@@ -1,0 +1,24 @@
+"""ringpop_tpu — a TPU-native membership / sharding / forwarding framework.
+
+A ground-up rebuild of the capabilities of charliezhang/ringpop (Uber's SWIM
+gossip membership + consistent-hash sharding + request forwarding library)
+designed TPU-first:
+
+* ``ringpop_tpu.RingPop`` — the host-side library: full API parity with the
+  reference facade (index.js): bootstrap, lookup/lookupN, handleOrProxy(All),
+  proxyReq, getStats, whoami, admin ops, events.  Python/asyncio, pluggable
+  transports (in-process for tests, TCP JSON-RPC for real clusters).
+* ``ringpop_tpu.models.swim_sim`` — the TPU simulation backend: the SWIM
+  membership/dissemination layer as vmapped epidemic-broadcast kernels over
+  dense N x N view/state tensors, simulating tens of thousands of virtual
+  nodes per chip with membership checksums identical to the host library.
+* ``ringpop_tpu.ops`` — bit-exact FarmHash32 (C / Python / JAX), checksum and
+  hash-ring kernels.
+* ``ringpop_tpu.parallel`` — jax.sharding mesh layouts for multi-chip scale.
+"""
+
+__version__ = "0.1.0"
+
+from ringpop_tpu.ops.farmhash import farmhash32
+
+__all__ = ["farmhash32", "__version__"]

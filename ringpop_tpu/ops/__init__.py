@@ -1,0 +1,5 @@
+"""Hashing / checksum / ring kernels (host C fast paths + JAX device ops)."""
+
+from ringpop_tpu.ops.farmhash import farmhash32, farmhash32_py, has_native
+
+__all__ = ["farmhash32", "farmhash32_py", "has_native"]

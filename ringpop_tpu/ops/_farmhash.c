@@ -1,0 +1,201 @@
+/* Portable FarmHash32 (Fingerprint32 == farmhashmk::Hash32).
+ *
+ * This is the stable, architecture-independent 32-bit FarmHash used for
+ * membership checksums and hash-ring replica placement.  The reference
+ * implementation (charliezhang/ringpop) uses the `farmhash` Node.js addon
+ * (lib/membership.js:57, lib/ring.js:29); that addon's `hash32` dispatches on
+ * CPU features and is NOT stable across machines, so this rebuild pins the
+ * portable Fingerprint32 variant (identical to `hash32` on non-SSE4.1 hosts
+ * and to `fingerprint32` everywhere).
+ *
+ * Algorithm: public-domain-style FarmHash by Geoff Pike (Google), MIT
+ * licensed.  Implemented from the published algorithm; verified bit-exact
+ * against the farmhash copy vendored by TensorFlow (see
+ * tools/verify_farmhash.cc and tests/test_farmhash.py).
+ *
+ * Exposed via ctypes (no pybind11 in this environment):
+ *   rp_farmhash32(buf, len) -> uint32
+ *   rp_farmhash32_batch(buf, offsets, lens, out, n)  -- n independent hashes
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+
+#define C1 0xcc9e2d51u
+#define C2 0x1b873593u
+
+static inline uint32_t fetch32(const uint8_t *p) {
+    /* little-endian 32-bit load (x86/ARM LE only, asserted in loader) */
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+/* FarmHash's Rotate32 is a rotate RIGHT. */
+static inline uint32_t rotr32(uint32_t v, int s) {
+    return s == 0 ? v : ((v >> s) | (v << (32 - s)));
+}
+
+static inline uint32_t fmix(uint32_t h) {
+    h ^= h >> 16;
+    h *= 0x85ebca6bu;
+    h ^= h >> 13;
+    h *= 0xc2b2ae35u;
+    h ^= h >> 16;
+    return h;
+}
+
+static inline uint32_t mur(uint32_t a, uint32_t h) {
+    a *= C1;
+    a = rotr32(a, 17);
+    a *= C2;
+    h ^= a;
+    h = rotr32(h, 19);
+    return h * 5 + 0xe6546b64u;
+}
+
+static uint32_t hash32_len_0_to_4(const uint8_t *s, size_t len, uint32_t seed) {
+    uint32_t b = seed;
+    uint32_t c = 9;
+    for (size_t i = 0; i < len; i++) {
+        /* signed char: bytes >= 0x80 subtract */
+        int8_t v = (int8_t)s[i];
+        b = b * C1 + (uint32_t)(int32_t)v;
+        c ^= b;
+    }
+    return fmix(mur(b, mur((uint32_t)len, c)));
+}
+
+static uint32_t hash32_len_5_to_12(const uint8_t *s, size_t len, uint32_t seed) {
+    uint32_t a = (uint32_t)len, b = (uint32_t)len * 5, c = 9, d = b + seed;
+    a += fetch32(s);
+    b += fetch32(s + len - 4);
+    c += fetch32(s + ((len >> 1) & 4));
+    return fmix(seed ^ mur(c, mur(b, mur(a, d))));
+}
+
+static uint32_t hash32_len_13_to_24(const uint8_t *s, size_t len, uint32_t seed) {
+    uint32_t a = fetch32(s - 4 + (len >> 1));
+    uint32_t b = fetch32(s + 4);
+    uint32_t c = fetch32(s + len - 8);
+    uint32_t d = fetch32(s + (len >> 1));
+    uint32_t e = fetch32(s);
+    uint32_t f = fetch32(s + len - 4);
+    uint32_t h = d * C1 + (uint32_t)len + seed;
+    a = rotr32(a, 12) + f;
+    h = mur(c, h) + a;
+    a = rotr32(a, 3) + c;
+    h = mur(e, h) + a;
+    a = rotr32(a + f, 12) + d;
+    h = mur(b ^ seed, h) + a;
+    return fmix(h);
+}
+
+uint32_t rp_farmhash32(const uint8_t *s, size_t len) {
+    if (len <= 24) {
+        return len <= 12
+                   ? (len <= 4 ? hash32_len_0_to_4(s, len, 0)
+                               : hash32_len_5_to_12(s, len, 0))
+                   : hash32_len_13_to_24(s, len, 0);
+    }
+
+    /* len > 24 */
+    uint32_t h = (uint32_t)len, g = C1 * (uint32_t)len, f = g;
+    uint32_t a0 = rotr32(fetch32(s + len - 4) * C1, 17) * C2;
+    uint32_t a1 = rotr32(fetch32(s + len - 8) * C1, 17) * C2;
+    uint32_t a2 = rotr32(fetch32(s + len - 16) * C1, 17) * C2;
+    uint32_t a3 = rotr32(fetch32(s + len - 12) * C1, 17) * C2;
+    uint32_t a4 = rotr32(fetch32(s + len - 20) * C1, 17) * C2;
+    h ^= a0;
+    h = rotr32(h, 19);
+    h = h * 5 + 0xe6546b64u;
+    h ^= a2;
+    h = rotr32(h, 19);
+    h = h * 5 + 0xe6546b64u;
+    g ^= a1;
+    g = rotr32(g, 19);
+    g = g * 5 + 0xe6546b64u;
+    g ^= a3;
+    g = rotr32(g, 19);
+    g = g * 5 + 0xe6546b64u;
+    f += a4;
+    f = rotr32(f, 19) + 113;
+    size_t iters = (len - 1) / 20;
+    do {
+        uint32_t a = fetch32(s);
+        uint32_t b = fetch32(s + 4);
+        uint32_t c = fetch32(s + 8);
+        uint32_t d = fetch32(s + 12);
+        uint32_t e = fetch32(s + 16);
+        h += a;
+        g += b;
+        f += c;
+        h = mur(d, h) + e;
+        g = mur(c, g) + a;
+        f = mur(b + e * C1, f) + d;
+        f += g;
+        g += f;
+        s += 20;
+    } while (--iters != 0);
+    g = rotr32(g, 11) * C1;
+    g = rotr32(g, 17) * C1;
+    f = rotr32(f, 11) * C1;
+    f = rotr32(f, 17) * C1;
+    h = rotr32(h + g, 19);
+    h = h * 5 + 0xe6546b64u;
+    h = rotr32(h, 17) * C1;
+    h = rotr32(h + f, 19);
+    h = h * 5 + 0xe6546b64u;
+    h = rotr32(h, 17) * C1;
+    return h;
+}
+
+void rp_farmhash32_batch(const uint8_t *buf, const int64_t *offsets,
+                         const int64_t *lens, uint32_t *out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = rp_farmhash32(buf + offsets[i], (size_t)lens[i]);
+    }
+}
+
+/* Build a ringpop membership checksum string and hash it.
+ *
+ * The reference builds `addr + status + incarnationNumber` per member, sorted
+ * by address, joined with ';' (lib/membership.js:70-93), then farmhash32s the
+ * result (lib/membership.js:57).  This helper does the concatenation in C for
+ * the host-side hot path.  Caller passes members pre-sorted by address as
+ * NUL-separated strings "addr\0status\0incarnation_decimal\0" x n.
+ */
+#include <stdlib.h>
+
+uint32_t rp_membership_checksum(const uint8_t *packed, int64_t packed_len,
+                                int64_t n_members) {
+    /* Concatenated length is < packed_len (3 NULs per member drop, up to
+     * n-1 ';' separators are added). */
+    uint8_t *heapbuf = (uint8_t *)malloc((size_t)packed_len + 1);
+    if (heapbuf == NULL) {
+        return 0;
+    }
+    uint8_t *dst = heapbuf;
+    const uint8_t *p = packed;
+    const uint8_t *end = packed + packed_len;
+    int64_t m = 0;
+    while (p < end && m < n_members) {
+        int fields = 0;
+        while (p < end && fields < 3) {
+            if (*p == 0) {
+                fields++;
+                p++;
+            } else {
+                *dst++ = *p++;
+            }
+        }
+        m++;
+        if (m < n_members) {
+            *dst++ = ';';
+        }
+    }
+    uint32_t h = rp_farmhash32(heapbuf, (size_t)(dst - heapbuf));
+    free(heapbuf);
+    return h;
+}

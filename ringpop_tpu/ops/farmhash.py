@@ -1,0 +1,279 @@
+"""Portable FarmHash32 (Fingerprint32) for membership/ring checksums.
+
+The reference (charliezhang/ringpop) hashes with the `farmhash` Node addon
+(`lib/membership.js:24,57`, `lib/ring.js:21,29`).  That addon's ``hash32``
+dispatches on CPU features; this rebuild pins the portable, seed-stable
+``Fingerprint32`` variant (== ``farmhashmk::Hash32``) so checksums are
+identical across hosts, TPUs and the pure-Python fallback.
+
+Three implementations, all bit-identical (cross-checked in
+tests/test_farmhash.py):
+
+* C (``_farmhash.c``, loaded via ctypes)  -- host hot path
+* pure Python                             -- fallback / oracle
+* JAX uint32 kernel (``farmhash_jax.py``) -- on-device batched hashing
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_M32 = 0xFFFFFFFF
+
+# ---------------------------------------------------------------------------
+# pure Python implementation
+# ---------------------------------------------------------------------------
+
+
+def _rotr32(v: int, s: int) -> int:
+    if s == 0:
+        return v
+    return ((v >> s) | (v << (32 - s))) & _M32
+
+
+def _fmix(h: int) -> int:
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+def _mur(a: int, h: int) -> int:
+    a = (a * _C1) & _M32
+    a = _rotr32(a, 17)
+    a = (a * _C2) & _M32
+    h ^= a
+    h = _rotr32(h, 19)
+    return (h * 5 + 0xE6546B64) & _M32
+
+
+def _fetch32(s: bytes, i: int) -> int:
+    return int.from_bytes(s[i : i + 4], "little")
+
+
+def _hash32_len_0_to_4(s: bytes, seed: int = 0) -> int:
+    b = seed
+    c = 9
+    for ch in s:
+        v = ch - 256 if ch >= 128 else ch  # signed char semantics
+        b = (b * _C1 + v) & _M32
+        c ^= b
+    return _fmix(_mur(b, _mur(len(s), c)))
+
+
+def _hash32_len_5_to_12(s: bytes, seed: int = 0) -> int:
+    n = len(s)
+    a = (n + _fetch32(s, 0)) & _M32
+    b = (n * 5 + _fetch32(s, n - 4)) & _M32
+    c = (9 + _fetch32(s, (n >> 1) & 4)) & _M32
+    d = (n * 5 + seed) & _M32
+    return _fmix(seed ^ _mur(c, _mur(b, _mur(a, d))))
+
+
+def _hash32_len_13_to_24(s: bytes, seed: int = 0) -> int:
+    n = len(s)
+    a = _fetch32(s, (n >> 1) - 4)
+    b = _fetch32(s, 4)
+    c = _fetch32(s, n - 8)
+    d = _fetch32(s, n >> 1)
+    e = _fetch32(s, 0)
+    f = _fetch32(s, n - 4)
+    h = (d * _C1 + n + seed) & _M32
+    a = (_rotr32(a, 12) + f) & _M32
+    h = (_mur(c, h) + a) & _M32
+    a = (_rotr32(a, 3) + c) & _M32
+    h = (_mur(e, h) + a) & _M32
+    a = (_rotr32((a + f) & _M32, 12) + d) & _M32
+    h = (_mur(b ^ seed, h) + a) & _M32
+    return _fmix(h)
+
+
+def _farmhash32_py(s: bytes) -> int:
+    n = len(s)
+    if n <= 24:
+        if n <= 12:
+            return _hash32_len_0_to_4(s) if n <= 4 else _hash32_len_5_to_12(s)
+        return _hash32_len_13_to_24(s)
+
+    h = n
+    g = (_C1 * n) & _M32
+    f = g
+    a0 = (_rotr32((_fetch32(s, n - 4) * _C1) & _M32, 17) * _C2) & _M32
+    a1 = (_rotr32((_fetch32(s, n - 8) * _C1) & _M32, 17) * _C2) & _M32
+    a2 = (_rotr32((_fetch32(s, n - 16) * _C1) & _M32, 17) * _C2) & _M32
+    a3 = (_rotr32((_fetch32(s, n - 12) * _C1) & _M32, 17) * _C2) & _M32
+    a4 = (_rotr32((_fetch32(s, n - 20) * _C1) & _M32, 17) * _C2) & _M32
+    h ^= a0
+    h = _rotr32(h, 19)
+    h = (h * 5 + 0xE6546B64) & _M32
+    h ^= a2
+    h = _rotr32(h, 19)
+    h = (h * 5 + 0xE6546B64) & _M32
+    g ^= a1
+    g = _rotr32(g, 19)
+    g = (g * 5 + 0xE6546B64) & _M32
+    g ^= a3
+    g = _rotr32(g, 19)
+    g = (g * 5 + 0xE6546B64) & _M32
+    f = (f + a4) & _M32
+    f = (_rotr32(f, 19) + 113) & _M32
+    iters = (n - 1) // 20
+    off = 0
+    while iters > 0:
+        a = _fetch32(s, off)
+        b = _fetch32(s, off + 4)
+        c = _fetch32(s, off + 8)
+        d = _fetch32(s, off + 12)
+        e = _fetch32(s, off + 16)
+        h = (h + a) & _M32
+        g = (g + b) & _M32
+        f = (f + c) & _M32
+        h = (_mur(d, h) + e) & _M32
+        g = (_mur(c, g) + a) & _M32
+        f = (_mur((b + e * _C1) & _M32, f) + d) & _M32
+        f = (f + g) & _M32
+        g = (g + f) & _M32
+        off += 20
+        iters -= 1
+    g = (_rotr32(g, 11) * _C1) & _M32
+    g = (_rotr32(g, 17) * _C1) & _M32
+    f = (_rotr32(f, 11) * _C1) & _M32
+    f = (_rotr32(f, 17) * _C1) & _M32
+    h = _rotr32((h + g) & _M32, 19)
+    h = (h * 5 + 0xE6546B64) & _M32
+    h = (_rotr32(h, 17) * _C1) & _M32
+    h = _rotr32((h + f) & _M32, 19)
+    h = (h * 5 + 0xE6546B64) & _M32
+    h = (_rotr32(h, 17) * _C1) & _M32
+    return h
+
+
+# ---------------------------------------------------------------------------
+# C fast path (built on demand, ctypes)
+# ---------------------------------------------------------------------------
+
+_C_SRC = os.path.join(os.path.dirname(__file__), "_farmhash.c")
+_C_LIB_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_C_LIB = os.path.join(_C_LIB_DIR, "libringpop_farmhash.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _build_c_lib() -> str | None:
+    if sys.byteorder != "little":  # fetch32 assumes LE
+        return None
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O2", "-shared", "-fPIC", "-o", _C_LIB, _C_SRC]
+    try:
+        os.makedirs(_C_LIB_DIR, exist_ok=True)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=60)
+    except (subprocess.SubprocessError, OSError):
+        return None
+    return _C_LIB
+
+
+def _c_lib_fresh() -> bool:
+    try:
+        return os.path.getmtime(_C_LIB) >= os.path.getmtime(_C_SRC)
+    except OSError:
+        return False
+
+
+def _load_c_lib():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = _C_LIB if _c_lib_fresh() else _build_c_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.rp_farmhash32.restype = ctypes.c_uint32
+    lib.rp_farmhash32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.rp_farmhash32_batch.restype = None
+    lib.rp_farmhash32_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.rp_membership_checksum.restype = ctypes.c_uint32
+    lib.rp_membership_checksum.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    _lib = lib
+    return _lib
+
+
+def has_native() -> bool:
+    """True when the C fast path is available."""
+    return _load_c_lib() is not None
+
+
+def farmhash32(data: bytes | str) -> int:
+    """Portable FarmHash Fingerprint32 of ``data`` (str encoded as UTF-8)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    lib = _load_c_lib()
+    if lib is not None:
+        return lib.rp_farmhash32(data, len(data))
+    return _farmhash32_py(data)
+
+
+def farmhash32_py(data: bytes | str) -> int:
+    """Pure-Python reference path (always available)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _farmhash32_py(data)
+
+
+def farmhash32_batch(buf: np.ndarray, offsets: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Hash ``n`` substrings of ``buf`` described by (offset, len) pairs."""
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    n = len(offsets)
+    out = np.empty(n, dtype=np.uint32)
+    lib = _load_c_lib()
+    if lib is not None:
+        lib.rp_farmhash32_batch(
+            buf.ctypes.data, offsets.ctypes.data, lens.ctypes.data, out.ctypes.data, n
+        )
+        return out
+    raw = buf.tobytes()
+    for i in range(n):
+        out[i] = _farmhash32_py(raw[offsets[i] : offsets[i] + lens[i]])
+    return out
+
+
+def membership_checksum_packed(packed: bytes, n_members: int) -> int:
+    """Checksum of pre-sorted members packed as ``addr\\0status\\0inc\\0`` x n.
+
+    Equivalent to farmhash32 of the reference's checksum string
+    (lib/membership.js:70-93): ``addr+status+inc`` joined by ``;``.
+    """
+    lib = _load_c_lib()
+    if lib is not None:
+        return lib.rp_membership_checksum(packed, len(packed), n_members)
+    parts = packed.split(b"\x00")
+    entries = [
+        parts[i] + parts[i + 1] + parts[i + 2] for i in range(0, 3 * n_members, 3)
+    ]
+    return _farmhash32_py(b";".join(entries))

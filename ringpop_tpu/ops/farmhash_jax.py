@@ -1,0 +1,190 @@
+"""Jittable FarmHash32 (Fingerprint32) on padded byte buffers.
+
+Bit-identical to ``ops/farmhash.py`` / ``ops/_farmhash.c`` (and therefore to
+the reference's farmhash checksums, lib/membership.js:57, lib/ring.js:29).
+All arithmetic is uint32 with natural wraparound; rotations are right-rotates.
+
+The kernel hashes a *variable-length* byte string stored in a *fixed-shape*
+uint8 buffer (padded), with the true length passed separately — the XLA-
+friendly shape discipline.  ``farmhash32_jax`` is vmappable over a batch of
+buffers, which is how per-node membership-checksum batches are computed on
+device (see ops/checksum.py).
+
+Design notes (TPU):
+ - no data-dependent Python control flow: the three small-length variants and
+   the long path are all computed branchlessly and selected by length;
+ - the long-path main loop is a ``lax.fori_loop`` over the *static* maximum
+   iteration count with predicated updates, so one compiled kernel serves all
+   lengths up to the buffer size;
+ - byte fetches are gathers; for batched use XLA fuses them into a handful of
+   vectorized loads.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+_MIX1 = jnp.uint32(0x85EBCA6B)
+_MIX2 = jnp.uint32(0xC2B2AE35)
+_MAGIC = jnp.uint32(0xE6546B64)
+
+
+def _rotr(v, s: int):
+    if s == 0:
+        return v
+    return (v >> jnp.uint32(s)) | (v << jnp.uint32(32 - s))
+
+
+def _fmix(h):
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * _MIX1
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * _MIX2
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _mur(a, h):
+    a = a * _C1
+    a = _rotr(a, 17)
+    a = a * _C2
+    h = h ^ a
+    h = _rotr(h, 19)
+    return h * jnp.uint32(5) + _MAGIC
+
+
+def _fetch32(buf, i):
+    """Little-endian uint32 load at dynamic byte offset ``i`` (clamped)."""
+    i = jnp.clip(i, 0, buf.shape[0] - 4)
+    w = lax.dynamic_slice(buf, (i,), (4,)).astype(jnp.uint32)
+    return w[0] | (w[1] << 8) | (w[2] << 16) | (w[3] << 24)
+
+
+def _hash_len_0_to_4(buf, n):
+    # b = b * c1 + signed(s[i]); c ^= b  -- for i < n (n <= 4)
+    b = jnp.uint32(0)
+    c = jnp.uint32(9)
+    for i in range(4):
+        v = buf[i].astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        nb = b * _C1 + v
+        nc = c ^ nb
+        take = i < n
+        b = jnp.where(take, nb, b)
+        c = jnp.where(take, nc, c)
+    return _fmix(_mur(b, _mur(n.astype(jnp.uint32), c)))
+
+
+def _hash_len_5_to_12(buf, n):
+    nu = n.astype(jnp.uint32)
+    a = nu + _fetch32(buf, 0)
+    b = nu * jnp.uint32(5) + _fetch32(buf, n - 4)
+    c = jnp.uint32(9) + _fetch32(buf, (n >> 1) & 4)
+    d = nu * jnp.uint32(5)
+    return _fmix(_mur(c, _mur(b, _mur(a, d))))
+
+
+def _hash_len_13_to_24(buf, n):
+    a = _fetch32(buf, (n >> 1) - 4)
+    b = _fetch32(buf, 4)
+    c = _fetch32(buf, n - 8)
+    d = _fetch32(buf, n >> 1)
+    e = _fetch32(buf, 0)
+    f = _fetch32(buf, n - 4)
+    h = d * _C1 + n.astype(jnp.uint32)
+    a = _rotr(a, 12) + f
+    h = _mur(c, h) + a
+    a = _rotr(a, 3) + c
+    h = _mur(e, h) + a
+    a = _rotr(a + f, 12) + d
+    h = _mur(b, h) + a
+    return _fmix(h)
+
+
+def _hash_len_gt_24(buf, n):
+    nu = n.astype(jnp.uint32)
+    h = nu
+    g = _C1 * nu
+    f = g
+    a0 = _rotr(_fetch32(buf, n - 4) * _C1, 17) * _C2
+    a1 = _rotr(_fetch32(buf, n - 8) * _C1, 17) * _C2
+    a2 = _rotr(_fetch32(buf, n - 16) * _C1, 17) * _C2
+    a3 = _rotr(_fetch32(buf, n - 12) * _C1, 17) * _C2
+    a4 = _rotr(_fetch32(buf, n - 20) * _C1, 17) * _C2
+    h = h ^ a0
+    h = _rotr(h, 19)
+    h = h * jnp.uint32(5) + _MAGIC
+    h = h ^ a2
+    h = _rotr(h, 19)
+    h = h * jnp.uint32(5) + _MAGIC
+    g = g ^ a1
+    g = _rotr(g, 19)
+    g = g * jnp.uint32(5) + _MAGIC
+    g = g ^ a3
+    g = _rotr(g, 19)
+    g = g * jnp.uint32(5) + _MAGIC
+    f = f + a4
+    f = _rotr(f, 19) + jnp.uint32(113)
+    iters = (n - 1) // 20
+    max_iters = (buf.shape[0] - 1) // 20
+
+    def body(i, state):
+        h, g, f = state
+        off = i * 20
+        a = _fetch32(buf, off)
+        b = _fetch32(buf, off + 4)
+        c = _fetch32(buf, off + 8)
+        d = _fetch32(buf, off + 12)
+        e = _fetch32(buf, off + 16)
+        nh = h + a
+        ng = g + b
+        nf = f + c
+        nh = _mur(d, nh) + e
+        ng = _mur(c, ng) + a
+        nf = _mur(b + e * _C1, nf) + d
+        nf = nf + ng
+        ng = ng + nf
+        take = i < iters
+        return (
+            jnp.where(take, nh, h),
+            jnp.where(take, ng, g),
+            jnp.where(take, nf, f),
+        )
+
+    h, g, f = lax.fori_loop(0, max_iters, body, (h, g, f))
+    g = _rotr(g, 11) * _C1
+    g = _rotr(g, 17) * _C1
+    f = _rotr(f, 11) * _C1
+    f = _rotr(f, 17) * _C1
+    h = _rotr(h + g, 19)
+    h = h * jnp.uint32(5) + _MAGIC
+    h = _rotr(h, 17) * _C1
+    h = _rotr(h + f, 19)
+    h = h * jnp.uint32(5) + _MAGIC
+    h = _rotr(h, 17) * _C1
+    return h
+
+
+def farmhash32_jax(buf: jax.Array, n: jax.Array) -> jax.Array:
+    """Fingerprint32 of ``buf[:n]``; ``buf`` is uint8[L] (L static, >= 25)."""
+    if buf.shape[0] < 25:
+        raise ValueError("pad buffer to at least 25 bytes")
+    n = n.astype(jnp.int32)
+    h04 = _hash_len_0_to_4(buf, n)
+    h512 = _hash_len_5_to_12(buf, n)
+    h1324 = _hash_len_13_to_24(buf, n)
+    hlong = _hash_len_gt_24(buf, n)
+    return jnp.where(
+        n <= 4, h04, jnp.where(n <= 12, h512, jnp.where(n <= 24, h1324, hlong))
+    )
+
+
+@functools.partial(jax.jit, static_argnames=())
+def farmhash32_batch_jax(bufs: jax.Array, lens: jax.Array) -> jax.Array:
+    """Vmapped Fingerprint32: bufs uint8[B, L], lens int32[B] -> uint32[B]."""
+    return jax.vmap(farmhash32_jax)(bufs, lens)

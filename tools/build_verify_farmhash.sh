@@ -1,0 +1,92 @@
+#!/bin/bash
+# Build the farmhash golden-oracle verifier by extracting the farmhashmk
+# (Fingerprint32) section from the FarmHash copy vendored by TensorFlow.
+# Usage: tools/build_verify_farmhash.sh <output-binary>
+# Exits non-zero (quietly) if the TF header is unavailable.
+set -e
+OUT="${1:-/tmp/verify_farmhash}"
+HDR=$(python3 - <<'EOF'
+import glob, sys
+hits = glob.glob('/opt/venv/lib/python*/site-packages/tensorflow/include/external/farmhash_gpu_archive/src/farmhash_gpu.h')
+if not hits:
+    sys.exit(1)
+print(hits[0])
+EOF
+)
+[ -n "$HDR" ] || exit 1
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+
+# Locate the farmhashmk namespace block and the Murmur helper block by markers
+# rather than line numbers so header revisions don't break us.
+python3 - "$HDR" "$WORK/golden_mk.cc" <<'EOF'
+import sys
+hdr, out = sys.argv[1], sys.argv[2]
+text = open(hdr).read().splitlines()
+
+# Helpers: from the c1/c2 constants comment through the end of Mur's body.
+pre = next(i for i, l in enumerate(text) if "// Magic numbers for 32-bit hashing" in l)
+mur_start = next(i for i, l in enumerate(text) if "STATIC_INLINE uint32_t Mur" in l)
+mur_end = next(i for i in range(mur_start, len(text)) if text[i].startswith("}"))
+helpers = "\n".join(text[pre:mur_end + 1])
+
+mk_start = next(i for i, l in enumerate(text) if l.strip() == "namespace farmhashmk {")
+mk_end = next(i for i, l in enumerate(text) if "// namespace farmhashmk" in l)
+mk = "\n".join(text[mk_start:mk_end + 1])
+# Drop the Fetch/Rotate/Bswap macro redefinitions at the head of the block.
+mk = "\n".join(l for l in mk.splitlines()
+               if not l.startswith(("#undef", "#define")))
+
+open(out, "w").write(f"""
+#include <cstdint>
+#include <cstring>
+namespace golden {{
+#define STATIC_INLINE static inline
+static inline uint32_t Fetch(const char *p) {{
+  uint32_t v; memcpy(&v, p, 4); return v;
+}}
+static inline uint32_t Rotate(uint32_t val, int shift) {{
+  return shift == 0 ? val : ((val >> shift) | (val << (32 - shift)));
+}}
+#define Rotate32 Rotate
+{helpers}
+{mk}
+}}  // namespace golden
+""")
+EOF
+
+cat > "$WORK/main.cc" <<'EOF'
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+#include "golden_mk.cc"
+extern "C" {
+#include "_farmhash.c"
+}
+static int unhex(int c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  return -1;
+}
+int main() {
+  char buf[1 << 16];
+  while (fgets(buf, sizeof(buf), stdin)) {
+    size_t n = strlen(buf);
+    while (n && (buf[n - 1] == '\n' || buf[n - 1] == '\r')) buf[--n] = 0;
+    std::vector<uint8_t> bytes;
+    for (size_t i = 0; i + 1 < n; i += 2)
+      bytes.push_back((uint8_t)((unhex(buf[i]) << 4) | unhex(buf[i + 1])));
+    const char *p = bytes.empty() ? "" : (const char *)bytes.data();
+    uint32_t golden = golden::farmhashmk::Hash32(p, bytes.size());
+    uint32_t ours = rp_farmhash32(
+        bytes.empty() ? (const uint8_t *)"" : bytes.data(), bytes.size());
+    printf("%u %u\n", ours, golden);
+  }
+  return 0;
+}
+EOF
+
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
+cp "$SCRIPT_DIR/../ringpop_tpu/ops/_farmhash.c" "$WORK/"
+g++ -O2 -o "$OUT" "$WORK/main.cc" -I "$WORK"
