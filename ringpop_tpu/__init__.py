@@ -21,4 +21,14 @@ __version__ = "0.1.0"
 
 from ringpop_tpu.ops.farmhash import farmhash32
 
-__all__ = ["farmhash32", "__version__"]
+
+def __getattr__(name):
+    # Lazy to keep `import ringpop_tpu` light (jax-free) for hashing-only use.
+    if name == "RingPop":
+        from ringpop_tpu.ringpop import RingPop
+
+        return RingPop
+    raise AttributeError(name)
+
+
+__all__ = ["farmhash32", "RingPop", "__version__"]

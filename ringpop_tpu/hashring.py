@@ -1,0 +1,132 @@
+"""Consistent hash ring: 100 replica points per server, farmhash32 placement.
+
+Reference: lib/ring.js + lib/rbtree.js.  The reference stores replica points
+in a red-black tree; the behavior contract is only the lookup semantics
+(ring.js:138-182): ``lookup(key)`` returns the owner of the first replica
+with hash >= farmhash32(key) (rbtree upperBound includes equality,
+rbtree.js:262-271), wrapping to the minimum; ``lookupN`` walks successive
+unique owners with wraparound.  A sorted array + binary search gives the
+same O(log R) with far better constants and maps directly onto the
+vectorized ``searchsorted`` device kernel (ops/ring_ops.py).
+
+Tie-break on (astronomically rare) 32-bit hash collisions is by server name
+— deterministic, unlike the reference's insertion-order-dependent tree.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Callable
+
+from ringpop_tpu.ops.farmhash import farmhash32
+from ringpop_tpu.utils.events import EventEmitter
+
+DEFAULT_REPLICA_POINTS = 100
+
+
+class HashRing(EventEmitter):
+    def __init__(
+        self,
+        replica_points: int = DEFAULT_REPLICA_POINTS,
+        hash_func: Callable[[str], int] | None = None,
+    ):
+        super().__init__()
+        self.replica_points = replica_points
+        self.hash_func = hash_func or farmhash32
+        # Sorted list of (replica_hash, server) pairs.
+        self._entries: list[tuple[int, str]] = []
+        self.servers: dict[str, bool] = {}
+        self.checksum: int | None = None
+
+    # -- mutation (ring.js:39-94) -------------------------------------------
+
+    def add_server(self, name: str) -> None:
+        if self.has_server(name):
+            return
+        self._add_server_replicas(name)
+        self.compute_checksum()
+        self.emit("added", name)
+
+    def remove_server(self, name: str) -> None:
+        if not self.has_server(name):
+            return
+        self._remove_server_replicas(name)
+        self.compute_checksum()
+        self.emit("removed", name)
+
+    def add_remove_servers(
+        self,
+        servers_to_add: list[str] | None = None,
+        servers_to_remove: list[str] | None = None,
+    ) -> bool:
+        """Batch add/remove with a single checksum recompute (ring.js:60-94)."""
+        added = False
+        removed = False
+        for server in servers_to_add or []:
+            if not self.has_server(server):
+                self._add_server_replicas(server)
+                added = True
+        for server in servers_to_remove or []:
+            if self.has_server(server):
+                self._remove_server_replicas(server)
+                removed = True
+        changed = added or removed
+        if changed:
+            self.compute_checksum()
+        return changed
+
+    def _add_server_replicas(self, server: str) -> None:
+        self.servers[server] = True
+        for i in range(self.replica_points):
+            h = self.hash_func(f"{server}{i}")
+            bisect.insort(self._entries, (h, server))
+
+    def _remove_server_replicas(self, server: str) -> None:
+        del self.servers[server]
+        for i in range(self.replica_points):
+            h = self.hash_func(f"{server}{i}")
+            idx = bisect.bisect_left(self._entries, (h, server))
+            if idx < len(self._entries) and self._entries[idx] == (h, server):
+                del self._entries[idx]
+
+    # -- checksum (ring.js:96-105) ------------------------------------------
+
+    def compute_checksum(self) -> None:
+        server_name_str = ";".join(sorted(self.servers.keys()))
+        self.checksum = self.hash_func(server_name_str)
+        self.emit("checksumComputed")
+
+    # -- queries (ring.js:107-182) ------------------------------------------
+
+    def get_server_count(self) -> int:
+        return len(self.servers)
+
+    def has_server(self, name: str) -> bool:
+        return name in self.servers
+
+    def lookup(self, key: str) -> str | None:
+        if not self._entries:
+            return None
+        h = self.hash_func(key)
+        idx = bisect.bisect_left(self._entries, (h, ""))
+        if idx == len(self._entries):
+            idx = 0  # wrap to min (ring.js:142-145)
+        return self._entries[idx][1]
+
+    def lookup_n(self, key: str, n: int) -> list[str]:
+        """Preference list: up to n unique successor owners (ring.js:150-182)."""
+        n = min(n, self.get_server_count())
+        if n <= 0 or not self._entries:
+            return []
+        h = self.hash_func(key)
+        start = bisect.bisect_left(self._entries, (h, ""))
+        result: list[str] = []
+        seen: set[str] = set()
+        for k in range(len(self._entries)):
+            server = self._entries[(start + k) % len(self._entries)][1]
+            if server not in seen:
+                seen.add(server)
+                result.append(server)
+                if len(result) == n:
+                    break
+        return result

@@ -168,13 +168,14 @@ void rp_farmhash32_batch(const uint8_t *buf, const int64_t *offsets,
  */
 #include <stdlib.h>
 
-uint32_t rp_membership_checksum(const uint8_t *packed, int64_t packed_len,
-                                int64_t n_members) {
-    /* Concatenated length is < packed_len (3 NULs per member drop, up to
-     * n-1 ';' separators are added). */
+int64_t rp_membership_checksum(const uint8_t *packed, int64_t packed_len,
+                               int64_t n_members) {
+    /* Returns the uint32 checksum, or -1 on allocation failure (the Python
+     * caller falls back to the pure path).  Concatenated length is
+     * < packed_len (3 NULs per member drop, up to n-1 ';' are added). */
     uint8_t *heapbuf = (uint8_t *)malloc((size_t)packed_len + 1);
     if (heapbuf == NULL) {
-        return 0;
+        return -1;
     }
     uint8_t *dst = heapbuf;
     const uint8_t *p = packed;
@@ -197,5 +198,5 @@ uint32_t rp_membership_checksum(const uint8_t *packed, int64_t packed_len,
     }
     uint32_t h = rp_farmhash32(heapbuf, (size_t)(dst - heapbuf));
     free(heapbuf);
-    return h;
+    return (int64_t)h;
 }

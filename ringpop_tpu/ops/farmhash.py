@@ -20,7 +20,6 @@ import ctypes
 import os
 import subprocess
 import sys
-import tempfile
 
 import numpy as np
 
@@ -212,7 +211,7 @@ def _load_c_lib():
         ctypes.c_void_p,
         ctypes.c_int64,
     ]
-    lib.rp_membership_checksum.restype = ctypes.c_uint32
+    lib.rp_membership_checksum.restype = ctypes.c_int64
     lib.rp_membership_checksum.argtypes = [
         ctypes.c_char_p,
         ctypes.c_int64,
@@ -271,9 +270,16 @@ def membership_checksum_packed(packed: bytes, n_members: int) -> int:
     """
     lib = _load_c_lib()
     if lib is not None:
-        return lib.rp_membership_checksum(packed, len(packed), n_members)
+        result = lib.rp_membership_checksum(packed, len(packed), n_members)
+        if result >= 0:
+            return result
+    # Pure path, mirroring the C concatenation exactly (including its
+    # behavior when fewer members are packed than n_members claims).
     parts = packed.split(b"\x00")
-    entries = [
-        parts[i] + parts[i + 1] + parts[i + 2] for i in range(0, 3 * n_members, 3)
-    ]
-    return _farmhash32_py(b";".join(entries))
+    n_packed = min(n_members, len(parts) // 3)
+    out = bytearray()
+    for i in range(n_packed):
+        out += parts[3 * i] + parts[3 * i + 1] + parts[3 * i + 2]
+        if i + 1 < n_members:
+            out += b";"
+    return _farmhash32_py(bytes(out))

@@ -22,8 +22,6 @@ Design notes (TPU):
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -184,7 +182,7 @@ def farmhash32_jax(buf: jax.Array, n: jax.Array) -> jax.Array:
     )
 
 
-@functools.partial(jax.jit, static_argnames=())
+@jax.jit
 def farmhash32_batch_jax(bufs: jax.Array, lens: jax.Array) -> jax.Array:
     """Vmapped Fingerprint32: bufs uint8[B, L], lens int32[B] -> uint32[B]."""
     return jax.vmap(farmhash32_jax)(bufs, lens)

@@ -1,0 +1,7 @@
+"""Request forwarding ("handle-or-forward") — reference: lib/request-proxy/."""
+
+from ringpop_tpu.request_proxy.proxy import RequestProxy
+from ringpop_tpu.request_proxy.head import raw_head, str_head
+from ringpop_tpu.request_proxy.http import ProxyRequest, ProxyResponse
+
+__all__ = ["RequestProxy", "raw_head", "str_head", "ProxyRequest", "ProxyResponse"]

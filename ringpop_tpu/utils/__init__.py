@@ -1,0 +1,20 @@
+"""Cross-cutting utilities (reference: lib/util.js, lib/nulls.js)."""
+
+from ringpop_tpu.utils.events import EventEmitter
+from ringpop_tpu.utils.misc import (
+    capture_host,
+    num_or_default,
+    parse_arg,
+    safe_parse,
+)
+from ringpop_tpu.utils.nulls import NullLogger, NullStatsd
+
+__all__ = [
+    "EventEmitter",
+    "capture_host",
+    "num_or_default",
+    "parse_arg",
+    "safe_parse",
+    "NullLogger",
+    "NullStatsd",
+]

@@ -1,0 +1,41 @@
+"""No-op logger and stats sink defaults (reference: lib/nulls.js)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class NullLogger:
+    def debug(self, msg: str, extra: Any = None) -> None: ...
+
+    def info(self, msg: str, extra: Any = None) -> None: ...
+
+    def warn(self, msg: str, extra: Any = None) -> None: ...
+
+    def error(self, msg: str, extra: Any = None) -> None: ...
+
+    def trace(self, msg: str, extra: Any = None) -> None: ...
+
+
+class NullStatsd:
+    def increment(self, key: str, value: Any = None) -> None: ...
+
+    def gauge(self, key: str, value: Any = None) -> None: ...
+
+    def timing(self, key: str, value: Any = None) -> None: ...
+
+
+class CapturingStatsd:
+    """Records every stat call; used by tests and /admin/stats."""
+
+    def __init__(self) -> None:
+        self.calls: list[tuple[str, str, Any]] = []
+
+    def increment(self, key: str, value: Any = None) -> None:
+        self.calls.append(("increment", key, value))
+
+    def gauge(self, key: str, value: Any = None) -> None:
+        self.calls.append(("gauge", key, value))
+
+    def timing(self, key: str, value: Any = None) -> None:
+        self.calls.append(("timing", key, value))

@@ -1,0 +1,222 @@
+"""Multi-node integration tests on the in-process cluster harness.
+
+Reference: test/integration/{join,swim}-test.js via
+test/lib/test-ringpop-cluster.js — N real RingPops in one process, with
+pre-bootstrap sabotage hooks and deterministic time.
+"""
+
+from ringpop_tpu.harness import Cluster
+from ringpop_tpu.member import Status
+
+
+def converged_cluster(size=3, **kw):
+    c = Cluster(size=size, **kw)
+    c.bootstrap_all(run=False)
+    assert c.run_until_converged(60000)
+    return c
+
+
+def test_single_node_cluster_short_circuit():
+    """join-sender.js:69-73,212-221."""
+    c = Cluster(size=1)
+    results = c.bootstrap_all()
+    assert results == [[]]
+    assert c.nodes[0].is_ready
+
+
+def test_two_and_three_node_join():
+    for size in (2, 3):
+        c = converged_cluster(size)
+        for node in c.nodes:
+            assert node.is_ready
+            assert node.membership.get_member_count() == size
+        assert len(c.checksum_groups()) == 1
+        c.destroy_all()
+
+
+def test_mega_cluster_25_nodes():
+    """join-test.js:109-119."""
+    c = converged_cluster(25)
+    counts = {n.membership.get_member_count() for n in c.nodes}
+    assert counts == {25}
+    c.destroy_all()
+
+
+def test_join_with_dead_seed():
+    """Bad node in bootstrap list does not prevent join (enough live seeds
+    remain to satisfy joinSize=3)."""
+    c = Cluster(size=6)
+    c.kill(5)
+    c.bootstrap_all(run=False)
+    c.scheduler.advance(30000)
+    live = c.live_nodes()
+    assert all(n.is_ready for n in live)
+
+
+def test_deny_joins():
+    """index.js:697-704 + join-handler.js:44-50: all seeds denying ->
+    bootstrap fails with join-attempts/duration error."""
+
+    def tap(nodes):
+        for node in nodes[1:]:
+            node.deny_joins()
+
+    c = Cluster(size=3, tap=tap)
+    results = [None, None, None]
+
+    def cb(i):
+        return lambda err, joined=None: results.__setitem__(i, err or joined)
+
+    c.nodes[0].bootstrap(list(c.host_ports), cb(0))
+    c.scheduler.advance(150000)
+    err = results[0]
+    assert err is not None
+    assert getattr(err, "type", "").startswith("ringpop.join-")
+
+
+def test_kill_suspect_faulty_cycle():
+    c = converged_cluster(5)
+    victim = c.host_ports[4]
+    c.kill(4)
+    c.run(7000)
+    statuses = {
+        n.host_port: n.membership.find_member_by_address(victim).status
+        for n in c.live_nodes()
+    }
+    assert all(s in (Status.suspect, Status.faulty) for s in statuses.values())
+    c.run(15000)
+    statuses = {
+        n.host_port: n.membership.find_member_by_address(victim).status
+        for n in c.live_nodes()
+    }
+    assert all(s == Status.faulty for s in statuses.values())
+    # Faulty members are retained in the list but removed from the ring.
+    node0 = c.nodes[0]
+    assert node0.membership.get_member_count() == 5
+    assert victim not in node0.ring.servers
+    assert c.run_until_converged(30000)
+    c.destroy_all()
+
+
+def test_suspend_behaves_like_slow_node_then_recovers():
+    """SIGSTOP analog (tick-cluster.js:432-446): suspended node times out
+    (suspect) and recovers on resume via refutation."""
+    c = converged_cluster(5)
+    victim = c.host_ports[4]
+    c.suspend(4)
+    c.run(12000)
+    statuses = {
+        n.host_port: n.membership.find_member_by_address(victim).status
+        for n in c.live_nodes()
+    }
+    assert all(s in (Status.suspect, Status.faulty) for s in statuses.values())
+    c.resume(4)
+    assert c.run_until_converged(90000)
+    final = {
+        n.host_port: n.membership.find_member_by_address(victim).status
+        for n in c.nodes
+    }
+    assert all(s == Status.alive for s in final.values())
+    c.destroy_all()
+
+
+def test_partition_and_heal():
+    """Netsplit: the stub the reference never finished
+    (test/lib/partition-cluster.js) done properly with reachability masks."""
+    c = converged_cluster(6)
+    c.partition([[0, 1, 2], [3, 4, 5]])
+    c.run(30000)
+    # Each side declares the other faulty; two checksum groups among all.
+    groups = c.checksum_groups()
+    assert len(groups) == 2
+    side_a = c.nodes[0]
+    for idx in (3, 4, 5):
+        assert (
+            side_a.membership.find_member_by_address(c.host_ports[idx]).status
+            == Status.faulty
+        )
+    c.heal_partition()
+    assert c.run_until_converged(180000)
+    # After heal every member is alive everywhere again (faulty members are
+    # retained so splits can merge, docs/architecture_design.md:19).
+    for node in c.nodes:
+        for host in c.host_ports:
+            assert node.membership.find_member_by_address(host).status == Status.alive
+    c.destroy_all()
+
+
+def test_leave_and_rejoin():
+    """admin-leave + admin-join semantics (server/admin-*-handler.js)."""
+    c = converged_cluster(3)
+    node = c.nodes[2]
+    results = []
+    node.channel.request(
+        c.host_ports[0], "/admin/leave", None, None, 5000,
+        lambda err, r1=None, r2=None: results.append((err, r2)),
+    )
+    c.run(5000)
+    assert results and results[0][0] is None
+    # Node 0 left: gossip stopped, status leave spreads.
+    c.run(20000)
+    assert c.nodes[0].gossip.is_stopped
+    for n in (c.nodes[1], c.nodes[2]):
+        assert (
+            n.membership.find_member_by_address(c.host_ports[0]).status == Status.leave
+        )
+        assert c.host_ports[0] not in n.ring.servers
+
+    # Redundant leave errors.
+    res2 = []
+    node.channel.request(
+        c.host_ports[0], "/admin/leave", None, None, 5000,
+        lambda err, r1=None, r2=None: res2.append(err),
+    )
+    c.run(1000)
+    assert getattr(res2[0], "type", None) == "ringpop.invalid-leave.redundant"
+
+    # Rejoin via /admin/join.
+    res3 = []
+    node.channel.request(
+        c.host_ports[0], "/admin/join", None, "{}", 5000,
+        lambda err, r1=None, r2=None: res3.append((err, r2)),
+    )
+    c.run(2000)
+    assert res3 and res3[0][0] is None
+    assert c.run_until_converged(60000)
+    for n in c.nodes:
+        assert (
+            n.membership.find_member_by_address(c.host_ports[0]).status == Status.alive
+        )
+    c.destroy_all()
+
+
+def test_tick_and_admin_stats():
+    """tick-cluster's convergence probe (/admin/tick, index.js:398-403)."""
+    c = converged_cluster(3)
+    out = c.tick_all()
+    assert len(out) == 3
+    import json
+
+    checksums = {json.loads(v)["checksum"] for v in out.values()}
+    assert len(checksums) == 1
+
+    res = []
+    c.nodes[0].channel.request(
+        c.host_ports[1], "/admin/stats", None, None, 5000,
+        lambda err, r1=None, r2=None: res.append((err, r2)),
+    )
+    c.run(100)
+    stats = json.loads(res[0][1])
+    assert stats["membership"]["checksum"] == c.nodes[1].membership.checksum
+    assert len(stats["ring"]) == 3
+    assert "protocol" in stats
+    c.destroy_all()
+
+
+def test_gossip_full_cycle_with_packet_loss():
+    """1% packet loss does not prevent convergence (BASELINE config 3 analog)."""
+    c = Cluster(size=8)
+    c.network.set_drop_rate(0.01)
+    c.bootstrap_all(run=False)
+    assert c.run_until_converged(120000)
+    c.destroy_all()

@@ -1,0 +1,87 @@
+"""Dissemination tests (reference: test/dissemination-test.js)."""
+
+from ringpop_tpu.harness import test_ringpop
+from ringpop_tpu.member import Status
+
+
+def make_rp(n_members=3):
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    for i in range(2, 2 + n_members):
+        rp.membership.make_alive(f"10.0.0.{i}:3000", 1000 + i)
+    return rp
+
+
+def test_record_and_issue_as_sender():
+    rp = make_rp()
+    issued = rp.dissemination.issue_as_sender()
+    addrs = {c["address"] for c in issued}
+    assert "10.0.0.2:3000" in addrs
+    for change in issued:
+        assert set(change) == {
+            "id", "source", "sourceIncarnationNumber", "address", "status",
+            "incarnationNumber",
+        }
+
+
+def test_piggyback_eviction():
+    """Changes are evicted after maxPiggybackCount issues
+    (dissemination.js:138-177)."""
+    rp = make_rp()
+    max_pb = rp.dissemination.max_piggyback_count
+    assert max_pb == 15  # 15 * ceil(log10(4+1)) = 15
+
+    for _ in range(max_pb):
+        assert rp.dissemination.issue_as_sender()
+    assert rp.dissemination.issue_as_sender() == []
+    assert rp.dissemination.changes == {}
+
+
+def test_receiver_filters_senders_own_changes():
+    """Anti-echo (dissemination-test.js:43-72)."""
+    rp = make_rp()
+    rp.dissemination.clear_changes()
+    rp.dissemination.record_change(
+        {
+            "address": "10.0.0.9:3000",
+            "status": Status.alive,
+            "incarnationNumber": 1,
+            "source": "10.0.0.2:3000",
+            "sourceIncarnationNumber": 42,
+        }
+    )
+    # Sender is the change's source with matching incarnation -> filtered,
+    # and checksums match -> no full sync.
+    issued = rp.dissemination.issue_as_receiver(
+        "10.0.0.2:3000", 42, rp.membership.checksum
+    )
+    assert issued == []
+    # Different incarnation -> not filtered.
+    issued = rp.dissemination.issue_as_receiver(
+        "10.0.0.2:3000", 43, rp.membership.checksum
+    )
+    assert len(issued) == 1
+
+
+def test_full_sync_on_checksum_mismatch():
+    """Empty piggyback + checksum mismatch -> full membership as changes
+    (dissemination.js:100-118)."""
+    rp = make_rp()
+    rp.dissemination.clear_changes()
+    issued = rp.dissemination.issue_as_receiver("10.0.0.2:3000", 42, 12345)
+    assert len(issued) == rp.membership.get_member_count()
+    assert all(c["source"] == rp.whoami() for c in issued)
+    # Checksum match -> nothing.
+    assert (
+        rp.dissemination.issue_as_receiver("10.0.0.2:3000", 42, rp.membership.checksum)
+        == []
+    )
+
+
+def test_adjust_max_piggyback_with_ring_size():
+    rp = make_rp()
+    # 3 members + self = 4 ring servers -> ceil(log10(5)) = 1 -> 15
+    assert rp.dissemination.max_piggyback_count == 15
+    for i in range(10, 20):
+        rp.membership.make_alive(f"10.0.0.{i}:3000", 1)
+    # 14 servers -> ceil(log10(15)) = 2 -> 30
+    assert rp.dissemination.max_piggyback_count == 30

@@ -5,6 +5,7 @@ The membership checksum (lib/membership.js:41-64) and ring placement
 must agree bit-for-bit.
 """
 
+import glob
 import os
 import random
 import subprocess
@@ -95,8 +96,6 @@ def test_jax_matches_python():
     for c, h in zip(cases, out):
         assert farmhash32_py(c) == int(h), f"len={len(c)}"
 
-
-import glob
 
 TF_HEADERS = glob.glob(
     "/opt/venv/lib/python*/site-packages/tensorflow/include/external/"

@@ -1,0 +1,105 @@
+"""Hash ring tests (reference: test/hashring_test.js, test/ring-test.js)."""
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.ops.farmhash import farmhash32
+
+
+def test_replica_points_and_membership():
+    ring = HashRing()
+    ring.add_server("a:1")
+    assert ring.has_server("a:1")
+    assert ring.get_server_count() == 1
+    assert len(ring._entries) == 100  # 100 replica points (ring.js:28)
+    ring.remove_server("a:1")
+    assert not ring.has_server("a:1")
+    assert len(ring._entries) == 0
+
+
+def test_checksum_order_independence():
+    """hashring_test.js:130-158."""
+    r1, r2 = HashRing(), HashRing()
+    r1.add_remove_servers(["a:1", "b:2", "c:3"], [])
+    r2.add_remove_servers(["c:3", "a:1", "b:2"], [])
+    assert r1.checksum == r2.checksum
+    assert r1.checksum == farmhash32(";".join(sorted(["a:1", "b:2", "c:3"])))
+
+
+def test_checksum_computed_once_for_batch():
+    ring = HashRing()
+    count = [0]
+    ring.on("checksumComputed", lambda *a: count.__setitem__(0, count[0] + 1))
+    ring.add_remove_servers(["a:1", "b:2", "c:3"], [])
+    assert count[0] == 1
+
+
+def test_empty_ring_checksum_is_hash_of_empty_string():
+    ring = HashRing()
+    ring.compute_checksum()
+    assert ring.checksum == farmhash32("")
+
+
+def test_lookup_consistency():
+    ring = HashRing()
+    servers = [f"10.0.0.{i}:3000" for i in range(10)]
+    ring.add_remove_servers(servers, [])
+    # every key maps to a real server, deterministically
+    for key in (str(i) for i in range(1000)):
+        dest = ring.lookup(key)
+        assert dest in servers
+        assert ring.lookup(key) == dest
+
+
+def test_lookup_successor_semantics():
+    """lookup returns owner of first replica with hash >= hash(key), with
+    wraparound (ring.js:138-147 + rbtree upperBound incl. equality)."""
+    ring = HashRing()
+    ring.add_remove_servers(["a:1", "b:2", "c:3"], [])
+    entries = ring._entries
+    # exact-hash key: find a key colliding is impractical; instead verify
+    # the array invariant directly for a sample of hashes.
+    for key in ("x", "y", "hello", "key0"):
+        h = farmhash32(key)
+        expect = None
+        for eh, server in entries:
+            if eh >= h:
+                expect = server
+                break
+        if expect is None:
+            expect = entries[0][1]
+        assert ring.lookup(key) == expect
+
+
+def test_lookup_n_unique_and_wrapping():
+    ring = HashRing()
+    servers = ["a:1", "b:2", "c:3", "d:4"]
+    ring.add_remove_servers(servers, [])
+    dests = ring.lookup_n("some-key", 3)
+    assert len(dests) == 3
+    assert len(set(dests)) == 3
+    assert ring.lookup_n("some-key", 10) == ring.lookup_n("some-key", 4)
+    assert ring.lookup("some-key") == dests[0]
+    assert ring.lookup_n("some-key", 0) == []
+
+
+def test_lookup_empty_ring():
+    ring = HashRing()
+    assert ring.lookup("k") is None
+    assert ring.lookup_n("k", 3) == []
+
+
+def test_removal_rebalances_only_affected_keys():
+    """Consistent hashing: removing one server only moves its keys."""
+    ring = HashRing()
+    servers = [f"10.0.0.{i}:3000" for i in range(10)]
+    ring.add_remove_servers(servers, [])
+    keys = [f"key{i}" for i in range(2000)]
+    before = {k: ring.lookup(k) for k in keys}
+    victim = servers[3]
+    ring.remove_server(victim)
+    moved = 0
+    for k in keys:
+        after = ring.lookup(k)
+        if before[k] != after:
+            moved += 1
+            assert before[k] == victim  # only the victim's keys may move
+    assert moved > 0

@@ -1,0 +1,82 @@
+"""Changeset/join-response merge tests (reference:
+test/membership-changeset-merge-test.js, test/join-response-merge-test.js)
+plus join group selection (test/join-sender-test.js)."""
+
+import random
+
+from ringpop_tpu.changeset_merge import merge_membership_changesets
+from ringpop_tpu.harness import test_ringpop
+from ringpop_tpu.swim.join_response_merge import merge_join_responses
+from ringpop_tpu.swim.join_sender import JoinCluster
+
+
+def ch(addr, inc, status="alive"):
+    return {"address": addr, "status": status, "incarnationNumber": inc}
+
+
+def test_changeset_merge_max_incarnation_wins():
+    merged = merge_membership_changesets(
+        "me:1",
+        [[ch("a:1", 5), ch("b:2", 3)], [ch("a:1", 9)], [ch("a:1", 7), ch("c:3", 1)]],
+    )
+    by_addr = {c["address"]: c for c in merged}
+    assert by_addr["a:1"]["incarnationNumber"] == 9
+    assert by_addr["b:2"]["incarnationNumber"] == 3
+    assert set(by_addr) == {"a:1", "b:2", "c:3"}
+
+
+def test_changeset_merge_excludes_self():
+    merged = merge_membership_changesets("me:1", [[ch("me:1", 5), ch("a:1", 1)]])
+    assert [c["address"] for c in merged] == ["a:1"]
+
+
+def test_join_response_merge_same_checksum_takes_first():
+    members = [ch("a:1", 1), ch("b:2", 2)]
+    responses = [
+        {"checksum": 42, "members": members},
+        {"checksum": 42, "members": [ch("a:1", 99)]},
+    ]
+    assert merge_join_responses("me:1", responses) is members
+
+
+def test_join_response_merge_mixed_checksums():
+    responses = [
+        {"checksum": 42, "members": [ch("a:1", 1)]},
+        {"checksum": 43, "members": [ch("a:1", 9), ch("b:2", 2)]},
+    ]
+    merged = merge_join_responses("me:1", responses)
+    by_addr = {c["address"]: c for c in merged}
+    assert by_addr["a:1"]["incarnationNumber"] == 9
+    assert merge_join_responses("me:1", []) == []
+
+
+def _joiner(bootstrap, host_port="10.0.0.1:3000", **opts):
+    rp = test_ringpop(host_port=host_port)
+    rp.bootstrap_hosts = bootstrap
+    rp.rng = random.Random(7)
+    return JoinCluster(rp, **opts)
+
+
+def test_group_selection_prefers_other_hosts():
+    """join-sender.js:165-183,478-484: nodes on other physical hosts first."""
+    bootstrap = ["10.0.0.1:3000", "10.0.0.1:3001", "10.0.0.2:3000", "10.0.0.3:3000"]
+    joiner = _joiner(bootstrap)
+    joiner.init([])
+    assert set(joiner.preferred_nodes) == {"10.0.0.2:3000", "10.0.0.3:3000"}
+    assert set(joiner.non_preferred_nodes) == {"10.0.0.1:3001"}
+    # join_size=3, parallelism 2 -> asks for 6, only 3 available
+    group = joiner.select_group([])
+    assert len(group) == 3
+    assert set(group[:2]) == set(joiner.preferred_nodes)
+
+
+def test_group_excludes_self_and_joined():
+    bootstrap = ["10.0.0.1:3000", "10.0.0.2:3000", "10.0.0.3:3000"]
+    joiner = _joiner(bootstrap)
+    assert "10.0.0.1:3000" not in joiner.potential_nodes
+    assert set(joiner.collect_potential_nodes(["10.0.0.2:3000"])) == {"10.0.0.3:3000"}
+
+
+def test_join_size_capped_by_cluster_size():
+    joiner = _joiner(["10.0.0.1:3000", "10.0.0.2:3000"], join_size=10)
+    assert joiner.join_size == 1

@@ -1,0 +1,113 @@
+"""Membership semantics (reference: test/membership-test.js)."""
+
+from ringpop_tpu.harness import test_ringpop
+from ringpop_tpu.member import Status
+from ringpop_tpu.ops.farmhash import farmhash32
+
+
+def test_checksum_format_parity():
+    """Checksum == farmhash32 of 'addr+status+inc' sorted, ';'-joined
+    (membership.js:41-93)."""
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    rp.membership.make_alive("10.0.0.2:3000", 1414142122275)
+    expected_str = rp.membership.generate_checksum_string()
+    assert farmhash32(expected_str) == rp.membership.checksum
+    # With the known two-member layout the string matches the documented
+    # format example (membership.js:42-53).
+    assert ";" in expected_str
+    assert "alive" in expected_str
+
+
+def test_checksum_changes_on_update_and_stable_otherwise():
+    rp = test_ringpop()
+    before = rp.membership.checksum
+    rp.membership.make_alive("127.0.0.1:3001", 1)
+    after = rp.membership.checksum
+    assert before != after
+    # Re-applying the same change is a no-op (no new incarnation).
+    rp.membership.make_alive("127.0.0.1:3001", 1)
+    assert rp.membership.checksum == after
+
+
+def test_checksum_order_independent():
+    rp1 = test_ringpop(host_port="127.0.0.1:3000", seed=1)
+    rp2 = test_ringpop(host_port="127.0.0.1:3000", seed=99)
+    for rp, order in ((rp1, [1, 2, 3]), (rp2, [3, 1, 2])):
+        for i in order:
+            rp.membership.make_alive(f"127.0.0.1:300{i}", 1000 + i)
+    assert rp1.membership.checksum == rp2.membership.checksum
+
+
+def test_update_precedence_applied_through_membership():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    addr = "10.0.0.2:3000"
+    rp.membership.make_alive(addr, 10)
+    member = rp.membership.find_member_by_address(addr)
+
+    # Same-incarnation suspect beats alive.
+    rp.membership.update({"address": addr, "status": Status.suspect, "incarnationNumber": 10})
+    assert member.status == Status.suspect
+    # Same-incarnation alive does NOT beat suspect.
+    rp.membership.update({"address": addr, "status": Status.alive, "incarnationNumber": 10})
+    assert member.status == Status.suspect
+    # Newer alive does.
+    rp.membership.update({"address": addr, "status": Status.alive, "incarnationNumber": 11})
+    assert member.status == Status.alive
+
+
+def test_local_suspect_rumor_triggers_refutation():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    local = rp.membership.local_member
+    original_inc = local.incarnation_number
+    applied = rp.membership.update(
+        {"address": "10.0.0.1:3000", "status": Status.suspect, "incarnationNumber": original_inc}
+    )
+    assert local.status == Status.alive
+    assert local.incarnation_number > original_inc
+    assert applied and applied[0]["status"] == Status.alive
+
+
+def test_stash_until_ready_and_atomic_set():
+    rp = test_ringpop(make_alive=False)
+    rp.is_ready = False
+    rp.membership.update(
+        [{"address": "127.0.0.1:3001", "status": Status.alive, "incarnationNumber": 1}]
+    )
+    rp.membership.update(
+        [{"address": "127.0.0.1:3001", "status": Status.alive, "incarnationNumber": 5},
+         {"address": "127.0.0.1:3002", "status": Status.alive, "incarnationNumber": 2}]
+    )
+    assert rp.membership.get_member_count() == 0  # stashed, not applied
+
+    rp.membership.set()
+    # Max-incarnation merge during set (membership.js:162-206).
+    assert rp.membership.get_member_count() == 2
+    assert rp.membership.find_member_by_address("127.0.0.1:3001").incarnation_number == 5
+    assert rp.membership.checksum is not None
+    # set() is once-only.
+    rp.membership.set()
+    assert rp.membership.get_member_count() == 2
+
+
+def test_pingable_excludes_self_faulty_leave():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    rp.membership.make_alive("10.0.0.2:3000", 1)
+    rp.membership.make_suspect("10.0.0.2:3000", 1)
+    rp.membership.make_alive("10.0.0.3:3000", 1)
+    rp.membership.make_faulty("10.0.0.3:3000", 1)
+    rp.membership.make_alive("10.0.0.4:3000", 1)
+
+    pingable = [m.address for m in rp.membership.members if rp.membership.is_pingable(m)]
+    assert "10.0.0.1:3000" not in pingable  # self
+    assert "10.0.0.2:3000" in pingable  # suspect is pingable
+    assert "10.0.0.3:3000" not in pingable  # faulty is not
+    assert "10.0.0.4:3000" in pingable
+
+
+def test_get_random_pingable_members_excludes():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    for i in range(2, 8):
+        rp.membership.make_alive(f"10.0.0.{i}:3000", 1)
+    sample = rp.membership.get_random_pingable_members(3, ["10.0.0.2:3000"])
+    assert len(sample) == 3
+    assert all(m.address != "10.0.0.2:3000" for m in sample)

@@ -1,0 +1,79 @@
+"""Gossip/suspicion unit tests with virtual time (reference: test/swim_test.js)."""
+
+from ringpop_tpu.harness import test_ringpop
+from ringpop_tpu.member import Status
+
+
+def test_gossip_start_stop_restart():
+    rp = test_ringpop()
+    assert rp.gossip.is_stopped
+    rp.gossip.start()
+    assert not rp.gossip.is_stopped
+    rp.gossip.start()  # no-op
+    rp.gossip.stop()
+    assert rp.gossip.is_stopped
+    rp.gossip.stop()  # no-op
+    rp.gossip.start()
+    assert not rp.gossip.is_stopped
+
+
+def test_suspicion_timeout_makes_faulty():
+    """Real-timeout faulty transition (swim_test.js:158-178), deterministic."""
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    rp.membership.make_alive("10.0.0.2:3000", 7)
+    rp.membership.make_suspect("10.0.0.2:3000", 7)
+    member = rp.membership.find_member_by_address("10.0.0.2:3000")
+    assert member.status == Status.suspect
+    assert "10.0.0.2:3000" in rp.suspicion.timers
+
+    rp.clock.advance(4999)
+    assert member.status == Status.suspect
+    rp.clock.advance(2)
+    assert member.status == Status.faulty
+
+
+def test_suspicion_cancelled_by_alive():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    rp.membership.make_alive("10.0.0.2:3000", 7)
+    rp.membership.make_suspect("10.0.0.2:3000", 7)
+    rp.membership.update(
+        {"address": "10.0.0.2:3000", "status": Status.alive, "incarnationNumber": 8}
+    )
+    assert "10.0.0.2:3000" not in rp.suspicion.timers
+    rp.clock.advance(10000)
+    member = rp.membership.find_member_by_address("10.0.0.2:3000")
+    assert member.status == Status.alive
+
+
+def test_suspicion_never_for_local_member():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    rp.suspicion.start(rp.membership.local_member)
+    assert "10.0.0.1:3000" not in rp.suspicion.timers
+
+
+def test_suspicion_stop_all_and_reenable():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    rp.membership.make_alive("10.0.0.2:3000", 7)
+    rp.suspicion.stop_all()
+    rp.membership.make_suspect("10.0.0.2:3000", 7)
+    assert "10.0.0.2:3000" not in rp.suspicion.timers  # gated
+    rp.suspicion.reenable()
+    rp.membership.make_suspect("10.0.0.2:3000", 8)
+    assert "10.0.0.2:3000" in rp.suspicion.timers
+
+
+def test_membership_iterator_visits_all_pingable():
+    """membership-iterator-test.js semantics."""
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    for i in range(2, 6):
+        rp.membership.make_alive(f"10.0.0.{i}:3000", 1)
+    seen = set()
+    for _ in range(4):
+        m = rp.member_iterator.next()
+        seen.add(m.address)
+    assert seen == {f"10.0.0.{i}:3000" for i in range(2, 6)}
+
+
+def test_membership_iterator_none_when_no_pingable():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    assert rp.member_iterator.next() is None

@@ -1,0 +1,61 @@
+"""Update-rule lattice tests (reference: membership precedence semantics in
+lib/membership-update-rules.js, exercised by test/membership-test.js)."""
+
+from ringpop_tpu.member import Member, Status
+from ringpop_tpu import update_rules as rules
+
+
+def member(status, inc=10):
+    return Member("10.0.0.1:3000", status, inc)
+
+
+def change(status, inc):
+    return {"status": status, "incarnationNumber": inc}
+
+
+def test_alive_override():
+    # Alive beats anything only with strictly newer incarnation (:25-29).
+    for status in Status.ALL:
+        assert rules.is_alive_override(member(status), change(Status.alive, 11))
+        assert not rules.is_alive_override(member(status), change(Status.alive, 10))
+        assert not rules.is_alive_override(member(status), change(Status.alive, 9))
+
+
+def test_suspect_override():
+    # suspect vs alive: >=; vs suspect/faulty: >; vs leave: never (:54-59).
+    assert rules.is_suspect_override(member(Status.alive), change(Status.suspect, 10))
+    assert rules.is_suspect_override(member(Status.alive), change(Status.suspect, 11))
+    assert not rules.is_suspect_override(member(Status.alive), change(Status.suspect, 9))
+    assert not rules.is_suspect_override(member(Status.suspect), change(Status.suspect, 10))
+    assert rules.is_suspect_override(member(Status.suspect), change(Status.suspect, 11))
+    assert not rules.is_suspect_override(member(Status.faulty), change(Status.suspect, 10))
+    assert rules.is_suspect_override(member(Status.faulty), change(Status.suspect, 11))
+    assert not rules.is_suspect_override(member(Status.leave), change(Status.suspect, 99))
+
+
+def test_faulty_override():
+    assert rules.is_faulty_override(member(Status.alive), change(Status.faulty, 10))
+    assert rules.is_faulty_override(member(Status.suspect), change(Status.faulty, 10))
+    assert not rules.is_faulty_override(member(Status.faulty), change(Status.faulty, 10))
+    assert rules.is_faulty_override(member(Status.faulty), change(Status.faulty, 11))
+    assert not rules.is_faulty_override(member(Status.leave), change(Status.faulty, 99))
+    assert not rules.is_faulty_override(member(Status.alive), change(Status.faulty, 9))
+
+
+def test_leave_override():
+    for status in (Status.alive, Status.suspect, Status.faulty):
+        assert rules.is_leave_override(member(status), change(Status.leave, 10))
+        assert not rules.is_leave_override(member(status), change(Status.leave, 9))
+    # leave never re-applied over leave, regardless of incarnation
+    assert not rules.is_leave_override(member(Status.leave), change(Status.leave, 99))
+
+
+def test_local_overrides():
+    local = "10.0.0.1:3000"
+    other = "10.0.0.9:3000"
+    m = member(Status.alive)
+    assert rules.is_local_suspect_override(local, m, change(Status.suspect, 1))
+    assert rules.is_local_faulty_override(local, m, change(Status.faulty, 1))
+    assert not rules.is_local_suspect_override(other, m, change(Status.suspect, 1))
+    assert not rules.is_local_faulty_override(other, m, change(Status.faulty, 1))
+    assert not rules.is_local_suspect_override(local, m, change(Status.faulty, 1))
