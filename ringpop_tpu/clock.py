@@ -109,22 +109,11 @@ class AsyncioScheduler:
         return time.time() * 1000.0
 
     def call_later(self, delay_ms: float, fn: Callable[[], Any]):
-        handle = self._loop.call_later(max(0.0, delay_ms) / 1000.0, fn)
-
-        class _H:
-            def cancel(self_inner) -> None:
-                handle.cancel()
-
-        return _H()
+        # asyncio handles already expose .cancel(), the only method used
+        return self._loop.call_later(max(0.0, delay_ms) / 1000.0, fn)
 
     def call_soon(self, fn: Callable[[], Any]):
-        handle = self._loop.call_soon(fn)
-
-        class _H:
-            def cancel(self_inner) -> None:
-                handle.cancel()
-
-        return _H()
+        return self._loop.call_soon(fn)
 
     def cancel(self, timer) -> None:
         if timer is not None:

@@ -1,0 +1,20 @@
+"""TPU simulation backends (the jax/XLA compute path of the framework).
+
+``swim_sim`` is the flagship model: the reference's SWIM membership +
+dissemination layers (lib/membership.js, lib/dissemination.js,
+lib/swim/*) as one jitted tick-synchronous kernel over dense N x N view
+tensors.  ``cluster.SimCluster`` is its host driver (the tick-cluster
+analog); ``checksum`` renders view rows into reference-format
+farmhash32 membership checksums for parity checks.
+"""
+
+from ringpop_tpu.models.swim_sim import (  # noqa: F401
+    ClusterState,
+    NetState,
+    SwimParams,
+    init_state,
+    make_net,
+    swim_run,
+    swim_step,
+)
+from ringpop_tpu.models.cluster import SimCluster  # noqa: F401

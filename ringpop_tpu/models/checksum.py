@@ -1,0 +1,106 @@
+"""Reference-format membership checksums for simulation view rows.
+
+The reference checksum (lib/membership.js:41-93) is farmhash32 of the
+member list sorted by address, each entry ``addr + status + incarnation``,
+entries joined by ';'.  The host library (membership.py) produces it per
+node; this module produces it for *simulation* state — node i's checksum
+is a function of row i of the view tensors — so sim convergence can be
+asserted bit-identical to the host library / reference.
+
+The hot path packs each requested row into the ``addr\\0status\\0inc\\0``
+layout consumed by the C extension's ``rp_membership_checksum``
+(ops/_farmhash.c), falling back to pure Python automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ringpop_tpu.models.swim_sim import NONE, STATUS_NAMES
+from ringpop_tpu.ops import farmhash
+
+
+def default_addresses(n: int, host: str = "127.0.0.1", base_port: int = 10000) -> list[str]:
+    """Address book matching the host harness (harness.py Cluster)."""
+    return [f"{host}:{base_port + i}" for i in range(n)]
+
+
+class AddressBook:
+    """Static per-simulation address table + the precomputed sort order.
+
+    Addresses never change during a simulation (dynamic membership is the
+    NONE status), so the checksum's sort-by-address (membership.js:70-93)
+    is a precomputed permutation.
+    """
+
+    def __init__(self, addresses: Sequence[str]):
+        self.addresses = list(addresses)
+        self.sorted_order = np.argsort(np.array(self.addresses, dtype=object), kind="stable")
+        self._addr_bytes = [a.encode() for a in self.addresses]
+        self.index = {a: i for i, a in enumerate(self.addresses)}
+
+    def __len__(self) -> int:
+        return len(self.addresses)
+
+
+_STATUS_BYTES = {code: name.encode() for code, name in STATUS_NAMES.items()}
+
+
+def row_checksum(
+    book: AddressBook,
+    row_status: np.ndarray,
+    row_inc: np.ndarray,
+    base_inc: int,
+) -> int:
+    """Reference checksum of one node's view row (uint32)."""
+    parts = []
+    count = 0
+    for j in book.sorted_order:
+        s = int(row_status[j])
+        if s == NONE:
+            continue
+        inc = base_inc + int(row_inc[j])
+        parts.append(b"%s\x00%s\x00%d\x00" % (book._addr_bytes[j], _STATUS_BYTES[s], inc))
+        count += 1
+    return farmhash.membership_checksum_packed(b"".join(parts), count)
+
+
+def view_checksums(
+    book: AddressBook,
+    view_status: np.ndarray,
+    view_inc: np.ndarray,
+    base_inc: int,
+    indices: Sequence[int] | None = None,
+) -> dict[int, int]:
+    """Checksums of the given (default: all) nodes' views."""
+    if indices is None:
+        indices = range(view_status.shape[0])
+    return {
+        int(i): row_checksum(book, view_status[i], view_inc[i], base_inc)
+        for i in indices
+    }
+
+
+def row_members(
+    book: AddressBook,
+    row_status: np.ndarray,
+    row_inc: np.ndarray,
+    base_inc: int,
+) -> list[dict]:
+    """A view row as the reference's member-list JSON (getStats dump,
+    membership.js:122-129: sorted by address)."""
+    out = []
+    for j in book.sorted_order:
+        s = int(row_status[j])
+        if s == NONE:
+            continue
+        out.append(
+            {
+                "address": book.addresses[j],
+                "status": STATUS_NAMES[s],
+                "incarnationNumber": base_inc + int(row_inc[j]),
+            }
+        )
+    return out

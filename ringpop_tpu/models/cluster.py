@@ -1,0 +1,207 @@
+"""SimCluster — the host-side driver of the TPU SWIM simulation.
+
+The simulation analog of the reference's tick-cluster harness
+(scripts/tick-cluster.js) and of this repo's host ``harness.Cluster``:
+drive protocol periods, group live nodes by membership checksum
+(tick-cluster.js:88-115 — the convergence metric), and inject faults —
+kill / suspend / revive (tick-cluster.js:418-471), partitions and packet
+loss (the netsplit testing the reference stubbed out in
+test/lib/partition-cluster.js:59-61) — as mask edits on ``NetState``.
+
+All protocol state lives on device; the driver only pulls rows back for
+reference-format checksums and stats.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.models import checksum as cksum
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
+
+DEFAULT_BASE_INC = 1_400_000_000_000  # host clock epoch (clock.SimScheduler)
+
+
+class SimCluster:
+    def __init__(
+        self,
+        n: int,
+        params: SwimParams = SwimParams(),
+        *,
+        seed: int = 0,
+        addresses: Sequence[str] | None = None,
+        base_inc: int = DEFAULT_BASE_INC,
+        inc: Sequence[int] | None = None,
+        init: str = "converged",
+        device: Any | None = None,
+    ):
+        self.params = params
+        self.book = cksum.AddressBook(addresses or cksum.default_addresses(n))
+        if len(self.book) != n:
+            raise ValueError("addresses must have length n")
+        self.base_inc = base_inc
+        rel = np.zeros(n, dtype=np.int32) if inc is None else (
+            np.asarray(inc, dtype=np.int64) - base_inc
+        ).astype(np.int32)
+        self.state: ClusterState = sim.init_state(n, jnp.asarray(rel), mode=init)
+        self.net: NetState = sim.make_net(n)
+        self.key = jax.random.PRNGKey(seed)
+        self.metrics_log: list[dict[str, int]] = []
+        if device is not None:
+            self.state = jax.device_put(self.state, device)
+            self.net = jax.device_put(self.net, device)
+
+    @property
+    def n(self) -> int:
+        return len(self.book)
+
+    # -- time ---------------------------------------------------------------
+
+    def _split(self) -> jax.Array:
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def tick(self, ticks: int = 1) -> dict[str, int]:
+        """Advance every node ``ticks`` protocol periods."""
+        if ticks == 1:
+            self.state, metrics = sim.swim_step(
+                self.state, self.net, self._split(), self.params
+            )
+        else:
+            self.state, metrics = sim.swim_run(
+                self.state, self.net, self._split(), self.params, ticks
+            )
+        out = {k: int(v) for k, v in metrics.items()}
+        self.metrics_log.append(out)
+        return out
+
+    def run_until_converged(self, max_ticks: int = 1000, check_every: int = 5) -> int:
+        """Ticks until convergence (or -1); the tick-cluster 't' loop."""
+        done = 0
+        while done < max_ticks:
+            step = min(check_every, max_ticks - done)
+            self.tick(step)
+            done += step
+            if self.converged():
+                return done
+        return -1
+
+    # -- convergence (tick-cluster.js:88-115) --------------------------------
+
+    def live_indices(self) -> np.ndarray:
+        up = np.asarray(self.net.up) & np.asarray(self.net.responsive)
+        own = np.asarray(jnp.diagonal(self.state.view_status))
+        gossiping = up & ((own == sim.ALIVE) | (own == sim.SUSPECT))
+        return np.flatnonzero(gossiping)
+
+    def converged(self) -> bool:
+        """Exact view agreement among live nodes (stronger than checksum
+        equality — no hash involved)."""
+        live = self.live_indices()
+        if len(live) <= 1:
+            return True
+        vs = self.state.view_status[jnp.asarray(live)]
+        vi = self.state.view_inc[jnp.asarray(live)]
+        same = jnp.all(vs == vs[0]) & jnp.all(vi == vi[0])
+        return bool(same)
+
+    def checksums(self, indices: Sequence[int] | None = None) -> dict[str, int]:
+        """Reference-format membership checksum per (live) node address."""
+        idx = self.live_indices() if indices is None else np.asarray(indices)
+        vs = np.asarray(self.state.view_status)
+        vi = np.asarray(self.state.view_inc)
+        sums = cksum.view_checksums(self.book, vs, vi, self.base_inc, idx)
+        return {self.book.addresses[i]: c for i, c in sums.items()}
+
+    def checksum_groups(self) -> dict[int, list[str]]:
+        groups: dict[int, list[str]] = {}
+        for addr, c in self.checksums().items():
+            groups.setdefault(c, []).append(addr)
+        return groups
+
+    def members(self, viewer: int) -> list[dict]:
+        """The viewer's member list, reference getStats shape."""
+        vs = np.asarray(self.state.view_status[viewer])
+        vi = np.asarray(self.state.view_inc[viewer])
+        return cksum.row_members(self.book, vs, vi, self.base_inc)
+
+    # -- lookup (ring derived from a node's view, lib/ring.js) ---------------
+
+    def ring_for(self, viewer: int) -> HashRing:
+        ring = HashRing()
+        # alive members are added and faulty/leave removed; suspects stay
+        # in the ring (membership-update-listener.js:34-45)
+        servers = [
+            m["address"]
+            for m in self.members(viewer)
+            if m["status"] in ("alive", "suspect")
+        ]
+        ring.add_remove_servers(servers, [])
+        return ring
+
+    def lookup(self, key: str, viewer: int = 0) -> str | None:
+        return self.ring_for(viewer).lookup(key)
+
+    # -- fault injection (tick-cluster.js:418-471; partitions via masks) -----
+
+    def kill(self, i: int) -> None:
+        self.net = self.net._replace(up=self.net.up.at[i].set(False))
+
+    def suspend(self, i: int) -> None:
+        self.net = self.net._replace(responsive=self.net.responsive.at[i].set(False))
+
+    def resume(self, i: int) -> None:
+        self.net = self.net._replace(responsive=self.net.responsive.at[i].set(True))
+
+    def revive(self, i: int, inc: int | None = None, seed: int | None = None) -> None:
+        """Restart a killed node as a fresh process and re-join it
+        (tick-cluster.js:418-430 -> admin-join-handler.js:47-51)."""
+        if inc is None:
+            inc = int(jnp.max(self.state.view_inc)) + 1000
+        else:
+            inc = inc - self.base_inc
+        self.state = sim.revive(self.state, i, inc)
+        self.net = self.net._replace(
+            up=self.net.up.at[i].set(True),
+            responsive=self.net.responsive.at[i].set(True),
+        )
+        if seed is None:
+            live = [j for j in self.live_indices() if j != i]
+            if not live:
+                return
+            seed = int(live[0])
+        self.join(i, seed)
+
+    def join(self, joiner: int, seed: int) -> None:
+        self.state = sim.admin_join(self.state, joiner, seed)
+
+    def leave(self, i: int) -> None:
+        self.state = sim.admin_leave(self.state, i)
+
+    def partition(self, groups: Sequence[Sequence[int]]) -> None:
+        """Disconnect the given groups from each other (block adjacency)."""
+        gid = np.full(self.n, -1, dtype=np.int32)
+        for g, members in enumerate(groups):
+            gid[np.asarray(members, dtype=np.int32)] = g
+        same = (gid[:, None] == gid[None, :]) | (gid[:, None] < 0) | (gid[None, :] < 0)
+        self.net = self.net._replace(adj=jnp.asarray(same))
+
+    def heal_partition(self) -> None:
+        self.net = self.net._replace(adj=jnp.ones((self.n, self.n), dtype=bool))
+
+    def set_loss(self, p: float) -> None:
+        self.params = self.params._replace(loss=float(p))
+
+    # -- stats ---------------------------------------------------------------
+
+    def status_counts(self, viewer: int) -> dict[str, int]:
+        vs = np.asarray(self.state.view_status[viewer])
+        return {
+            name: int((vs == code).sum()) for code, name in sim.STATUS_NAMES.items()
+        }

@@ -1,0 +1,716 @@
+"""TPU SWIM simulation backend: the membership + dissemination layers as
+vmapped epidemic-broadcast kernels over dense N x N view/state tensors.
+
+This is the tensorized re-design of the reference's L3+L4
+(lib/membership.js, lib/dissemination.js, lib/swim/*): instead of one
+process per node exchanging JSON change lists over TChannel, every virtual
+node's *view* of the cluster is one row of a dense tensor, and one jitted
+``swim_step`` advances every node through one protocol period
+simultaneously.  The "network" is a boolean delivery mask — packet loss,
+partitions and suspended processes are all mask edits (the fault-injection
+surface replacing tick-cluster.js signals).
+
+Semantics parity map (reference file:line -> here):
+
+* membership-update-rules.js:25-59  -> ``_lattice_key`` / ``_apply_mask``:
+  the incarnation-precedence lattice is a total-order key
+  ``inc * 8 + rank`` (rank: alive<suspect<faulty<leave) plus two masks for
+  the non-total corners (leave is only ever overridden by a
+  strictly-newer alive; membership.js first-sight takes any change).
+* membership.js:243-254             -> refutation: any suspect/faulty rumor
+  about self re-asserts alive with ``max(self_inc, rumor_inc) + 1``.
+* dissemination.js:125-177          -> per-(viewer, subject) piggyback
+  counts; a recorded change is issued while ``pb < max_piggyback``, where
+  ``max_piggyback = factor * ceil(log10(server_count + 1))``
+  (dissemination.js:38-55), and evicted past it.  A change's payload is
+  always the viewer's current (status, incarnation) for the subject — the
+  reference's change buffer is keyed by address and overwritten on every
+  applied update, so only (pb, source, source_inc) need separate storage.
+* dissemination.js:86-98            -> anti-echo: replies drop changes whose
+  (source, sourceIncarnation) equal the ping sender's identity.
+* dissemination.js:61-76,100-118    -> full sync: a receiver with nothing to
+  piggyback but a checksum mismatch answers with its entire view row.
+* swim/ping-sender.js, ping-handler -> phase 2/3/4 of ``swim_step``.
+* swim/ping-req-sender.js:153-296   -> phase 5: k random witnesses, two-hop
+  reachability, all-definite-failures => suspect.
+* swim/suspicion.js                 -> per-(viewer, subject) deadline ticks;
+  expiry declares faulty; alive stops the timer; re-suspect restarts it.
+* membership-iterator.js            -> probe-target selection; the reference
+  uses a reshuffled round-robin, the simulation samples uniformly among
+  pingable members (distributionally equivalent; documented deviation).
+
+Time model: one call to ``swim_step`` == one protocol period
+(gossip.js:127-129, 200 ms) for every node at once.  Wall-clock timeouts
+become tick counts (suspicion 5000 ms -> 25 ticks).  The reference's ping
+timeout (1500 ms) spans periods; the simulation compresses
+ping + ping-req + suspect-declaration into the probing tick.  Convergence
+measured in ticks maps to wall-clock via ``period_ms``.
+
+Documented intra-tick conventions (where the async reference has no
+defined order):
+
+* Concurrent inbound pings at one receiver are merged by the lattice's
+  total-order key (the reference applies them in arrival order; both end
+  at the lattice maximum except for contrived leave/suspect mixes).
+* A receiver's reply piggyback counter advances by the number of inbound
+  pings it served that tick, but all probers of the tick see the same
+  issued set.
+* The ping-req path probes reachability only; its piggyback exchange is
+  omitted (convergence-neutral, traffic-level deviation).
+
+Incarnation numbers are stored as int32 offsets from a host-side base
+(``SimCluster`` keeps the absolute int ms base) so all device arithmetic is
+x64-free; the lattice key needs ``inc * 8`` to fit int32, so relative
+incarnations must stay below 2**27 (~37 hours of ms).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+# Status encoding: lattice rank == code - 1 (alive < suspect < faulty < leave,
+# matching equal-incarnation precedence in membership-update-rules.js).
+NONE = 0
+ALIVE = 1
+SUSPECT = 2
+FAULTY = 3
+LEAVE = 4
+
+STATUS_NAMES = {ALIVE: "alive", SUSPECT: "suspect", FAULTY: "faulty", LEAVE: "leave"}
+
+_KEY_MIN = jnp.iinfo(jnp.int32).min
+
+
+class SwimParams(NamedTuple):
+    """Protocol constants (reference defaults cited per field)."""
+
+    period_ms: int = 200  # gossip.js:127-129 minProtocolPeriod
+    suspicion_ticks: int = 25  # suspicion.js:110-112 (5000 ms / period)
+    piggyback_factor: int = 15  # dissemination.js:133-136
+    ping_req_size: int = 3  # index.js:99
+    loss: float = 0.0  # iid per-message drop probability
+
+
+class ClusterState(NamedTuple):
+    """Per-(viewer i, subject j) membership views + dissemination buffers.
+
+    ``view_status[i, j]`` / ``view_inc[i, j]``: node i's belief about j
+    (membership.js member records, one row per node).  ``pb[i, j]`` is the
+    piggyback count of i's recorded change about j (-1: no change
+    recorded); ``src``/``src_inc`` are the change's originator
+    (dissemination.js change.source / sourceIncarnationNumber; -1 absent).
+    ``suspect_at[i, j]``: tick when i started suspecting j (-1: no timer)
+    — the tensor form of per-node Suspicion.timers (suspicion.js:27).
+    """
+
+    view_status: jax.Array  # int8[N, N]
+    view_inc: jax.Array  # int32[N, N]
+    pb: jax.Array  # int16[N, N]
+    src: jax.Array  # int32[N, N]
+    src_inc: jax.Array  # int32[N, N]
+    suspect_at: jax.Array  # int32[N, N]
+    tick: jax.Array  # int32[]
+
+    @property
+    def n(self) -> int:
+        return self.view_status.shape[0]
+
+
+class NetState(NamedTuple):
+    """The simulated network: the fault-injection surface.
+
+    ``up``: process exists (kill -> False).  ``responsive``: process
+    scheduled (SIGSTOP analog -> False; state is retained, the node just
+    neither probes nor answers — tick-cluster.js:432-446).  ``adj``:
+    directed connectivity; partitions are block masks.
+    """
+
+    up: jax.Array  # bool[N]
+    responsive: jax.Array  # bool[N]
+    adj: jax.Array  # bool[N, N]
+
+
+def make_net(n: int) -> NetState:
+    return NetState(
+        up=jnp.ones((n,), dtype=bool),
+        responsive=jnp.ones((n,), dtype=bool),
+        adj=jnp.ones((n, n), dtype=bool),
+    )
+
+
+def init_state(
+    n: int, inc: jax.Array | None = None, *, mode: str = "converged"
+) -> ClusterState:
+    """Fresh cluster state.
+
+    ``mode='converged'``: every node already knows every node alive (the
+    post-bootstrap fixture for churn/fault benchmarks).  ``mode='self'``:
+    each node knows only itself (pre-join; discover via ``admin_join``).
+    ``inc``: initial incarnation per node (relative ms), default 0.
+    """
+    if inc is None:
+        inc = jnp.zeros((n,), dtype=jnp.int32)
+    inc = jnp.asarray(inc, dtype=jnp.int32)
+    eye = jnp.eye(n, dtype=bool)
+    if mode == "converged":
+        status = jnp.full((n, n), ALIVE, dtype=jnp.int8)
+        view_inc = jnp.broadcast_to(inc[None, :], (n, n)).astype(jnp.int32)
+    elif mode == "self":
+        status = jnp.where(eye, ALIVE, NONE).astype(jnp.int8)
+        view_inc = jnp.where(eye, inc[None, :], 0).astype(jnp.int32)
+    else:
+        raise ValueError(f"unknown init mode: {mode}")
+    return ClusterState(
+        view_status=status,
+        view_inc=view_inc,
+        pb=jnp.full((n, n), -1, dtype=jnp.int16),
+        src=jnp.full((n, n), -1, dtype=jnp.int32),
+        src_inc=jnp.full((n, n), -1, dtype=jnp.int32),
+        suspect_at=jnp.full((n, n), -1, dtype=jnp.int32),
+        tick=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# lattice (membership-update-rules.js as uint arithmetic)
+# ---------------------------------------------------------------------------
+
+
+def _lattice_key(status: jax.Array, inc: jax.Array) -> jax.Array:
+    """Total-order key of a (status, incarnation) claim; NONE -> minimum.
+
+    ``inc * 8 + rank + 1`` realizes: alive overrides at strictly newer
+    incarnation; suspect/faulty/leave override lower ranks at equal
+    incarnation and anything at newer incarnation.  The two places the
+    real lattice is *not* this total order are handled by ``_apply_mask``.
+    """
+    key = inc.astype(jnp.int32) * 8 + status.astype(jnp.int32)
+    return jnp.where(status == NONE, _KEY_MIN, key)
+
+
+def _apply_mask(
+    cur_status: jax.Array,
+    cur_key: jax.Array,
+    in_status: jax.Array,
+    in_key: jax.Array,
+) -> jax.Array:
+    """Does the incoming claim override the current view entry?
+
+    key-greater, except: an existing ``leave`` entry is only overridden by
+    ``alive`` (is_leave/suspect/faulty_override exclude leave members —
+    membership-update-rules.js:31-42,54-59), while a first-sighted member
+    (cur NONE, key minimum) takes any change wholesale
+    (membership.js:230-247).
+    """
+    beats = in_key > cur_key
+    leave_guard = (cur_status == LEAVE) & (in_status != ALIVE)
+    return beats & ~leave_guard & (in_status != NONE)
+
+
+def _view_hash(state: ClusterState) -> jax.Array:
+    """Cheap commutative per-node view digest, uint32[N].
+
+    Stands in for the membership checksum *inside the protocol* (the
+    full-sync trigger needs only equality, dissemination.js:100-118).
+    Reported/parity checksums are the real farmhash over the reference's
+    string format — see models/checksum.py.
+    """
+    s = state.view_status.astype(jnp.uint32)
+    i = state.view_inc.astype(jnp.uint32)
+    h = (i ^ (s * jnp.uint32(0x9E3779B9))) * jnp.uint32(0x85EBCA6B)
+    h = (h ^ (h >> jnp.uint32(13))) * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    idx = jnp.arange(state.n, dtype=jnp.uint32) * jnp.uint32(0x27D4EB2F)
+    h = jnp.where(state.view_status != NONE, h ^ idx, jnp.uint32(0))
+    return jnp.sum(h, axis=1, dtype=jnp.uint32)
+
+
+def _max_piggyback(state: ClusterState, factor: int) -> jax.Array:
+    """``factor * ceil(log10(server_count + 1))`` per node, exactly
+    (dissemination.js:38-55); server count ~ members the node would have
+    in its ring (alive + suspect — suspects stay in the ring,
+    membership-update-listener.js:34-45)."""
+    sc = jnp.sum(
+        (state.view_status == ALIVE) | (state.view_status == SUSPECT),
+        axis=1,
+        dtype=jnp.int32,
+    )
+    x = sc + 1
+    digits = jnp.zeros_like(x)
+    p = jnp.int32(1)
+    for _ in range(10):
+        digits = digits + (x > p).astype(jnp.int32)
+        p = p * 10
+    return factor * digits
+
+
+def _pingable(state: ClusterState) -> jax.Array:
+    """pingable = alive|suspect and not self (membership.js:135-139)."""
+    ok = (state.view_status == ALIVE) | (state.view_status == SUSPECT)
+    eye = jnp.eye(state.n, dtype=bool)
+    return ok & ~eye
+
+
+def _choose_targets(pingable: jax.Array, key: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One probe target per node, uniform among its pingable members.
+
+    The reference walks a per-round shuffled round-robin
+    (membership-iterator.js:33-52); uniform sampling keeps the same
+    distribution over targets without N x N iterator state.
+    """
+    n = pingable.shape[0]
+    g = jax.random.gumbel(key, (n, n), dtype=jnp.float32)
+    score = jnp.where(pingable, g, -jnp.inf)
+    target = jnp.argmax(score, axis=1).astype(jnp.int32)
+    has = jnp.any(pingable, axis=1)
+    return jnp.where(has, target, -1), has
+
+
+def _choose_witnesses(
+    pingable: jax.Array, target: jax.Array, k: int, key: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """k distinct random pingable members excluding the probe target
+    (ping-req-sender.js:292-295 / membership.getRandomPingableMembers)."""
+    n = pingable.shape[0]
+    cols = jnp.arange(n, dtype=jnp.int32)
+    mask = pingable & (cols[None, :] != jnp.where(target < 0, n, target)[:, None])
+    g = jax.random.gumbel(key, (n, n), dtype=jnp.float32)
+    score = jnp.where(mask, g, -jnp.inf)
+    top = jax.lax.top_k(score, k)
+    valid = jnp.isfinite(top[0])
+    return top[1].astype(jnp.int32), valid
+
+
+def _drop(key: jax.Array, shape: tuple, loss: float) -> jax.Array:
+    """Per-message Bernoulli loss draw (True = dropped)."""
+    if loss <= 0.0:
+        return jnp.zeros(shape, dtype=bool)
+    return jax.random.uniform(key, shape) < loss
+
+
+class _Merge(NamedTuple):
+    """Result of applying a batch of incoming changes at each receiver."""
+
+    state: ClusterState
+    applied: jax.Array  # bool[N, N] — change applied (incl. refutations)
+    refuted: jax.Array  # bool[N] — receiver re-asserted itself alive
+
+
+def _merge_incoming(
+    state: ClusterState,
+    in_status: jax.Array,  # int8[N, N]: claim about j arriving at receiver r
+    in_inc: jax.Array,  # int32[N, N]
+    in_src: jax.Array,  # int32[N, N]
+    in_src_inc: jax.Array,  # int32[N, N]
+    active: jax.Array,  # bool[N]: receiver r processes input this tick
+) -> _Merge:
+    """Apply one batch of incoming changes at every receiver.
+
+    Implements membership.update's per-change evaluation
+    (membership.js:208-313) vectorized: first-sight wholesale, the
+    refutation fast-path for self rumors, then the override lattice.
+    Applied changes are recorded into the receiver's dissemination buffer
+    with piggyback count 0 (membership-update-listener.js:47 ->
+    dissemination.recordChange).
+    """
+    n = state.n
+    eye = jnp.eye(n, dtype=bool)
+
+    in_key = _lattice_key(in_status, in_inc)
+    cur_key = _lattice_key(state.view_status, state.view_inc)
+
+    # Refutation (membership.js:243-254): any suspect/faulty rumor about
+    # self — regardless of incarnation — re-asserts alive with an
+    # incarnation beating both the rumor and the current self view.
+    rumor_self = (
+        eye
+        & active[:, None]
+        & ((in_status == SUSPECT) | (in_status == FAULTY))
+        & (in_status != NONE)
+    )
+    refuted = jnp.any(rumor_self, axis=1)
+    self_inc = jnp.diagonal(state.view_inc)
+    rumor_inc = jnp.where(rumor_self, in_inc, _KEY_MIN).max(axis=1)
+    new_self_inc = jnp.maximum(self_inc, rumor_inc) + 1
+
+    apply = (
+        _apply_mask(state.view_status, cur_key, in_status, in_key)
+        & active[:, None]
+        & ~eye  # self entries only change via refutation / local ops
+    )
+
+    view_status = jnp.where(apply, in_status, state.view_status)
+    view_inc = jnp.where(apply, in_inc, state.view_inc)
+    src = jnp.where(apply, in_src, state.src)
+    src_inc = jnp.where(apply, in_src_inc, state.src_inc)
+    pb = jnp.where(apply, jnp.int16(0), state.pb)
+
+    # Refutation writes the diagonal and records a self-sourced alive change.
+    ids = jnp.arange(n, dtype=jnp.int32)
+    diag_status = jnp.where(refuted, ALIVE, jnp.diagonal(view_status)).astype(jnp.int8)
+    diag_inc = jnp.where(refuted, new_self_inc, jnp.diagonal(view_inc))
+    view_status = _set_diag(view_status, diag_status)
+    view_inc = _set_diag(view_inc, diag_inc)
+    src = _set_diag(src, jnp.where(refuted, ids, jnp.diagonal(src)))
+    src_inc = _set_diag(src_inc, jnp.where(refuted, new_self_inc, jnp.diagonal(src_inc)))
+    pb = _set_diag(pb, jnp.where(refuted, jnp.int16(0), jnp.diagonal(pb)))
+
+    applied = apply | (eye & refuted[:, None])
+
+    # Suspicion timers (suspicion.js:45-69 via update-listener:34-45):
+    # applied suspect (re)starts the deadline; applied alive stops it.
+    suspect_at = jnp.where(
+        applied & (view_status == SUSPECT), state.tick, state.suspect_at
+    )
+    suspect_at = jnp.where(applied & (view_status == ALIVE), -1, suspect_at)
+
+    return _Merge(
+        state._replace(
+            view_status=view_status,
+            view_inc=view_inc,
+            pb=pb,
+            src=src,
+            src_inc=src_inc,
+            suspect_at=suspect_at,
+        ),
+        applied,
+        refuted,
+    )
+
+
+def _set_diag(mat: jax.Array, d: jax.Array) -> jax.Array:
+    n = mat.shape[0]
+    ids = jnp.arange(n)
+    return mat.at[ids, ids].set(d.astype(mat.dtype))
+
+
+def _declare(
+    state: ClusterState,
+    viewer_mask: jax.Array,  # bool[N]
+    subject: jax.Array,  # int32[N] (index per viewer; clipped where invalid)
+    new_status: int,
+) -> ClusterState:
+    """Local declaration (makeSuspect / makeFaulty, membership.js:141-156):
+    viewer i re-labels ``subject[i]`` with its currently-known incarnation,
+    applying only where the lattice admits it, and records a self-sourced
+    change."""
+    n = state.n
+    ids = jnp.arange(n, dtype=jnp.int32)
+    subj = jnp.clip(subject, 0, n - 1)
+    cur_s = state.view_status[ids, subj]
+    cur_i = state.view_inc[ids, subj]
+    in_key = _lattice_key(jnp.full((n,), new_status, jnp.int8), cur_i)
+    cur_key = _lattice_key(cur_s, cur_i)
+    ok = (
+        viewer_mask
+        & (subj != ids)
+        & _apply_mask(cur_s, cur_key, jnp.full((n,), new_status, jnp.int8), in_key)
+    )
+    self_inc = jnp.diagonal(state.view_inc)
+    vs = state.view_status.at[ids, subj].set(
+        jnp.where(ok, jnp.int8(new_status), cur_s).astype(jnp.int8)
+    )
+    pb = state.pb.at[ids, subj].set(jnp.where(ok, jnp.int16(0), state.pb[ids, subj]))
+    src = state.src.at[ids, subj].set(jnp.where(ok, ids, state.src[ids, subj]))
+    src_inc = state.src_inc.at[ids, subj].set(
+        jnp.where(ok, self_inc, state.src_inc[ids, subj])
+    )
+    sus = state.suspect_at
+    if new_status == SUSPECT:
+        sus = sus.at[ids, subj].set(
+            jnp.where(ok, state.tick, sus[ids, subj]).astype(jnp.int32)
+        )
+    return state._replace(view_status=vs, pb=pb, src=src, src_inc=src_inc, suspect_at=sus)
+
+
+# ---------------------------------------------------------------------------
+# the protocol period
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def swim_step(
+    state: ClusterState, net: NetState, key: jax.Array, params: SwimParams
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """One synchronized protocol period for every virtual node.
+
+    Phases (intra-tick order convention, see module docstring):
+      1. probe-target selection          (membership-iterator.js)
+      2. sender piggyback issue          (dissemination.issueAsSender)
+      3. ping delivery + receiver merge  (ping-handler.js:34)
+      4. receiver reply (+ full sync) + sender merge  (ping-handler.js:36-39)
+      5. failed probes -> ping-req two-hop -> suspect  (ping-req-sender.js)
+      6. suspicion deadlines -> faulty   (suspicion.js:66-69)
+    """
+    n = state.n
+    k_target, k_loss1, k_loss2, k_wit, k_loss3 = jax.random.split(key, 5)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    maxpb = _max_piggyback(state, params.piggyback_factor)  # int32[N]
+    h_pre = _view_hash(state)  # sender checksum claim in the ping body
+    self_inc0 = jnp.diagonal(state.view_inc)  # sender identity claim
+
+    # -- phase 1: who probes whom ------------------------------------------
+    own_status = jnp.diagonal(state.view_status)
+    gossiping = (
+        net.up & net.responsive & ((own_status == ALIVE) | (own_status == SUSPECT))
+    )
+    target, has_target = _choose_targets(_pingable(state), k_target)
+    sends = gossiping & has_target
+    t_safe = jnp.where(sends, target, 0)
+
+    # -- phase 2: sender issues its active changes -------------------------
+    has_change = state.pb >= 0
+    pb_next = jnp.where(has_change & sends[:, None], state.pb + 1, state.pb)
+    issued_s = has_change & sends[:, None] & (pb_next <= maxpb[:, None].astype(jnp.int16))
+    # eviction past the budget, only on issue attempts (dissemination.js:
+    # 147-151; counted even if the packet is then lost in the network)
+    pb_next = jnp.where(
+        sends[:, None] & (pb_next > maxpb[:, None].astype(jnp.int16)),
+        jnp.int16(-1),
+        pb_next,
+    )
+    state = state._replace(pb=pb_next)
+
+    # -- phase 3: delivery + receiver-side merge ---------------------------
+    resp = net.up & net.responsive
+    fwd_ok = (
+        sends
+        & net.adj[ids, t_safe]
+        & ~_drop(k_loss1, (n,), params.loss)
+        & resp[t_safe]
+    )
+    # scatter-max incoming claims into receiver rows; ties share the key,
+    # payload (src, src_inc) resolved by two more masked scatter-maxes.
+    key_out = jnp.where(
+        issued_s & fwd_ok[:, None],
+        _lattice_key(state.view_status, state.view_inc),
+        _KEY_MIN,
+    )
+    best = jnp.full((n, n), _KEY_MIN, dtype=jnp.int32).at[t_safe].max(key_out)
+    winner = (key_out > _KEY_MIN) & (key_out == best[t_safe])
+    best_src = (
+        jnp.full((n, n), -1, dtype=jnp.int32)
+        .at[t_safe]
+        .max(jnp.where(winner, state.src, -1))
+    )
+    src_winner = winner & (state.src == best_src[t_safe])
+    best_src_inc = (
+        jnp.full((n, n), -1, dtype=jnp.int32)
+        .at[t_safe]
+        .max(jnp.where(src_winner, state.src_inc, -1))
+    )
+    in_exists = best > _KEY_MIN
+    in_status = jnp.where(in_exists, (best % 8).astype(jnp.int8), jnp.int8(NONE))
+    in_inc = jnp.where(in_exists, best // 8, 0).astype(jnp.int32)
+    inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(fwd_ok.astype(jnp.int32))
+    got_ping = inbound > 0
+
+    merged = _merge_incoming(state, in_status, in_inc, best_src, best_src_inc, got_ping)
+    state = merged.state
+    ping_applied = jnp.sum(merged.applied, dtype=jnp.int32)
+
+    # -- phase 4: receiver replies; sender merges the ack ------------------
+    maxpb2 = _max_piggyback(state, params.piggyback_factor)
+    has_change2 = state.pb >= 0
+    # issue-as-receiver: one issued set per tick; counter advances by the
+    # number of pings served (documented tick-model convention).
+    rep_issuable = has_change2 & got_ping[:, None] & (
+        (state.pb + 1).astype(jnp.int32) <= maxpb2[:, None]
+    )
+    pb_after = jnp.where(
+        has_change2 & got_ping[:, None],
+        state.pb + inbound[:, None].astype(jnp.int16),
+        state.pb,
+    )
+    pb_after = jnp.where(
+        got_ping[:, None] & (pb_after.astype(jnp.int32) > maxpb2[:, None]),
+        jnp.int16(-1),
+        pb_after,
+    )
+    state = state._replace(pb=pb_after)
+
+    h_post = _view_hash(state)
+    # per-(sender i, receiver t) view of the reply: anti-echo filters
+    # changes i itself originated (dissemination.js:86-98)
+    rep_row = rep_issuable[t_safe]  # bool[N(sender), N(subject)]
+    echo = (state.src[t_safe] == ids[:, None]) & (
+        state.src_inc[t_safe] == self_inc0[:, None]
+    )
+    rep_row = rep_row & ~echo
+    # full sync (dissemination.js:100-118): nothing to say but checksums
+    # disagree -> entire view row, self-sourced, no source incarnation
+    full_sync = (
+        fwd_ok & ~jnp.any(rep_row, axis=1) & (h_post[t_safe] != h_pre)
+    )
+    exists_row = state.view_status[t_safe] != NONE
+    send_row = jnp.where(full_sync[:, None], exists_row, rep_row)
+
+    bwd_ok = fwd_ok & net.adj[t_safe, ids] & ~_drop(k_loss2, (n,), params.loss)
+    ack = bwd_ok
+
+    in2_mask = send_row & ack[:, None]
+    in2_status = jnp.where(in2_mask, state.view_status[t_safe], jnp.int8(NONE))
+    in2_inc = jnp.where(in2_mask, state.view_inc[t_safe], 0)
+    in2_src = jnp.where(
+        in2_mask,
+        jnp.where(full_sync[:, None], t_safe[:, None], state.src[t_safe]),
+        -1,
+    )
+    in2_src_inc = jnp.where(
+        in2_mask,
+        jnp.where(full_sync[:, None], -1, state.src_inc[t_safe]),
+        -1,
+    )
+    merged2 = _merge_incoming(state, in2_status, in2_inc, in2_src, in2_src_inc, ack)
+    state = merged2.state
+    ack_applied = jnp.sum(merged2.applied, dtype=jnp.int32)
+
+    # -- phase 5: ping-req for failed probes (ping-req-sender.js) ----------
+    failed = sends & ~ack
+    wit, wit_valid = _choose_witnesses(_pingable(state), target, params.ping_req_size, k_wit)
+    k_a, k_b, k_c, k_d = jax.random.split(k_loss3, 4)
+    kshape = (n, params.ping_req_size)
+    wit_safe = jnp.clip(wit, 0, n - 1)
+    req_ok = (
+        failed[:, None]
+        & wit_valid
+        & net.adj[ids[:, None], wit_safe]
+        & ~_drop(k_a, kshape, params.loss)
+        & resp[wit_safe]
+    )
+    wt_ok = (
+        req_ok
+        & net.adj[wit_safe, t_safe[:, None]]
+        & ~_drop(k_b, kshape, params.loss)
+        & resp[t_safe][:, None]
+        & net.adj[t_safe[:, None], wit_safe]
+        & ~_drop(k_c, kshape, params.loss)
+    )
+    relay_ok = net.adj[wit_safe, ids[:, None]] & ~_drop(k_d, kshape, params.loss)
+    any_success = jnp.any(wt_ok & relay_ok, axis=1)
+    # all witnesses answered "target unreachable" and none succeeded ->
+    # suspect (ping-req-sender.js:238-267); no witness response at all is
+    # inconclusive (:268-282)
+    definite_fail = jnp.any(req_ok & ~wt_ok & relay_ok, axis=1)
+    declare_suspect = failed & ~any_success & definite_fail
+    state = _declare(state, declare_suspect, t_safe, SUSPECT)
+
+    # -- phase 6: suspicion deadlines fire -> faulty (suspicion.js:66-69) --
+    expired = (
+        (state.suspect_at >= 0)
+        & (state.tick - state.suspect_at >= params.suspicion_ticks)
+        & (state.view_status == SUSPECT)
+        & gossiping[:, None]  # a stopped/dead process fires no timers
+    )
+    vs = jnp.where(expired, jnp.int8(FAULTY), state.view_status)
+    pb = jnp.where(expired, jnp.int16(0), state.pb)
+    src = jnp.where(expired, ids[:, None], state.src)
+    src_inc = jnp.where(expired, jnp.diagonal(state.view_inc)[:, None], state.src_inc)
+    sus = jnp.where(expired, -1, state.suspect_at)
+    state = state._replace(
+        view_status=vs, pb=pb, src=src, src_inc=src_inc, suspect_at=sus
+    )
+
+    state = state._replace(tick=state.tick + 1)
+    metrics = {
+        "pings_sent": jnp.sum(sends, dtype=jnp.int32),
+        "acks": jnp.sum(ack, dtype=jnp.int32),
+        "ping_changes_applied": ping_applied,
+        "ack_changes_applied": ack_applied,
+        "full_syncs": jnp.sum(full_sync, dtype=jnp.int32),
+        "ping_reqs": jnp.sum(failed, dtype=jnp.int32),
+        "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
+        "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
+    }
+    return state, metrics
+
+
+@functools.partial(jax.jit, static_argnames=("params", "ticks"))
+def swim_run(
+    state: ClusterState, net: NetState, key: jax.Array, params: SwimParams, ticks: int
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """``ticks`` protocol periods under lax.scan (one compiled program)."""
+
+    def body(carry, subkey):
+        st, _ = carry
+        st, m = swim_step(st, net, subkey, params)
+        return (st, m), None
+
+    keys = jax.random.split(key, ticks)
+    st0, m0 = swim_step(state, net, keys[0], params)
+    (state, metrics), _ = jax.lax.scan(body, (st0, m0), keys[1:])
+    return state, metrics
+
+
+# ---------------------------------------------------------------------------
+# host-side membership ops (join / leave / revive — the admin surface)
+# ---------------------------------------------------------------------------
+
+
+def admin_join(state: ClusterState, joiner: int, seed: int) -> ClusterState:
+    """Bootstrap join against a seed (join-sender.js + join-handler.js):
+    the seed marks the joiner alive and answers with a full membership
+    sync; the joiner adopts it wholesale and both record the changes."""
+    vs, vi = state.view_status, state.view_inc
+    j_inc = vi[joiner, joiner]
+    j_status = vs[joiner, joiner]
+
+    # seed: makeAlive(joiner) (join-handler.js:90)
+    cur_key = _lattice_key(vs[seed, joiner], vi[seed, joiner])
+    in_key = _lattice_key(jnp.int8(ALIVE), j_inc)
+    ok = _apply_mask(vs[seed, joiner], cur_key, jnp.int8(ALIVE), in_key)
+    vs = vs.at[seed, joiner].set(jnp.where(ok, ALIVE, vs[seed, joiner]).astype(jnp.int8))
+    vi = vi.at[seed, joiner].set(jnp.where(ok, j_inc, vi[seed, joiner]))
+    pb = state.pb.at[seed, joiner].set(
+        jnp.where(ok, 0, state.pb[seed, joiner]).astype(jnp.int16)
+    )
+    src = state.src.at[seed, joiner].set(jnp.where(ok, seed, state.src[seed, joiner]))
+    src_inc = state.src_inc.at[seed, joiner].set(
+        jnp.where(ok, vi[seed, seed], state.src_inc[seed, joiner])
+    )
+
+    # joiner: adopt the seed's row (full sync), keep own self entry, and
+    # record everything learned (membership-set-listener.js:33-47)
+    row_s = vs[seed]
+    row_i = vi[seed]
+    learned = (row_s != NONE) & (jnp.arange(state.n) != joiner)
+    vs = vs.at[joiner].set(jnp.where(learned, row_s, vs[joiner]).astype(jnp.int8))
+    vi = vi.at[joiner].set(jnp.where(learned, row_i, vi[joiner]))
+    vs = vs.at[joiner, joiner].set(jnp.where(j_status == NONE, ALIVE, j_status).astype(jnp.int8))
+    pb = pb.at[joiner].set(jnp.where(learned, 0, pb[joiner]).astype(jnp.int16))
+    src = src.at[joiner].set(jnp.where(learned, seed, src[joiner]))
+    src_inc = src_inc.at[joiner].set(jnp.where(learned, row_i[seed], src_inc[joiner]))
+    return state._replace(view_status=vs, view_inc=vi, pb=pb, src=src, src_inc=src_inc)
+
+
+def admin_leave(state: ClusterState, node: int) -> ClusterState:
+    """makeLeave(self) (admin-leave-handler.js:48-52): the node marks
+    itself leave (stopping its gossip via the own-status gate) and records
+    the change for dissemination by peers that ping it."""
+    vs = state.view_status.at[node, node].set(LEAVE)
+    pb = state.pb.at[node, node].set(0)
+    src = state.src.at[node, node].set(node)
+    src_inc = state.src_inc.at[node, node].set(state.view_inc[node, node])
+    return state._replace(view_status=vs, pb=pb, src=src, src_inc=src_inc)
+
+
+def revive(state: ClusterState, node: int, inc: int) -> ClusterState:
+    """A killed process restarts fresh (tick-cluster.js:418-430): wipe its
+    row to self-only with a new (higher) incarnation; re-entry to the
+    cluster is an ``admin_join``."""
+    n = state.n
+    row = jnp.where(jnp.arange(n) == node, ALIVE, NONE).astype(jnp.int8)
+    inc_row = jnp.where(jnp.arange(n) == node, jnp.int32(inc), 0)
+    return state._replace(
+        view_status=state.view_status.at[node].set(row),
+        view_inc=state.view_inc.at[node].set(inc_row),
+        pb=state.pb.at[node].set(-1),
+        src=state.src.at[node].set(-1),
+        src_inc=state.src_inc.at[node].set(-1),
+        suspect_at=state.suspect_at.at[node].set(-1),
+    )

@@ -136,9 +136,12 @@ class RingPop(EventEmitter):
         )
         create_event_forwarder(self)
 
-        self.client_rate = Meter()
-        self.server_rate = Meter()
-        self.total_rate = Meter()
+        # rates tick on the injected clock so virtual-time runs stay
+        # deterministic (Meter defaults to wall time otherwise)
+        now_s = lambda: self.clock.now() / 1000.0  # noqa: E731
+        self.client_rate = Meter(now_fn=now_s)
+        self.server_rate = Meter(now_fn=now_s)
+        self.total_rate = Meter(now_fn=now_s)
 
         # 10.30.8.26:20600 -> 10_30_8_26_20600 (index.js:141-145)
         self.stat_host_port = self.host_port.replace(".", "_").replace(":", "_")

@@ -1,0 +1,86 @@
+"""Cross-backend parity: the TPU simulation must produce membership
+checksums bit-identical to the host library (and therefore to the
+reference's farmhash32 format, lib/membership.js:41-93) for the same
+cluster history.  This is BASELINE.json's north-star invariant and the
+"minimum end-to-end slice" of SURVEY §7.
+"""
+
+import numpy as np
+
+from ringpop_tpu.harness import Cluster
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import SwimParams
+
+
+def _host_cluster_converged(size: int):
+    cluster = Cluster(size=size)
+    cluster.bootstrap_all()
+    assert cluster.run_until_converged(), "host cluster failed to converge"
+    return cluster
+
+
+def test_bootstrap_checksum_parity_5_nodes():
+    host = _host_cluster_converged(5)
+    host_sums = set(host.checksums().values())
+    assert len(host_sums) == 1
+    members = host.nodes[0].membership.get_stats()["members"]
+
+    # adopt the host cluster's exact member list (addresses + incarnations)
+    simc = SimCluster(
+        5,
+        addresses=[m["address"] for m in members],
+        base_inc=min(m["incarnationNumber"] for m in members),
+        inc=[m["incarnationNumber"] for m in members],
+        init="converged",
+    )
+    sim_sums = set(simc.checksums().values())
+    assert sim_sums == host_sums
+    host.destroy_all()
+
+
+def test_faulty_transition_checksum_parity():
+    # Kill one node in both backends; after convergence both must agree
+    # on the same member list (dead node faulty at its old incarnation)
+    # and therefore the same checksum.
+    host = _host_cluster_converged(4)
+    members = host.nodes[0].membership.get_stats()["members"]
+    victim_addr = host.host_ports[2]
+
+    simc = SimCluster(
+        4,
+        SwimParams(suspicion_ticks=25),
+        addresses=[m["address"] for m in members],
+        base_inc=min(m["incarnationNumber"] for m in members),
+        inc=[m["incarnationNumber"] for m in members],
+        init="converged",
+    )
+    assert set(simc.checksums().values()) == set(host.checksums().values())
+
+    host.kill(2)
+    host.run(60000)
+    assert host.run_until_converged(), "host did not reconverge after kill"
+    host_sums = set(host.checksums().values())
+    assert len(host_sums) == 1
+
+    victim_idx = simc.book.index[victim_addr]
+    simc.kill(victim_idx)
+    simc.tick(3 * 25)
+    assert simc.run_until_converged(600) > 0
+    sim_sums = set(simc.checksums().values())
+
+    assert sim_sums == host_sums
+    host.destroy_all()
+
+
+def test_member_list_shape_matches_host():
+    host = _host_cluster_converged(3)
+    members = host.nodes[0].membership.get_stats()["members"]
+    simc = SimCluster(
+        3,
+        addresses=[m["address"] for m in members],
+        base_inc=min(m["incarnationNumber"] for m in members),
+        inc=[m["incarnationNumber"] for m in members],
+    )
+    assert simc.members(0) == members
+    host.destroy_all()
