@@ -1,0 +1,79 @@
+"""Headline benchmark: virtual-node SWIM protocol rounds simulated per second.
+
+Simulates a BASELINE config-3-class cluster (10k nodes, 1% packet loss) on
+one chip and measures protocol rounds (node-ticks) per wall-clock second.
+
+``vs_baseline``: the reference executes the protocol in real time — every
+node runs 5 protocol periods per second (200 ms minProtocolPeriod,
+lib/swim/gossip.js:127-129), so a tick-cluster of N real processes
+advances 5*N node-rounds per second. ``vs_baseline`` is the speedup of
+the TPU simulation over that real-time rate at equal N (i.e. how many
+seconds of real-cluster protocol time one TPU-second simulates).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+from ringpop_tpu.models import swim_sim as sim
+
+REFERENCE_ROUNDS_PER_NODE_SEC = 5.0  # 200 ms protocol period
+TICKS_PER_CALL = 20
+REPEATS = 3
+
+
+def bench_once(n: int) -> float:
+    """Node-rounds/sec of an n-node simulation (best of REPEATS)."""
+    params = sim.SwimParams(loss=0.01)
+    key = jax.random.PRNGKey(0)
+    state = sim.init_state(n)
+    net = sim.make_net(n)
+    # Compile + warm up (state is donated; keep the chain alive).
+    key, sub = jax.random.split(key)
+    state, _ = sim.swim_run(state, net, sub, params, TICKS_PER_CALL)
+    jax.block_until_ready(state)
+    best = 0.0
+    for _ in range(REPEATS):
+        key, sub = jax.random.split(key)
+        t0 = time.perf_counter()
+        state, metrics = sim.swim_run(state, net, sub, params, TICKS_PER_CALL)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        best = max(best, TICKS_PER_CALL * n / dt)
+    return best
+
+
+def main() -> None:
+    last_err = None
+    for n in (10240, 8192, 4096, 2048, 1024):
+        try:
+            value = bench_once(n)
+        except Exception as e:  # OOM on smaller chips: shrink the cluster
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg.lower():
+                raise
+            last_err = e
+            continue
+        baseline = REFERENCE_ROUNDS_PER_NODE_SEC * n
+        print(
+            json.dumps(
+                {
+                    "metric": f"swim_sim_node_rounds_per_sec_n{n}",
+                    "value": round(value, 1),
+                    "unit": "node-rounds/s",
+                    "vs_baseline": round(value / baseline, 2),
+                }
+            )
+        )
+        return
+    raise SystemExit(f"benchmark failed at every size: {last_err}") from last_err
+
+
+if __name__ == "__main__":
+    main()

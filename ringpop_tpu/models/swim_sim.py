@@ -66,7 +66,6 @@ incarnations must stay below 2**27 (~37 hours of ms).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -433,8 +432,7 @@ def _declare(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def swim_step(
+def swim_step_impl(
     state: ClusterState, net: NetState, key: jax.Array, params: SwimParams
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """One synchronized protocol period for every virtual node.
@@ -630,21 +628,27 @@ def swim_step(
     return state, metrics
 
 
-@functools.partial(jax.jit, static_argnames=("params", "ticks"))
-def swim_run(
+def swim_run_impl(
     state: ClusterState, net: NetState, key: jax.Array, params: SwimParams, ticks: int
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """``ticks`` protocol periods under lax.scan (one compiled program)."""
 
     def body(carry, subkey):
         st, _ = carry
-        st, m = swim_step(st, net, subkey, params)
+        st, m = swim_step_impl(st, net, subkey, params)
         return (st, m), None
 
     keys = jax.random.split(key, ticks)
-    st0, m0 = swim_step(state, net, keys[0], params)
+    st0, m0 = swim_step_impl(state, net, keys[0], params)
     (state, metrics), _ = jax.lax.scan(body, (st0, m0), keys[1:])
     return state, metrics
+
+
+# Jitted entry points; ``state`` is donated so long scans run in-place in HBM.
+swim_step = jax.jit(swim_step_impl, static_argnames=("params",), donate_argnums=(0,))
+swim_run = jax.jit(
+    swim_run_impl, static_argnames=("params", "ticks"), donate_argnums=(0,)
+)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,25 @@
+"""Multi-chip scale-out for the SWIM simulation (jax.sharding over a Mesh).
+
+The reference scales by adding processes connected over TChannel
+(docs/architecture_design.md:87-105); the TPU build scales by sharding the
+N and N x N state tensors across a device mesh and letting XLA place the
+cross-chip exchanges on ICI — see ``ringpop_tpu.parallel.mesh``.
+"""
+
+from ringpop_tpu.parallel.mesh import (
+    make_mesh,
+    net_sharding,
+    shard_cluster,
+    sharded_step,
+    sharded_run,
+    state_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "net_sharding",
+    "shard_cluster",
+    "sharded_step",
+    "sharded_run",
+    "state_sharding",
+]

@@ -15,3 +15,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment may pre-register a TPU plugin and pin jax_platforms via
+# sitecustomize, overriding the env var — force CPU at the config level too
+# (before any backend initializes).
+import jax
+
+jax.config.update("jax_platforms", "cpu")

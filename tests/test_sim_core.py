@@ -166,14 +166,15 @@ def test_metrics_shape():
 def test_swim_run_scan_matches_steps():
     # swim_run (lax.scan) and repeated swim_step agree given same keys.
     params = SwimParams(suspicion_ticks=5)
-    st = sim.init_state(8)
     net = sim.make_net(8)
     key = jax.random.PRNGKey(0)
     keys = jax.random.split(key, 4)
-    st_a = st
+    # swim_step/swim_run donate their state argument, so each chain needs
+    # its own freshly materialized state.
+    st_a = sim.init_state(8)
     for k in keys:
         st_a, _ = sim.swim_step(st_a, net, k, params)
-    st_b = st
+    st_b = sim.init_state(8)
     st_b, _ = sim.swim_step(st_b, net, keys[0], params)
     st_b, _ = sim.swim_run(st_b, net, key, params, 3)  # differing keys ok:
     # only assert structural invariants, not equality of random streams
