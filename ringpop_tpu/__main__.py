@@ -1,0 +1,38 @@
+"""``python -m ringpop_tpu`` — CLI dispatcher.
+
+Subcommands (reference §2.2: main.js, scripts/tick-cluster.js,
+scripts/generate-hosts.js):
+
+  worker          run one node (main.js parity)
+  tick-cluster    multi-node harness & fault injector
+  generate-hosts  write a hosts.json
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    command = argv[0] if argv else None
+    rest = argv[1:]
+    if command == "worker":
+        from ringpop_tpu.cli.main import main as worker_main
+
+        worker_main(rest)
+    elif command == "tick-cluster":
+        from ringpop_tpu.cli.tick_cluster import main as tick_main
+
+        tick_main(rest)
+    elif command == "generate-hosts":
+        from ringpop_tpu.cli.generate_hosts import main as hosts_main
+
+        hosts_main(rest)
+    else:
+        print(__doc__)
+        sys.exit(0 if command in (None, "-h", "--help") else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""CLI & tooling (reference: main.js, scripts/tick-cluster.js,
+scripts/generate-hosts.js — SURVEY §2.2).
+
+* ``python -m ringpop_tpu worker --listen H:P --hosts hosts.json`` — one
+  real node over the TCP transport (main.js parity).
+* ``python -m ringpop_tpu tick-cluster -n 5`` — multi-process cluster
+  harness + fault injector (tick-cluster.js parity), with a ``--sim``
+  mode that drives the in-process deterministic cluster instead.
+* ``python -m ringpop_tpu generate-hosts`` — hosts.json generator.
+"""

@@ -1,0 +1,113 @@
+"""``worker`` subcommand: run one real ringpop node over TCP.
+
+Reference: main.js — builds a channel, constructs RingPop, listens,
+bootstraps from a hosts file (main.js:24-61).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import Any
+
+
+class StdoutLogger:
+    """Line-per-event JSON logger (the reference injects winston here)."""
+
+    def __init__(self, name: str, level: str = "info"):
+        self.name = name
+        self.level = level
+        self._levels = {"trace": 0, "debug": 1, "info": 2, "warn": 3, "error": 4}
+
+    def _log(self, level: str, msg: str, extra: Any = None) -> None:
+        if self._levels[level] < self._levels.get(self.level, 2):
+            return
+        record = {"ts": round(time.time(), 3), "name": self.name, "level": level, "msg": msg}
+        if extra is not None:
+            record["extra"] = extra
+        try:
+            print(json.dumps(record), flush=True)
+        except (TypeError, ValueError):
+            print(json.dumps({**record, "extra": repr(extra)}), flush=True)
+
+    def trace(self, msg: str, extra: Any = None) -> None:
+        self._log("trace", msg, extra)
+
+    def debug(self, msg: str, extra: Any = None) -> None:
+        self._log("debug", msg, extra)
+
+    def info(self, msg: str, extra: Any = None) -> None:
+        self._log("info", msg, extra)
+
+    def warn(self, msg: str, extra: Any = None) -> None:
+        self._log("warn", msg, extra)
+
+    def error(self, msg: str, extra: Any = None) -> None:
+        self._log("error", msg, extra)
+
+
+def add_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--listen", "-l", required=True, metavar="HOST:PORT",
+        help="address to listen on (main.js --listen)",
+    )
+    parser.add_argument(
+        "--hosts", "-f", default="./hosts.json", metavar="FILE",
+        help="bootstrap hosts json file (main.js --hosts)",
+    )
+    parser.add_argument("--app", default="ringpop", help="app/service name")
+    parser.add_argument("--log-level", default="info",
+                        choices=["trace", "debug", "info", "warn", "error"])
+
+
+async def run_node(args: argparse.Namespace) -> None:
+    from ringpop_tpu.clock import AsyncioScheduler
+    from ringpop_tpu.ringpop import RingPop
+    from ringpop_tpu.transport.tcp import TcpChannel
+
+    loop = asyncio.get_event_loop()
+    logger = StdoutLogger(args.listen, level=args.log_level)
+    channel = TcpChannel(args.listen, loop)
+    ringpop = RingPop(
+        app=args.app,
+        host_port=args.listen,
+        channel=channel,
+        clock=AsyncioScheduler(loop),
+        logger=logger,
+    )
+    ringpop.setup_channel()
+    await channel.listen()
+    logger.info("ringpop listening", {"address": args.listen})
+
+    done: asyncio.Future = loop.create_future()
+
+    def on_bootstrap(err: Any, nodes_joined: Any = None) -> None:
+        if err:
+            logger.error("bootstrap failed", {"error": str(err)})
+            if not done.done():
+                done.set_exception(SystemExit(1))
+            return
+        logger.info("ringpop ready", {"nodesJoined": nodes_joined})
+
+    ringpop.bootstrap(args.hosts, on_bootstrap)
+    try:
+        await done  # runs forever unless bootstrap hard-fails
+    finally:
+        ringpop.destroy()
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="ringpop-tpu worker")
+    add_args(parser)
+    args = parser.parse_args(argv)
+    try:
+        asyncio.run(run_node(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,414 @@
+"""``tick-cluster`` subcommand: multi-node cluster harness & fault injector.
+
+Reference: scripts/tick-cluster.js — spawns N child processes of a ringpop
+program (tick-cluster.js:352-416), generates hosts.json (:486), and drives
+them over ``/admin/*`` requests with keyboard commands (:250-331):
+
+  j join-all   t tick-all (checksum-convergence groups, :88-115)
+  s membership stats by checksum (:117-149)   p protocol timing (:167-190)
+  g start gossip   d/D debug set/clear
+  l suspend (SIGSTOP, :432-446)  L resume  k kill (SIGKILL, :448-462)
+  K revive (:418-430)   q quit
+
+Two execution modes:
+* **proc** (default) — real OS processes (``python -m ringpop_tpu worker``)
+  over the TCP transport, signals for fault injection: the reference's shape.
+* **sim** — the deterministic in-process ``harness.Cluster`` on virtual
+  time: same commands, instant and reproducible.
+
+Non-interactive automation: ``--script "j,w3000,t,t,q"`` runs comma-
+separated commands (``wN`` = wait N ms) and exits — used by the
+integration tests and benchmarks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any
+
+from ringpop_tpu.cli.admin_client import AdminRequestError, admin_request
+from ringpop_tpu.cli.generate_hosts import generate
+
+
+def group_by_checksum(checksums: dict[str, Any]) -> dict[Any, list[str]]:
+    """tick-cluster.js:100-113: hosts grouped by membership checksum."""
+    groups: dict[Any, list[str]] = {}
+    for host, checksum in checksums.items():
+        groups.setdefault(checksum, []).append(host)
+    return groups
+
+
+def format_groups(groups: dict[Any, list[str]], elapsed_ms: float) -> str:
+    sizes = " ".join(str(len(v)) for v in groups.values())
+    state = "CONVERGED" if len(groups) == 1 else f"{len(groups)} groups"
+    return f"tick: {state} [{sizes}] in {elapsed_ms:.0f}ms"
+
+
+class ClusterDriver:
+    """Common command surface over either backend."""
+
+    def cmd(self, ch: str) -> None:
+        dispatch = {
+            "j": self.join_all,
+            "g": self.gossip_all,
+            "t": self.tick_all,
+            "s": self.stats,
+            "p": self.protocol_stats,
+            "d": lambda: self.debug_set("p"),
+            "D": self.debug_clear,
+            "l": self.suspend_next,
+            "L": self.resume_all,
+            "k": self.kill_next,
+            "K": self.revive_next,
+        }
+        fn = dispatch.get(ch)
+        if fn is None:
+            print(f"unknown command {ch!r}")
+        else:
+            fn()
+
+    # subclass responsibilities
+    def join_all(self) -> None: ...
+    def gossip_all(self) -> None: ...
+    def tick_all(self) -> None: ...
+    def stats(self) -> None: ...
+    def protocol_stats(self) -> None: ...
+    def debug_set(self, flag: str) -> None: ...
+    def debug_clear(self) -> None: ...
+    def suspend_next(self) -> None: ...
+    def resume_all(self) -> None: ...
+    def kill_next(self) -> None: ...
+    def revive_next(self) -> None: ...
+    def wait(self, ms: float) -> None: ...
+    def shutdown(self) -> None: ...
+
+
+class ProcCluster(ClusterDriver):
+    """Real process-per-node cluster (tick-cluster.js mode)."""
+
+    def __init__(self, size: int, base_port: int, host: str = "127.0.0.1",
+                 log_level: str = "warn"):
+        self.host_ports = generate([host], base_port, size)
+        self.workdir = tempfile.mkdtemp(prefix="ringpop-tick-")
+        self.hosts_file = os.path.join(self.workdir, "hosts.json")
+        with open(self.hosts_file, "w") as f:
+            json.dump(self.host_ports, f)
+        self.log_level = log_level
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.suspended: list[str] = []
+        for host_port in self.host_ports:
+            self.procs[host_port] = self._spawn(host_port)
+
+    def _spawn(self, host_port: str) -> subprocess.Popen:
+        log_path = os.path.join(self.workdir, host_port.replace(":", "_") + ".log")
+        log_file = open(log_path, "a")
+        return subprocess.Popen(
+            [sys.executable, "-m", "ringpop_tpu", "worker",
+             "--listen", host_port, "--hosts", self.hosts_file,
+             "--log-level", self.log_level],
+            stdout=log_file, stderr=subprocess.STDOUT,
+        )
+
+    def live(self) -> list[str]:
+        return [
+            hp for hp, p in self.procs.items()
+            if p.poll() is None and hp not in self.suspended
+        ]
+
+    def _each(self, endpoint: str, body: Any = None) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for host_port in self.live():
+            try:
+                out[host_port] = admin_request(host_port, endpoint, body)
+            except (AdminRequestError, OSError) as e:
+                out[host_port] = f"error: {e}"
+        return out
+
+    def join_all(self) -> None:
+        responses = self._each("/admin/join")
+        errors = [hp for hp, r in responses.items()
+                  if isinstance(r, str) and r.startswith("error")]
+        print(f"join: {len(responses) - len(errors)} nodes joined"
+              + (f", {len(errors)} errors {errors}" if errors else ""))
+
+    def gossip_all(self) -> None:
+        self._each("/admin/gossip")
+        print("gossip started on all nodes")
+
+    def tick_all(self) -> None:
+        t0 = time.perf_counter()
+        responses = self._each("/admin/tick")
+        checksums = {hp: r.get("checksum") for hp, r in responses.items()
+                     if isinstance(r, dict)}
+        errors = [hp for hp in responses if hp not in checksums]
+        line = format_groups(group_by_checksum(checksums),
+                             (time.perf_counter() - t0) * 1000)
+        if errors:
+            line += f"  ({len(errors)} errors: {errors})"
+        print(line)
+
+    def stats(self) -> None:
+        responses = self._each("/admin/stats")
+        checksums = {
+            hp: (r.get("membership", {}).get("checksum")
+                 if isinstance(r, dict) else r)
+            for hp, r in responses.items()
+        }
+        for checksum, hosts in group_by_checksum(checksums).items():
+            print(f"  checksum {checksum}: {len(hosts)} nodes {sorted(hosts)}")
+
+    def protocol_stats(self) -> None:
+        for hp, r in self._each("/admin/stats").items():
+            if isinstance(r, dict):
+                timing = r["protocol"]["timing"]
+                print(
+                    f"  {hp}: rate={r['protocol']['protocolRate']:.1f}ms"
+                    f" p50={timing['median']:.1f} p95={timing['p95']:.1f}"
+                    f" p99={timing['p99']:.1f} count={timing['count']}"
+                )
+            else:
+                print(f"  {hp}: {r}")
+
+    def debug_set(self, flag: str) -> None:
+        self._each("/admin/debugSet", {"debugFlag": flag})
+        print(f"debug flag {flag!r} set on all nodes")
+
+    def debug_clear(self) -> None:
+        self._each("/admin/debugClear")
+        print("debug flags cleared on all nodes")
+
+    def suspend_next(self) -> None:
+        live = self.live()
+        if not live:
+            return print("no live node to suspend")
+        target = live[-1]
+        self.procs[target].send_signal(signal.SIGSTOP)
+        self.suspended.append(target)
+        print(f"suspended {target}")
+
+    def resume_all(self) -> None:
+        for host_port in self.suspended:
+            if self.procs[host_port].poll() is None:
+                self.procs[host_port].send_signal(signal.SIGCONT)
+        print(f"resumed {len(self.suspended)} nodes")
+        self.suspended.clear()
+
+    def kill_next(self) -> None:
+        live = self.live()
+        if not live:
+            return print("no live node to kill")
+        target = live[-1]
+        self.procs[target].kill()
+        self.procs[target].wait()
+        print(f"killed {target}")
+
+    def revive_next(self) -> None:
+        dead = [hp for hp, p in self.procs.items() if p.poll() is not None]
+        if not dead:
+            return print("no dead node to revive")
+        target = dead[0]
+        self.procs[target] = self._spawn(target)
+        print(f"revived {target}")
+
+    def wait(self, ms: float) -> None:
+        time.sleep(ms / 1000.0)
+
+    def wait_healthy(self, timeout_s: float = 60.0) -> None:
+        """Block until every worker answers /health (startup can be slow:
+        each spawned interpreter pays the site-level jax import)."""
+        deadline = time.time() + timeout_s
+        waiting = set(self.host_ports)
+        while waiting and time.time() < deadline:
+            for host_port in list(waiting):
+                try:
+                    admin_request(host_port, "/health", timeout_s=1.0)
+                    waiting.discard(host_port)
+                except (AdminRequestError, OSError):
+                    pass
+            if waiting:
+                time.sleep(0.25)
+        if waiting:
+            print(f"warning: nodes never became healthy: {sorted(waiting)}")
+
+    def shutdown(self) -> None:
+        self.resume_all()
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 5
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+class SimCluster(ClusterDriver):
+    """Deterministic in-process cluster on virtual time (--sim)."""
+
+    def __init__(self, size: int, base_port: int, seed: int = 1):
+        from ringpop_tpu.harness import Cluster
+
+        self.cluster = Cluster(size=size, base_port=base_port, seed=seed)
+        self.cluster.bootstrap_all()
+        self._suspended: list[int] = []
+        self._killed: list[int] = []
+
+    def join_all(self) -> None:
+        print(f"join: {len(self.cluster.live_nodes())} nodes bootstrapped")
+
+    def gossip_all(self) -> None:
+        for node in self.cluster.live_nodes():
+            node.gossip.start()
+        print("gossip started on all nodes")
+
+    def tick_all(self) -> None:
+        t0 = time.perf_counter()
+        self.cluster.tick_all()
+        groups = {
+            k: v for k, v in self.cluster.checksum_groups().items()
+        }
+        print(format_groups(groups, (time.perf_counter() - t0) * 1000))
+
+    def stats(self) -> None:
+        for checksum, hosts in self.cluster.checksum_groups().items():
+            print(f"  checksum {checksum}: {len(hosts)} nodes {sorted(hosts)}")
+
+    def protocol_stats(self) -> None:
+        for node in self.cluster.live_nodes():
+            stats = node.get_stats()
+            timing = stats["protocol"]["timing"]
+            print(
+                f"  {node.host_port}: p50={timing['median']:.1f}"
+                f" p95={timing['p95']:.1f} count={timing['count']}"
+            )
+
+    def debug_set(self, flag: str) -> None:
+        for node in self.cluster.live_nodes():
+            node.set_debug_flag(flag)
+
+    def debug_clear(self) -> None:
+        for node in self.cluster.live_nodes():
+            node.clear_debug_flags()
+
+    def suspend_next(self) -> None:
+        live = [i for i, n in enumerate(self.cluster.nodes)
+                if i not in self._suspended and i not in self._killed]
+        if not live:
+            return print("no live node to suspend")
+        self.cluster.suspend(live[-1])
+        self._suspended.append(live[-1])
+        print(f"suspended {self.cluster.host_ports[live[-1]]}")
+
+    def resume_all(self) -> None:
+        for index in self._suspended:
+            self.cluster.resume(index)
+        print(f"resumed {len(self._suspended)} nodes")
+        self._suspended.clear()
+
+    def kill_next(self) -> None:
+        live = [i for i, n in enumerate(self.cluster.nodes)
+                if i not in self._suspended and i not in self._killed]
+        if not live:
+            return print("no live node to kill")
+        self.cluster.kill(live[-1])
+        self._killed.append(live[-1])
+        print(f"killed {self.cluster.host_ports[live[-1]]}")
+
+    def revive_next(self) -> None:
+        if not self._killed:
+            return print("no dead node to revive")
+        index = self._killed.pop(0)
+        self.cluster.revive(index)
+        print(f"revived {self.cluster.host_ports[index]}")
+
+    def wait(self, ms: float) -> None:
+        self.cluster.run(ms)
+
+    def shutdown(self) -> None:
+        self.cluster.destroy_all()
+
+
+MENU = """commands:
+  j join-all    g gossip-all   t tick (convergence)   s stats by checksum
+  p protocol timing   d/D debug set/clear
+  l suspend   L resume-all   k kill   K revive   q quit"""
+
+
+def run_script(driver: ClusterDriver, script: str) -> None:
+    for op in script.split(","):
+        op = op.strip()
+        if not op:
+            continue
+        if op[0] == "w":
+            driver.wait(float(op[1:]))
+        elif op == "q":
+            break
+        else:
+            driver.cmd(op)
+
+
+def run_interactive(driver: ClusterDriver) -> None:
+    import termios
+    import tty
+
+    print(MENU)
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setcbreak(fd)
+        while True:
+            ch = sys.stdin.read(1)
+            if ch in ("q", "\x03"):
+                break
+            driver.cmd(ch)
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+def add_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("-n", "--size", type=int, default=5,
+                        help="number of nodes (tick-cluster.js:32 default 5)")
+    parser.add_argument("--base-port", type=int, default=3000)
+    parser.add_argument("--sim", action="store_true",
+                        help="in-process deterministic cluster on virtual time")
+    parser.add_argument("--script", default=None,
+                        help='non-interactive command list, e.g. "j,w3000,t,q"')
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--log-level", default="warn")
+    parser.add_argument("--startup-timeout-s", type=float, default=60,
+                        help="proc mode: max wait for workers to answer /health")
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="ringpop-tpu tick-cluster")
+    add_args(parser)
+    args = parser.parse_args(argv)
+
+    if args.sim:
+        driver: ClusterDriver = SimCluster(args.size, args.base_port,
+                                           seed=args.seed)
+    else:
+        cluster = ProcCluster(args.size, args.base_port,
+                              log_level=args.log_level)
+        cluster.wait_healthy(args.startup_timeout_s)
+        driver = cluster
+
+    try:
+        if args.script:
+            run_script(driver, args.script)
+        else:
+            run_interactive(driver)
+    finally:
+        driver.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -48,12 +48,12 @@ def make_mesh(n_devices: int | None = None, devices: Any = None) -> Mesh:
     """A 1-D mesh over ``n_devices`` (default: all) devices."""
     if devices is None:
         devices = jax.devices()
-        if n_devices is not None:
-            if n_devices > len(devices):
-                raise ValueError(
-                    f"requested {n_devices} devices, only {len(devices)} available"
-                )
-            devices = devices[:n_devices]
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (AXIS,))
 
 

@@ -1,0 +1,72 @@
+"""CLI & tooling tests (reference: scripts/tick-cluster.js,
+scripts/generate-hosts.js).
+
+The sim-mode driver runs entirely on virtual time; the proc-mode test
+spawns real worker processes over the TCP transport (the reference's
+process-per-node shape, tick-cluster.js:352-416) and is marked slow.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+
+import pytest
+
+from ringpop_tpu.cli.generate_hosts import generate
+from ringpop_tpu.cli.tick_cluster import (
+    SimCluster,
+    group_by_checksum,
+    run_script,
+)
+
+
+def test_generate_hosts():
+    hosts = generate(["127.0.0.1", "10.0.0.2"], 3000, 3)
+    assert hosts == [
+        "127.0.0.1:3000", "127.0.0.1:3001", "127.0.0.1:3002",
+        "10.0.0.2:3000", "10.0.0.2:3001", "10.0.0.2:3002",
+    ]
+
+
+def test_group_by_checksum():
+    groups = group_by_checksum({"a": 1, "b": 1, "c": 2})
+    assert sorted(groups[1]) == ["a", "b"]
+    assert groups[2] == ["c"]
+
+
+def capture(fn) -> str:
+    old = sys.stdout
+    sys.stdout = buf = io.StringIO()
+    try:
+        fn()
+    finally:
+        sys.stdout = old
+    return buf.getvalue()
+
+
+def test_sim_tick_cluster_script_converges_and_survives_faults():
+    driver = SimCluster(size=5, base_port=24400, seed=7)
+    out = capture(lambda: run_script(
+        driver, "j,w3000,t,s,k,w1000,t,K,w10000,t,l,w1000,L,q"))
+    driver.shutdown()
+    lines = [l for l in out.splitlines() if l.startswith("tick:")]
+    assert lines[0].startswith("tick: CONVERGED [5]")
+    assert lines[1].startswith("tick: CONVERGED [4]")  # after kill
+    assert lines[2].startswith("tick: CONVERGED [5]")  # after revive
+    assert "suspended" in out and "resumed" in out
+
+
+@pytest.mark.slow
+def test_proc_tick_cluster_three_real_processes():
+    from ringpop_tpu.cli.tick_cluster import ProcCluster
+
+    cluster = ProcCluster(3, 24500, log_level="error")
+    try:
+        cluster.wait_healthy(90)
+        out = capture(lambda: run_script(cluster, "j,w4000,t"))
+        assert "join: 3 nodes joined" in out
+        assert "tick: CONVERGED [3]" in out
+    finally:
+        cluster.shutdown()

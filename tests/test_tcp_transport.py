@@ -1,0 +1,147 @@
+"""TCP transport unit tests: framing, timeout, refusal, full RingPop pair.
+
+Mirrors the transport-level behaviors the reference gets from TChannel
+(request/response, timeouts as typed errors, connection refusal) that the
+in-process transport tests already cover for the sim path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from ringpop_tpu.transport.tcp import (
+    TcpChannel,
+    TransportConnectionError,
+    TransportTimeoutError,
+)
+
+BASE = 24300
+
+
+def run(coro, timeout=20):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def make_echo_channel(host_port: str) -> TcpChannel:
+    channel = TcpChannel(host_port)
+
+    def echo(head, body, src, respond):
+        respond(None, head, json.dumps({"echo": json.loads(body)["x"], "src": src}))
+
+    def slow(head, body, src, respond):
+        # Never responds: exercises the client-side timeout.
+        pass
+
+    channel.register({"/echo": echo, "/slow": slow})
+    return channel
+
+
+def test_request_response():
+    async def scenario():
+        a = TcpChannel(f"127.0.0.1:{BASE}")
+        b = make_echo_channel(f"127.0.0.1:{BASE + 1}")
+        await a.listen()
+        await b.listen()
+        fut = asyncio.get_event_loop().create_future()
+        a.request(
+            b.host_port, "/echo", "HEAD", json.dumps({"x": 42}), 5000,
+            lambda err, res1, res2=None: fut.set_result((err, res1, res2)),
+        )
+        err, res1, res2 = await fut
+        assert err is None
+        assert res1 == "HEAD"
+        parsed = json.loads(res2)
+        assert parsed["echo"] == 42
+        assert parsed["src"] == a.host_port  # identified reverse route
+        a.close()
+        b.close()
+
+    run(scenario())
+
+
+def test_timeout_is_typed():
+    async def scenario():
+        a = TcpChannel(f"127.0.0.1:{BASE + 10}")
+        b = make_echo_channel(f"127.0.0.1:{BASE + 11}")
+        await a.listen()
+        await b.listen()
+        fut = asyncio.get_event_loop().create_future()
+        a.request(b.host_port, "/slow", None, None, 200,
+                  lambda err, *res: fut.set_result(err))
+        err = await fut
+        assert isinstance(err, TransportTimeoutError)
+        assert err.type == "ringpop.transport.timeout"
+        a.close()
+        b.close()
+
+    run(scenario())
+
+
+def test_connection_refused():
+    async def scenario():
+        a = TcpChannel(f"127.0.0.1:{BASE + 20}")
+        await a.listen()
+        fut = asyncio.get_event_loop().create_future()
+        a.request(f"127.0.0.1:{BASE + 29}", "/echo", None, None, 5000,
+                  lambda err, *res: fut.set_result(err))
+        err = await fut
+        assert isinstance(err, TransportConnectionError)
+        a.close()
+
+    run(scenario())
+
+
+def test_no_handler_is_remote_error():
+    async def scenario():
+        a = TcpChannel(f"127.0.0.1:{BASE + 30}")
+        b = make_echo_channel(f"127.0.0.1:{BASE + 31}")
+        await a.listen()
+        await b.listen()
+        fut = asyncio.get_event_loop().create_future()
+        a.request(b.host_port, "/nope", None, None, 5000,
+                  lambda err, *res: fut.set_result(err))
+        err = await fut
+        assert err is not None
+        assert "no handler" in str(err)
+        a.close()
+        b.close()
+
+    run(scenario())
+
+
+def test_two_ringpops_converge_over_tcp():
+    """Two real RingPop nodes gossip to one checksum over localhost TCP."""
+    from ringpop_tpu.clock import AsyncioScheduler
+    from ringpop_tpu.ringpop import RingPop
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        hosts = [f"127.0.0.1:{BASE + 40}", f"127.0.0.1:{BASE + 41}"]
+        nodes = []
+        for host_port in hosts:
+            channel = TcpChannel(host_port, loop)
+            node = RingPop(app="tcp-test", host_port=host_port, channel=channel,
+                           clock=AsyncioScheduler(loop))
+            node.setup_channel()
+            await channel.listen()
+            nodes.append(node)
+        boot = [loop.create_future() for _ in nodes]
+        for node, fut in zip(nodes, boot):
+            node.bootstrap(hosts, lambda err, joined=None, fut=fut:
+                           fut.set_result(err))
+        errs = await asyncio.gather(*boot)
+        assert all(e is None for e in errs), errs
+        for _ in range(100):
+            checksums = {n.membership.checksum for n in nodes}
+            if len(checksums) == 1 and None not in checksums:
+                break
+            await asyncio.sleep(0.1)
+        assert len({n.membership.checksum for n in nodes}) == 1
+        assert nodes[0].membership.get_member_count() == 2
+        for node in nodes:
+            node.destroy()
+
+    run(scenario(), timeout=30)
