@@ -1,0 +1,19 @@
+"""Benchmark suite (reference: benchmarks/ — SURVEY §2.3).
+
+Each module exposes ``run() -> list[dict]`` where every dict is one result:
+``{"metric": str, "value": float, "unit": str, ...}``.  ``run_all.py``
+aggregates them (the reference globs bench_*.js, benchmarks/index.js).
+
+Mirrors of the reference harnesses:
+  bench_membership_update   large-membership-update.js (1332-member fixture)
+  bench_compute_checksum    compute-checksum.js (@100 / @1000 members)
+  bench_hashring_churn      add-remove-hashring.js (individual vs bulk)
+  bench_find_member         find-member-by-address.js
+  bench_join_merge          join-response-merge.js (± same checksum)
+  bench_stat_keys           bench_ringpop_stat_{cached,new}_keys.js
+
+TPU simulation configs (BASELINE.md targets):
+  bench_sim_convergence     config 3: 10k nodes, 1% loss, suspect→faulty
+  bench_partition_heal      config 4: 50/50 netsplit then merge
+  bench_ring_rebalance      config 5: churn key-movement
+"""

@@ -1,0 +1,30 @@
+"""Membership checksum computation @ 100 / 1,000 members
+(reference: benchmarks/compute-checksum.js)."""
+
+from __future__ import annotations
+
+import time
+
+from benchmarks.fixtures import large_membership
+from ringpop_tpu.harness import test_ringpop
+
+
+def _bench(n_members: int, duration_s: float) -> dict:
+    rp = test_ringpop(host_port="10.30.0.1:30000")
+    rp.membership.update(large_membership(n_members))
+    iterations = 0
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s
+    while time.perf_counter() < deadline:
+        rp.membership.compute_checksum()
+        iterations += 1
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": f"compute_checksum_{n_members}",
+        "value": round(iterations / elapsed, 2),
+        "unit": "ops/sec",
+    }
+
+
+def run(duration_s: float = 1.0) -> list[dict]:
+    return [_bench(100, duration_s), _bench(1000, duration_s)]

@@ -1,0 +1,33 @@
+"""findMemberByAddress out of 1,000 members
+(reference: benchmarks/find-member-by-address.js)."""
+
+from __future__ import annotations
+
+import random
+import time
+
+from benchmarks.fixtures import large_membership
+from ringpop_tpu.harness import test_ringpop
+
+
+def run(duration_s: float = 1.0) -> list[dict]:
+    members = large_membership(1000)
+    rp = test_ringpop(host_port="10.30.0.1:30000")
+    rp.membership.update(members)
+    addresses = [m["address"] for m in members]
+    rng = random.Random(1)
+    iterations = 0
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s
+    while time.perf_counter() < deadline:
+        addr = addresses[rng.randrange(len(addresses))]
+        assert rp.membership.find_member_by_address(addr) is not None
+        iterations += 1
+    elapsed = time.perf_counter() - t0
+    return [
+        {
+            "metric": "find_member_by_address_1000",
+            "value": round(iterations / elapsed, 2),
+            "unit": "ops/sec",
+        }
+    ]

@@ -1,0 +1,42 @@
+"""Hash ring add/remove of 1,000 servers, individual vs bulk
+(reference: benchmarks/add-remove-hashring.js — bulk amortizes the
+checksum recompute, ring.js:60-94)."""
+
+from __future__ import annotations
+
+import time
+
+from ringpop_tpu.hashring import HashRing
+
+SERVERS = [f"10.0.{i // 250}.{i % 250}:3000" for i in range(1000)]
+
+
+def run(repeats: int = 3) -> list[dict]:
+    best_individual = float("inf")
+    best_bulk = float("inf")
+    for _ in range(repeats):
+        ring = HashRing()
+        t0 = time.perf_counter()
+        for server in SERVERS:
+            ring.add_server(server)
+        for server in SERVERS:
+            ring.remove_server(server)
+        best_individual = min(best_individual, time.perf_counter() - t0)
+
+        ring = HashRing()
+        t0 = time.perf_counter()
+        ring.add_remove_servers(SERVERS, [])
+        ring.add_remove_servers([], SERVERS)
+        best_bulk = min(best_bulk, time.perf_counter() - t0)
+    return [
+        {
+            "metric": "hashring_add_remove_1000_individual",
+            "value": round(1.0 / best_individual, 3),
+            "unit": "ops/sec",
+        },
+        {
+            "metric": "hashring_add_remove_1000_bulk",
+            "value": round(1.0 / best_bulk, 3),
+            "unit": "ops/sec",
+        },
+    ]

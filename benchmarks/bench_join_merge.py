@@ -1,0 +1,38 @@
+"""Merging 3 x 1,000-member join responses, with and without identical
+checksums (reference: benchmarks/join-response-merge.js — same checksum
+short-circuits to the first response, join-response-merge.js:24-47)."""
+
+from __future__ import annotations
+
+import time
+
+from benchmarks.fixtures import large_membership
+from ringpop_tpu.swim.join_response_merge import merge_join_responses
+
+LOCAL = "10.99.0.1:3000"
+
+
+def _bench(same_checksum: bool, duration_s: float) -> dict:
+    members = large_membership(1000)
+    responses = [
+        {"checksum": 12345 if same_checksum else 12345 + i, "members": members}
+        for i in range(3)
+    ]
+    iterations = 0
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s
+    while time.perf_counter() < deadline:
+        merged = merge_join_responses(LOCAL, responses)
+        assert len(merged) == 1000
+        iterations += 1
+    elapsed = time.perf_counter() - t0
+    suffix = "same_checksum" if same_checksum else "diff_checksum"
+    return {
+        "metric": f"join_response_merge_3x1000_{suffix}",
+        "value": round(iterations / elapsed, 2),
+        "unit": "ops/sec",
+    }
+
+
+def run(duration_s: float = 1.0) -> list[dict]:
+    return [_bench(True, duration_s), _bench(False, duration_s)]

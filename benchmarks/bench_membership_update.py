@@ -1,0 +1,31 @@
+"""membership.update() of a large changeset
+(reference: benchmarks/large-membership-update.js — applies the
+1,332-member fixture as one changeset, reports ops/sec)."""
+
+from __future__ import annotations
+
+import time
+
+from benchmarks.fixtures import large_membership
+from ringpop_tpu.harness import test_ringpop
+
+
+def run(duration_s: float = 2.0) -> list[dict]:
+    changes = large_membership()
+    iterations = 0
+    elapsed = 0.0
+    deadline = time.perf_counter() + duration_s
+    while time.perf_counter() < deadline:
+        rp = test_ringpop(host_port="10.30.0.1:30000")
+        t0 = time.perf_counter()
+        rp.membership.update(changes)
+        elapsed += time.perf_counter() - t0
+        iterations += 1
+    return [
+        {
+            "metric": "membership_update_1332",
+            "value": round(iterations / elapsed, 2),
+            "unit": "ops/sec",
+            "iterations": iterations,
+        }
+    ]

@@ -1,0 +1,52 @@
+"""BASELINE config 4: 50/50 netsplit then merge, checksum agreement.
+
+The reference *documents* partition-merge (faulty members retained so
+split-brains can merge, docs/architecture_design.md:19) but its netsplit
+test helper was never implemented (test/lib/partition-cluster.js:59-61).
+Here a partition is a block-structured adjacency mask.
+
+Default N is sized for one chip's HBM; the 65k-node target needs the
+row-sharded multi-chip path (ringpop_tpu/parallel) on a pod slice —
+the same code, a larger mesh ("partition_heal" at any N is shape-
+polymorphic)."""
+
+from __future__ import annotations
+
+import time
+
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+
+
+def run(n: int = 8192, loss: float = 0.0) -> list[dict]:
+    cluster = SimCluster(n, sim.SwimParams(loss=loss), seed=4)
+    cluster.tick(5)  # warm up / compile
+
+    half = n // 2
+    sides = [list(range(half)), list(range(half, n))]
+    cluster.partition(sides)
+    # Let each side declare the other faulty (suspicion must expire).
+    split_ticks = cluster.params.suspicion_ticks + 20
+    t0 = time.perf_counter()
+    cluster.tick(split_ticks)
+
+    cluster.heal_partition()
+    heal_ticks = 0
+    while heal_ticks < 600:
+        cluster.tick(5)
+        heal_ticks += 5
+        if cluster.converged():
+            break
+    wall = time.perf_counter() - t0
+    groups = cluster.checksum_groups()
+    return [
+        {
+            "metric": f"sim_partition_heal_n{n}",
+            "value": heal_ticks,
+            "unit": "ticks_to_remerge",
+            "split_ticks": split_ticks,
+            "wall_s": round(wall, 3),
+            "checksum_groups": len(groups),
+            "converged": cluster.converged(),
+        }
+    ]

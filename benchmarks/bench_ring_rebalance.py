@@ -1,0 +1,50 @@
+"""BASELINE config 5: hash-ring rebalance under churn — 10k servers,
+5%/tick join/leave, key-movement count.
+
+Measures consistent hashing's defining property (how few keys move under
+churn, ring.js replica-point design) and the ring update throughput."""
+
+from __future__ import annotations
+
+import random
+import time
+
+from ringpop_tpu.hashring import HashRing
+
+
+def run(n: int = 10000, churn: float = 0.05, ticks: int = 5,
+        n_keys: int = 2000) -> list[dict]:
+    rng = random.Random(5)
+    servers = [f"10.{i // 65536 % 256}.{i // 256 % 256}.{i % 256}:3000"
+               for i in range(n)]
+    ring = HashRing()
+    ring.add_remove_servers(servers, [])
+    keys = [f"key-{rng.randrange(10 ** 12)}" for _ in range(n_keys)]
+    owners = {k: ring.lookup(k) for k in keys}
+
+    in_ring = set(servers)
+    spare = [f"10.200.{i // 256}.{i % 256}:3000" for i in range(n)]
+    moved_total = 0
+    churn_count = int(n * churn)
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        leavers = rng.sample(sorted(in_ring), churn_count)
+        joiners = [spare.pop() for _ in range(churn_count)]
+        ring.add_remove_servers(joiners, leavers)
+        in_ring.difference_update(leavers)
+        in_ring.update(joiners)
+        new_owners = {k: ring.lookup(k) for k in keys}
+        moved_total += sum(1 for k in keys if new_owners[k] != owners[k])
+        owners = new_owners
+    wall = time.perf_counter() - t0
+
+    moved_frac = moved_total / (n_keys * ticks)
+    return [
+        {
+            "metric": f"ring_rebalance_n{n}_churn{churn}",
+            "value": round(moved_frac, 4),
+            "unit": "fraction_keys_moved_per_tick",
+            "expected_fraction": round(2 * churn, 4),  # leave + join movement
+            "wall_s_per_tick": round(wall / ticks, 3),
+        }
+    ]

@@ -1,0 +1,48 @@
+"""BASELINE config 3: 10k-node SWIM sim, 1% packet loss, suspect→faulty
+convergence after a node dies.
+
+Measures (a) protocol ticks until every live node has declared the dead
+node faulty and views re-agree, and (b) wall-clock per simulated
+protocol round.  The reference equivalent would be 10,000 real processes
+at one 200 ms protocol period each — a rate the ``realtime_speedup``
+field compares against (rounds simulated per second / rounds a real
+cluster executes per second)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+
+
+def run(n: int = 10240, loss: float = 0.01) -> list[dict]:
+    cluster = SimCluster(n, sim.SwimParams(loss=loss), seed=3)
+    cluster.tick(5)  # warm up / compile
+
+    victim = 7
+    cluster.kill(victim)
+    t0 = time.perf_counter()
+    ticks = 0
+    while ticks < 400:
+        cluster.tick(5)
+        ticks += 5
+        status = np.asarray(cluster.state.view_status[:, victim])
+        live = cluster.live_indices()
+        if (status[live] == sim.FAULTY).all() and cluster.converged():
+            break
+    wall = time.perf_counter() - t0
+    rounds_per_sec = ticks * n / wall
+    realtime_speedup = rounds_per_sec / (n / (cluster.params.period_ms / 1000.0))
+    return [
+        {
+            "metric": f"sim_suspect_to_faulty_convergence_n{n}_loss{loss}",
+            "value": ticks,
+            "unit": "ticks",
+            "wall_s": round(wall, 3),
+            "node_rounds_per_sec": round(rounds_per_sec, 1),
+            "realtime_speedup": round(realtime_speedup, 1),
+        }
+    ]

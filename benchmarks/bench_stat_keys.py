@@ -1,0 +1,31 @@
+"""stat() key-cache fast path: cached vs always-new keys
+(reference: benchmarks/bench_ringpop_stat_{cached,new}_keys.js;
+the cache is index.js:561-575)."""
+
+from __future__ import annotations
+
+import time
+
+from ringpop_tpu.harness import test_ringpop
+
+
+def run(duration_s: float = 1.0) -> list[dict]:
+    results = []
+    for cached in (True, False):
+        rp = test_ringpop(host_port="10.30.0.1:30000")
+        iterations = 0
+        t0 = time.perf_counter()
+        deadline = t0 + duration_s
+        while time.perf_counter() < deadline:
+            key = "ping.send" if cached else f"ping.send.{iterations}"
+            rp.stat("increment", key, 1)
+            iterations += 1
+        elapsed = time.perf_counter() - t0
+        results.append(
+            {
+                "metric": f"stat_{'cached' if cached else 'new'}_keys",
+                "value": round(iterations / elapsed, 2),
+                "unit": "ops/sec",
+            }
+        )
+    return results

@@ -1,0 +1,21 @@
+"""Benchmark fixtures (reference: benchmarks/large-membership.json —
+1,332 members with realistic 10.x addresses, status alive, wall-clock
+incarnation numbers).  Generated deterministically instead of stored."""
+
+from __future__ import annotations
+
+LARGE_MEMBERSHIP_SIZE = 1332
+
+
+def large_membership(n: int = LARGE_MEMBERSHIP_SIZE) -> list[dict]:
+    members = []
+    for i in range(n):
+        address = f"10.{30 + i // 2500}.{(i // 25) % 100}.{i % 25 + 1}:{31000 + i % 1000}"
+        members.append(
+            {
+                "address": address,
+                "status": "alive",
+                "incarnationNumber": 1414143508000 + i,
+            }
+        )
+    return members

@@ -1,0 +1,61 @@
+"""Benchmark aggregator (reference: benchmarks/index.js globs bench_*.js;
+benchmarks/run.js is the cross-ref harness — here a flat runner).
+
+Usage:  python -m benchmarks.run_all [--fast] [--only SUBSTR]
+Prints one JSON line per result; host-library benches first, then the
+TPU simulation configs (slow: one XLA compile each)."""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+import traceback
+
+HOST_BENCHES = [
+    "bench_membership_update",
+    "bench_compute_checksum",
+    "bench_hashring_churn",
+    "bench_find_member",
+    "bench_join_merge",
+    "bench_stat_keys",
+    "bench_ring_rebalance",  # config 5 is host-side (no XLA compile)
+]
+SIM_BENCHES = [
+    "bench_sim_convergence",
+    "bench_partition_heal",
+]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--fast", action="store_true",
+                        help="host benches only (skip XLA compiles)")
+    parser.add_argument("--only", default=None,
+                        help="substring filter on bench module name")
+    parser.add_argument("--sim-n", type=int, default=None,
+                        help="override N for the simulation configs")
+    args = parser.parse_args(argv)
+
+    names = HOST_BENCHES + ([] if args.fast else SIM_BENCHES)
+    if args.only:
+        names = [n for n in names if args.only in n]
+    failed = 0
+    for name in names:
+        module = importlib.import_module(f"benchmarks.{name}")
+        kwargs = {}
+        if args.sim_n and name in ("bench_sim_convergence", "bench_partition_heal"):
+            kwargs["n"] = args.sim_n
+        try:
+            for result in module.run(**kwargs):
+                print(json.dumps({"bench": name, **result}), flush=True)
+        except Exception:
+            failed += 1
+            traceback.print_exc()
+            print(json.dumps({"bench": name, "error": "failed"}), flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,12 +109,15 @@ class ProcCluster(ClusterDriver):
     def _spawn(self, host_port: str) -> subprocess.Popen:
         log_path = os.path.join(self.workdir, host_port.replace(":", "_") + ".log")
         log_file = open(log_path, "a")
-        return subprocess.Popen(
-            [sys.executable, "-m", "ringpop_tpu", "worker",
-             "--listen", host_port, "--hosts", self.hosts_file,
-             "--log-level", self.log_level],
-            stdout=log_file, stderr=subprocess.STDOUT,
-        )
+        try:
+            return subprocess.Popen(
+                [sys.executable, "-m", "ringpop_tpu", "worker",
+                 "--listen", host_port, "--hosts", self.hosts_file,
+                 "--log-level", self.log_level],
+                stdout=log_file, stderr=subprocess.STDOUT,
+            )
+        finally:
+            log_file.close()  # the child holds its inherited copy
 
     def live(self) -> list[str]:
         return [
@@ -123,13 +126,23 @@ class ProcCluster(ClusterDriver):
         ]
 
     def _each(self, endpoint: str, body: Any = None) -> dict[str, Any]:
-        out: dict[str, Any] = {}
-        for host_port in self.live():
+        """Fan the request out concurrently (the reference drives all
+        nodes in parallel; serial round-trips would distort the reported
+        tick/convergence timings)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        hosts = self.live()
+        if not hosts:
+            return {}
+
+        def one(host_port: str) -> Any:
             try:
-                out[host_port] = admin_request(host_port, endpoint, body)
+                return admin_request(host_port, endpoint, body)
             except (AdminRequestError, OSError) as e:
-                out[host_port] = f"error: {e}"
-        return out
+                return f"error: {e}"
+
+        with ThreadPoolExecutor(max_workers=min(32, len(hosts))) as pool:
+            return dict(zip(hosts, pool.map(one, hosts)))
 
     def join_all(self) -> None:
         responses = self._each("/admin/join")
@@ -272,10 +285,8 @@ class SimCluster(ClusterDriver):
     def tick_all(self) -> None:
         t0 = time.perf_counter()
         self.cluster.tick_all()
-        groups = {
-            k: v for k, v in self.cluster.checksum_groups().items()
-        }
-        print(format_groups(groups, (time.perf_counter() - t0) * 1000))
+        print(format_groups(self.cluster.checksum_groups(),
+                            (time.perf_counter() - t0) * 1000))
 
     def stats(self) -> None:
         for checksum, hosts in self.cluster.checksum_groups().items():

@@ -37,6 +37,20 @@ class HashRing(EventEmitter):
         self._entries: list[tuple[int, str]] = []
         self.servers: dict[str, bool] = {}
         self.checksum: int | None = None
+        # server -> tuple of replica hashes; remove re-uses what add
+        # computed, and churn re-adds recently removed servers.
+        self._replica_cache: dict[str, tuple[int, ...]] = {}
+
+    def _replicas(self, server: str) -> tuple[int, ...]:
+        hashes = self._replica_cache.get(server)
+        if hashes is None:
+            hashes = tuple(
+                self.hash_func(f"{server}{i}") for i in range(self.replica_points)
+            )
+            if len(self._replica_cache) > 4 * max(len(self.servers), 1000):
+                self._replica_cache.clear()
+            self._replica_cache[server] = hashes
+        return hashes
 
     # -- mutation (ring.js:39-94) -------------------------------------------
 
@@ -59,32 +73,52 @@ class HashRing(EventEmitter):
         servers_to_add: list[str] | None = None,
         servers_to_remove: list[str] | None = None,
     ) -> bool:
-        """Batch add/remove with a single checksum recompute (ring.js:60-94)."""
-        added = False
-        removed = False
-        for server in servers_to_add or []:
-            if not self.has_server(server):
-                self._add_server_replicas(server)
-                added = True
-        for server in servers_to_remove or []:
-            if self.has_server(server):
-                self._remove_server_replicas(server)
-                removed = True
-        changed = added or removed
-        if changed:
-            self.compute_checksum()
-        return changed
+        """Batch add/remove with a single checksum recompute (ring.js:60-94).
+
+        One filter + one sort for the whole batch — per-replica bisect
+        insertion is O(replicas x ring-size) per server, which made
+        bootstrap-sized batches (1000+ servers via the membership
+        listener) quadratic."""
+        # Dedupe within the batch: the membership listener builds these
+        # lists from raw update batches where an address can repeat, and a
+        # double add would insert duplicate replica entries that a later
+        # remove only half-deletes.  An address in both lists resolves to
+        # its final state the way sequential add-then-remove would.
+        removing = set(servers_to_remove or [])
+        to_add = [
+            s for s in dict.fromkeys(servers_to_add or [])
+            if not self.has_server(s) and s not in removing
+        ]
+        to_remove = [s for s in dict.fromkeys(removing) if self.has_server(s)]
+        if not to_add and not to_remove:
+            return False
+        entries = self._entries
+        if to_remove:
+            for server in to_remove:
+                del self.servers[server]
+            dead = {
+                (h, server) for server in to_remove for h in self._replicas(server)
+            }
+            entries = [e for e in entries if e not in dead]
+        if to_add:
+            for server in to_add:
+                self.servers[server] = True
+            entries = entries + [
+                (h, server) for server in to_add for h in self._replicas(server)
+            ]
+            entries.sort()
+        self._entries = entries
+        self.compute_checksum()
+        return True
 
     def _add_server_replicas(self, server: str) -> None:
         self.servers[server] = True
-        for i in range(self.replica_points):
-            h = self.hash_func(f"{server}{i}")
+        for h in self._replicas(server):
             bisect.insort(self._entries, (h, server))
 
     def _remove_server_replicas(self, server: str) -> None:
         del self.servers[server]
-        for i in range(self.replica_points):
-            h = self.hash_func(f"{server}{i}")
+        for h in self._replicas(server):
             idx = bisect.bisect_left(self._entries, (h, server))
             if idx < len(self._entries) and self._entries[idx] == (h, server):
                 del self._entries[idx]

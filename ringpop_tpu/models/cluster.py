@@ -28,6 +28,19 @@ from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 DEFAULT_BASE_INC = 1_400_000_000_000  # host clock epoch (clock.SimScheduler)
 
 
+@jax.jit
+def _converged_impl(state: ClusterState, net: NetState) -> jax.Array:
+    own = jnp.diagonal(state.view_status)
+    live = net.up & net.responsive & ((own == sim.ALIVE) | (own == sim.SUSPECT))
+    ref = jnp.argmax(live)  # first live node's view is the reference view
+    row_same = jnp.all(
+        (state.view_status == state.view_status[ref][None, :])
+        & (state.view_inc == state.view_inc[ref][None, :]),
+        axis=1,
+    )
+    return jnp.all(jnp.where(live, row_same, True)) | (jnp.sum(live) <= 1)
+
+
 class SimCluster:
     def __init__(
         self,
@@ -102,14 +115,10 @@ class SimCluster:
 
     def converged(self) -> bool:
         """Exact view agreement among live nodes (stronger than checksum
-        equality — no hash involved)."""
-        live = self.live_indices()
-        if len(live) <= 1:
-            return True
-        vs = self.state.view_status[jnp.asarray(live)]
-        vi = self.state.view_inc[jnp.asarray(live)]
-        same = jnp.all(vs == vs[0]) & jnp.all(vi == vi[0])
-        return bool(same)
+        equality — no hash involved).  Fixed-shape masked compare on
+        device: a gather by the (variable-length) live set would force an
+        XLA recompile every time the live count changes."""
+        return bool(_converged_impl(self.state, self.net))
 
     def checksums(self, indices: Sequence[int] | None = None) -> dict[str, int]:
         """Reference-format membership checksum per (live) node address."""

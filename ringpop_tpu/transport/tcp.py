@@ -216,6 +216,10 @@ class TcpChannel:
                     TransportConnectionError(f"connection refused: {host} ({e})"),
                 )
             return
+        if self.destroyed:  # closed while the dial was in flight
+            writer.close()
+            self._dialing.pop(host, None)
+            return
         conn = _Conn(self, reader, writer)
         self._conns.add(conn)
         self._peer_conn[host] = conn

@@ -103,3 +103,24 @@ def test_removal_rebalances_only_affected_keys():
             moved += 1
             assert before[k] == victim  # only the victim's keys may move
     assert moved > 0
+
+
+def test_batch_duplicates_and_conflicts_are_idempotent():
+    """Regression: duplicate adds in one batch must not insert replica
+    entries twice (a later remove would leave stale entries routing keys
+    to a departed server), and add+remove of the same server in one batch
+    resolves like sequential add-then-remove."""
+    from ringpop_tpu.hashring import HashRing
+
+    ring = HashRing()
+    ring.add_remove_servers(["a:1", "a:1", "b:1"], [])
+    assert ring.get_server_count() == 2
+    ring.remove_server("a:1")
+    assert ring.get_server_count() == 1
+    for _ in range(50):
+        assert ring.lookup(f"key-{_}") == "b:1"
+
+    ring2 = HashRing()
+    ring2.add_remove_servers(["c:1"], ["c:1"])
+    assert not ring2.has_server("c:1")
+    assert ring2.lookup("x") is None

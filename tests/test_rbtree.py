@@ -1,0 +1,122 @@
+"""Red-black tree tests (reference: test/rbtree_test.js, 612 LoC —
+insert/remove/bounds/iterator plus the 'RBTree payload copy bug'
+regression at rbtree_test.js:594) and RBRing vs HashRing cross-checks."""
+
+from __future__ import annotations
+
+import random
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.ops.farmhash import farmhash32
+from ringpop_tpu.rbtree import RBRing, RBTree
+
+
+def build(vals):
+    tree = RBTree()
+    for v in vals:
+        tree.insert(v, f"s{v}")
+    return tree
+
+
+def test_insert_iterate_sorted():
+    vals = random.Random(1).sample(range(10 ** 6), 500)
+    tree = build(vals)
+    assert tree.size == 500
+    assert [n.val for n in tree] == sorted(vals)
+    tree.check_invariants()
+
+
+def test_duplicate_insert_rejected():
+    tree = RBTree()
+    assert tree.insert(5, "a") is True
+    assert tree.insert(5, "b") is False
+    assert tree.size == 1
+    assert tree.find(5).name == "a"
+
+
+def test_remove_with_oracle_and_invariants():
+    rng = random.Random(7)
+    vals = rng.sample(range(10 ** 6), 400)
+    tree = build(vals)
+    alive = set(vals)
+    for v in rng.sample(vals, 300):
+        assert tree.remove(v) is True
+        alive.discard(v)
+        assert tree.remove(v) is False  # already gone
+    assert tree.size == len(alive)
+    assert [n.val for n in tree] == sorted(alive)
+    tree.check_invariants()
+
+
+def test_payload_copy_on_two_child_removal():
+    """Removing a node with two children replaces it with its successor's
+    val AND name together — the reference's payload-copy regression."""
+    tree = build([50, 25, 75, 10, 30, 60, 90])
+    tree.remove(50)
+    for node in tree:
+        assert node.name == f"s{node.val}", (node.val, node.name)
+    tree.check_invariants()
+
+
+def test_min_and_empty():
+    tree = RBTree()
+    assert tree.min() is None
+    assert tree.find(1) is None
+    assert tree.remove(1) is False
+    it = tree.iterator()
+    assert it.next() is None and it.val() is None
+    tree.insert(42, "x")
+    assert tree.min().val == 42
+
+
+def test_bounds_semantics():
+    tree = build([10, 20, 30, 40])
+    # Exact hit: equality-inclusive (ring.js lookup depends on this).
+    assert tree.upper_bound(20).val() == 20
+    assert tree.lower_bound(20).val() == 20
+    # Between nodes: first greater.
+    assert tree.upper_bound(21).val() == 30
+    assert tree.lower_bound(5).val() == 10
+    # Past the end: cursor is None (ring wraps to min).
+    assert tree.upper_bound(41).val() is None
+    # Iterator continues in order from a bound.
+    it2 = tree.lower_bound(15)
+    seen = [it2.val()]
+    while it2.next() is not None:
+        seen.append(it2.val())
+    assert seen == [20, 30, 40]
+
+
+def test_bounds_against_oracle():
+    rng = random.Random(3)
+    vals = sorted(rng.sample(range(100000), 200))
+    tree = build(vals)
+    for probe in rng.sample(range(100001), 300):
+        expect = next((v for v in vals if v >= probe), None)
+        assert tree.lower_bound(probe).val() == expect
+        assert tree.upper_bound(probe).val() == expect
+
+
+def test_rbring_matches_hashring():
+    """The tree-backed ring and the sorted-array ring implement the same
+    lookup/lookupN contract (ring.js:138-182)."""
+    array_ring = HashRing()
+    tree_ring = RBRing(farmhash32)
+    servers = [f"10.0.0.{i}:3000" for i in range(12)]
+    for server in servers:
+        array_ring.add_server(server)
+        tree_ring.add_server(server)
+
+    rng = random.Random(11)
+    keys = [f"key-{rng.randrange(10 ** 9)}" for _ in range(500)]
+    for key in keys:
+        assert array_ring.lookup(key) == tree_ring.lookup(key), key
+        assert array_ring.lookup_n(key, 4) == tree_ring.lookup_n(key, 4), key
+
+    # ... and still after churn.
+    for server in servers[::3]:
+        array_ring.remove_server(server)
+        tree_ring.remove_server(server)
+    for key in keys[:200]:
+        assert array_ring.lookup(key) == tree_ring.lookup(key), key
+        assert array_ring.lookup_n(key, 3) == tree_ring.lookup_n(key, 3), key
