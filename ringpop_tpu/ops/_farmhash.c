@@ -200,3 +200,156 @@ int64_t rp_membership_checksum(const uint8_t *packed, int64_t packed_len,
     free(heapbuf);
     return (int64_t)h;
 }
+
+/* Batched reference-format checksums of simulation view rows.
+ *
+ * For each requested viewer row, builds the checksum string of its view —
+ * members sorted by address, `addr + status + incarnation` joined by ';'
+ * (lib/membership.js:70-93) — and farmhash32s it, entirely in C with one
+ * worker thread per row shard.  This replaces a Python per-entry loop
+ * that made whole-cluster checksum parity O(N^2) interpreter work.
+ *
+ * Layout:
+ *   status      int8 [n_nodes * n_nodes]  row-major view_status
+ *   inc_rel     int32[n_nodes * n_nodes]  incarnation - base_inc
+ *   base_inc    int64                     added back before formatting
+ *   sorted      int64[n_nodes]            address sort permutation
+ *   addr_buf    concatenated address bytes
+ *   addr_off    int64[n_nodes + 1]        addr j = addr_buf[off[j]:off[j+1]]
+ *   status_buf / status_off                same encoding for status names,
+ *                                          indexed by status code 0..n_codes-1
+ *   none_code   the status meaning "member does not exist" (skipped)
+ *   rows        int64[n_rows]             which viewer rows to checksum
+ *   out         uint32[n_rows]
+ */
+#include <pthread.h>
+#include <stdio.h>
+
+typedef struct {
+    const int8_t *status;
+    const int32_t *inc_rel;
+    int64_t base_inc;
+    const int64_t *sorted;
+    const uint8_t *addr_buf;
+    const int64_t *addr_off;
+    const uint8_t *status_buf;
+    const int64_t *status_off;
+    int64_t n_nodes;
+    int8_t none_code;
+    const int64_t *rows;
+    uint32_t *out;
+    int64_t row_begin, row_end;
+    size_t scratch_len;
+    int failed;
+} vc_task;
+
+static inline uint8_t *write_i64(uint8_t *dst, int64_t v) {
+    char tmp[24];
+    int len = snprintf(tmp, sizeof(tmp), "%lld", (long long)v);
+    memcpy(dst, tmp, (size_t)len);
+    return dst + len;
+}
+
+static void *vc_worker(void *arg) {
+    vc_task *t = (vc_task *)arg;
+    uint8_t *scratch = (uint8_t *)malloc(t->scratch_len);
+    if (scratch == NULL) {
+        t->failed = 1;
+        return NULL;
+    }
+    for (int64_t r = t->row_begin; r < t->row_end; r++) {
+        const int64_t row = t->rows[r];
+        const int8_t *st = t->status + row * t->n_nodes;
+        const int32_t *inc = t->inc_rel + row * t->n_nodes;
+        uint8_t *dst = scratch;
+        int first = 1;
+        for (int64_t k = 0; k < t->n_nodes; k++) {
+            const int64_t j = t->sorted[k];
+            const int8_t s = st[j];
+            if (s == t->none_code) {
+                continue;
+            }
+            if (!first) {
+                *dst++ = ';';
+            }
+            first = 0;
+            {
+                const int64_t a0 = t->addr_off[j], a1 = t->addr_off[j + 1];
+                memcpy(dst, t->addr_buf + a0, (size_t)(a1 - a0));
+                dst += a1 - a0;
+            }
+            {
+                const int64_t s0 = t->status_off[s], s1 = t->status_off[s + 1];
+                memcpy(dst, t->status_buf + s0, (size_t)(s1 - s0));
+                dst += s1 - s0;
+            }
+            dst = write_i64(dst, t->base_inc + (int64_t)inc[j]);
+        }
+        t->out[r] = rp_farmhash32(scratch, (size_t)(dst - scratch));
+    }
+    free(scratch);
+    return NULL;
+}
+
+int rp_view_checksums(const int8_t *status, const int32_t *inc_rel,
+                      int64_t base_inc, const int64_t *sorted,
+                      const uint8_t *addr_buf, const int64_t *addr_off,
+                      const uint8_t *status_buf, const int64_t *status_off,
+                      int64_t n_nodes, int8_t none_code, const int64_t *rows,
+                      int64_t n_rows, uint32_t *out, int64_t n_threads) {
+    /* Worst-case per-row string: every member present. */
+    size_t scratch = 1;
+    for (int64_t j = 0; j < n_nodes; j++) {
+        size_t addr_len = (size_t)(addr_off[j + 1] - addr_off[j]);
+        scratch += addr_len + 8 /* status */ + 21 /* inc */ + 1 /* ';' */;
+    }
+    if (n_threads < 1) {
+        n_threads = 1;
+    }
+    if (n_threads > n_rows) {
+        n_threads = n_rows > 0 ? n_rows : 1;
+    }
+    vc_task tasks[64];
+    pthread_t threads[64];
+    if (n_threads > 64) {
+        n_threads = 64;
+    }
+    int64_t per = (n_rows + n_threads - 1) / n_threads;
+    int64_t started = 0;
+    for (int64_t t = 0; t < n_threads; t++) {
+        vc_task *task = &tasks[t];
+        task->status = status;
+        task->inc_rel = inc_rel;
+        task->base_inc = base_inc;
+        task->sorted = sorted;
+        task->addr_buf = addr_buf;
+        task->addr_off = addr_off;
+        task->status_buf = status_buf;
+        task->status_off = status_off;
+        task->n_nodes = n_nodes;
+        task->none_code = none_code;
+        task->rows = rows;
+        task->out = out;
+        task->row_begin = t * per;
+        task->row_end = (t + 1) * per < n_rows ? (t + 1) * per : n_rows;
+        task->scratch_len = scratch;
+        task->failed = 0;
+        if (task->row_begin >= task->row_end) {
+            task->row_begin = task->row_end = 0;
+        }
+        if (pthread_create(&threads[t], NULL, vc_worker, task) != 0) {
+            /* Fall back to running the remaining shards inline. */
+            vc_worker(task);
+            threads[t] = 0;
+        }
+        started++;
+    }
+    int failed = 0;
+    for (int64_t t = 0; t < started; t++) {
+        if (threads[t] != 0) {
+            pthread_join(threads[t], NULL);
+        }
+        failed |= tasks[t].failed;
+    }
+    return failed ? -1 : 0;
+}

@@ -173,7 +173,7 @@ def _build_c_lib() -> str | None:
     if sys.byteorder != "little":  # fetch32 assumes LE
         return None
     cc = os.environ.get("CC", "cc")
-    cmd = [cc, "-O2", "-shared", "-fPIC", "-o", _C_LIB, _C_SRC]
+    cmd = [cc, "-O2", "-shared", "-fPIC", "-pthread", "-o", _C_LIB, _C_SRC]
     try:
         os.makedirs(_C_LIB_DIR, exist_ok=True)
         subprocess.run(cmd, check=True, capture_output=True, timeout=60)
@@ -217,6 +217,23 @@ def _load_c_lib():
         ctypes.c_int64,
         ctypes.c_int64,
     ]
+    lib.rp_view_checksums.restype = ctypes.c_int
+    lib.rp_view_checksums.argtypes = [
+        ctypes.c_void_p,  # status int8[N*N]
+        ctypes.c_void_p,  # inc_rel int32[N*N]
+        ctypes.c_int64,  # base_inc
+        ctypes.c_void_p,  # sorted int64[N]
+        ctypes.c_char_p,  # addr_buf
+        ctypes.c_void_p,  # addr_off int64[N+1]
+        ctypes.c_char_p,  # status_buf
+        ctypes.c_void_p,  # status_off int64[codes+1]
+        ctypes.c_int64,  # n_nodes
+        ctypes.c_int8,  # none_code
+        ctypes.c_void_p,  # rows int64[n_rows]
+        ctypes.c_int64,  # n_rows
+        ctypes.c_void_p,  # out uint32[n_rows]
+        ctypes.c_int64,  # n_threads
+    ]
     _lib = lib
     return _lib
 
@@ -259,6 +276,56 @@ def farmhash32_batch(buf: np.ndarray, offsets: np.ndarray, lens: np.ndarray) -> 
     raw = buf.tobytes()
     for i in range(n):
         out[i] = _farmhash32_py(raw[offsets[i] : offsets[i] + lens[i]])
+    return out
+
+
+def view_checksums_native(
+    status: np.ndarray,  # int8[N, N]
+    inc_rel: np.ndarray,  # int32[N, N]
+    base_inc: int,
+    sorted_order: np.ndarray,  # int64[N]
+    addr_buf: bytes,
+    addr_off: np.ndarray,  # int64[N+1]
+    status_buf: bytes,
+    status_off: np.ndarray,  # int64[codes+1]
+    none_code: int,
+    rows: np.ndarray,  # int64[n_rows]
+    n_threads: int = 0,
+) -> np.ndarray | None:
+    """Reference-format checksum per requested view row, entirely in C.
+
+    Returns None when the native library is unavailable (caller falls
+    back to the pure path)."""
+    lib = _load_c_lib()
+    if lib is None:
+        return None
+    status = np.ascontiguousarray(status, dtype=np.int8)
+    inc_rel = np.ascontiguousarray(inc_rel, dtype=np.int32)
+    sorted_order = np.ascontiguousarray(sorted_order, dtype=np.int64)
+    addr_off = np.ascontiguousarray(addr_off, dtype=np.int64)
+    status_off = np.ascontiguousarray(status_off, dtype=np.int64)
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    out = np.empty(len(rows), dtype=np.uint32)
+    if n_threads <= 0:
+        n_threads = min(8, os.cpu_count() or 1)
+    rc = lib.rp_view_checksums(
+        status.ctypes.data,
+        inc_rel.ctypes.data,
+        int(base_inc),
+        sorted_order.ctypes.data,
+        addr_buf,
+        addr_off.ctypes.data,
+        status_buf,
+        status_off.ctypes.data,
+        status.shape[0],
+        int(none_code),
+        rows.ctypes.data,
+        len(rows),
+        out.ctypes.data,
+        n_threads,
+    )
+    if rc != 0:
+        return None
     return out
 
 

@@ -1,0 +1,179 @@
+"""Device-side consistent-hash ring: build + batched lookup kernels.
+
+The reference resolves one key at a time through a red-black tree
+(lib/ring.js:138-182).  The TPU-native form is data-parallel: the ring is
+a sorted ``uint32[R]`` replica-hash array with an ``int32[R]`` owner
+table, ``lookup`` of M keys is one ``searchsorted`` (same O(log R) per
+key, vectorized across the whole batch), and wraparound to the minimum
+replica (ring.js:142-145) is ``idx % R``.
+
+Replica placement is bit-identical to the host ring (hashring.py):
+``farmhash32(f"{server}{i}")`` for i in 0..replica_points-1 — on device
+via the jittable farmhash kernel — so a ring built from the same server
+set yields the same owners as the reference.
+
+Everything is shape-static and jittable; the lookup kernels compose with
+pjit/shard_map (the keys dimension shards freely — the ring tables are
+tiny and replicate).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.hashring import DEFAULT_REPLICA_POINTS
+from ringpop_tpu.ops.farmhash import farmhash32
+from ringpop_tpu.ops.farmhash_jax import farmhash32_batch_jax
+
+
+class DeviceRing(NamedTuple):
+    """Sorted replica table: the device form of lib/ring.js state."""
+
+    hashes: jax.Array  # uint32[R], sorted ascending
+    owners: jax.Array  # int32[R], owner index per replica
+
+    @property
+    def size(self) -> int:
+        return self.hashes.shape[0]
+
+
+def build_ring(
+    servers: Sequence[str], replica_points: int = DEFAULT_REPLICA_POINTS
+) -> DeviceRing:
+    """Host-side build (C farmhash): one sorted table shipped to device.
+    Owner ids index into ``servers``."""
+    hashes = np.empty(len(servers) * replica_points, dtype=np.uint32)
+    owners = np.empty(len(servers) * replica_points, dtype=np.int32)
+    pos = 0
+    for idx, server in enumerate(servers):
+        for i in range(replica_points):
+            hashes[pos] = farmhash32(f"{server}{i}")
+            owners[pos] = idx
+            pos += 1
+    # Hash ties break by server NAME, matching the host ring's
+    # (hash, server) tuple order — not by position in `servers`.
+    name_rank = np.argsort(np.argsort(np.array(servers, dtype=object)))
+    order = np.lexsort((name_rank[owners], hashes))
+    return DeviceRing(
+        hashes=jnp.asarray(hashes[order]), owners=jnp.asarray(owners[order])
+    )
+
+
+def encode_strings(strings: Sequence[str], pad_to: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """Pack strings into the (padded uint8 buffer, length) form the
+    device hash kernels consume."""
+    raw = [s.encode() for s in strings]
+    # the jittable farmhash kernel requires buffers of at least 25 bytes
+    width = pad_to or max(max((len(b) for b in raw), default=1), 25)
+    bufs = np.zeros((len(raw), width), dtype=np.uint8)
+    lens = np.zeros((len(raw),), dtype=np.int32)
+    for i, b in enumerate(raw):
+        bufs[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lens[i] = len(b)
+    return bufs, lens
+
+
+def build_ring_on_device(
+    server_bufs: jax.Array,  # uint8[S, L] padded server-name bytes
+    server_lens: jax.Array,  # int32[S]
+    replica_points: int = DEFAULT_REPLICA_POINTS,
+) -> DeviceRing:
+    """Fully on-device build: hash every ``server + str(i)`` replica name
+    (ring.js:54-57 concatenation) with the jittable farmhash kernel, then
+    sort.  Useful when the server set derives from simulation state."""
+    if replica_points > 1000:
+        raise ValueError(
+            "device ring build supports at most 1000 replica points"
+            " (3-decimal-digit replica suffixes)"
+        )
+    s, max_len = server_bufs.shape
+    digit_bytes = np.zeros((replica_points, 3), dtype=np.uint8)
+    digit_lens = np.zeros((replica_points,), dtype=np.int32)
+    for i in range(replica_points):
+        b = str(i).encode()
+        digit_bytes[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        digit_lens[i] = len(b)
+    digit_bytes = jnp.asarray(digit_bytes)
+    digit_lens = jnp.asarray(digit_lens)
+
+    out_len = max(max_len + 3, 25)  # farmhash kernel's minimum buffer
+    col = jnp.arange(out_len)
+    srv_pad = jnp.pad(server_bufs, ((0, 0), (0, out_len - max_len)))
+    rel = col[None, None, :] - server_lens[:, None, None]  # [S, 1, out_len]
+    rel = jnp.broadcast_to(rel, (s, replica_points, out_len))
+    in_server = col[None, None, :] < server_lens[:, None, None]
+    in_digit = (rel >= 0) & (rel < digit_lens[None, :, None])
+    digit_vals = jnp.take_along_axis(
+        jnp.broadcast_to(digit_bytes[None, :, :], (s, replica_points, 3)),
+        jnp.clip(rel, 0, 2),
+        axis=2,
+    )
+    names = jnp.where(
+        jnp.broadcast_to(in_server, rel.shape),
+        jnp.broadcast_to(srv_pad[:, None, :], rel.shape),
+        jnp.where(in_digit, digit_vals, 0),
+    ).astype(jnp.uint8)
+    lens = (server_lens[:, None] + digit_lens[None, :]).astype(jnp.int32)
+
+    hashes = farmhash32_batch_jax(
+        names.reshape(s * replica_points, out_len),
+        lens.reshape(s * replica_points),
+    )
+    owners = jnp.repeat(jnp.arange(s, dtype=jnp.int32), replica_points)
+    order = jnp.lexsort((owners, hashes))
+    return DeviceRing(hashes=hashes[order], owners=owners[order])
+
+
+def lookup_idx(ring: DeviceRing, key_hashes: jax.Array) -> jax.Array:
+    """Owner index per key hash — ``searchsorted`` with wraparound.
+
+    ``side='left'`` makes an exact hash hit own itself (the reference's
+    equality-inclusive upperBound, rbtree.js:262-271)."""
+    idx = jnp.searchsorted(ring.hashes, key_hashes, side="left")
+    idx = idx % ring.size  # wrap to min (ring.js:142-145)
+    return ring.owners[idx]
+
+
+def lookup_keys(ring: DeviceRing, key_bufs: jax.Array, key_lens: jax.Array) -> jax.Array:
+    """Hash keys on device (farmhash32) then resolve owners."""
+    return lookup_idx(ring, farmhash32_batch_jax(key_bufs, key_lens))
+
+
+def lookup_n_idx(
+    ring: DeviceRing, key_hashes: jax.Array, n: int, window: int | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """Preference list per key: the first ``n`` distinct owners walking
+    the ring clockwise with wraparound (ring.js:150-182 lookupN).
+
+    Scans a static window of successive replicas (the probability that
+    ``n`` distinct owners span more than W replicas decays geometrically
+    with W).  Returns ``(owners int32[M, n], complete bool[M])``:
+    ``complete[m]`` is False when the window ended before finding
+    ``min(n, server_count)`` distinct owners — callers re-resolve those
+    rows with a larger window (or the host ring) rather than trusting
+    the -1 padding."""
+    if window is None:
+        window = min(ring.size, 32 + 8 * n)
+    window = min(window, ring.size)
+    start = jnp.searchsorted(ring.hashes, key_hashes, side="left")
+    offs = (start[:, None] + jnp.arange(window)[None, :]) % ring.size
+    owners = ring.owners[offs]  # int32[M, W]
+    # first occurrence of each owner within the walk
+    eq = owners[:, :, None] == owners[:, None, :]
+    earlier = jnp.tril(jnp.ones((window, window), dtype=bool), k=-1)
+    first = ~jnp.any(eq & earlier[None, :, :], axis=2)
+    rank = jnp.cumsum(first.astype(jnp.int32), axis=1) - 1
+    m = key_hashes.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(m)[:, None], owners.shape)
+    # invalid slots scatter to column n, which mode="drop" discards
+    cols = jnp.where(first & (rank < n), rank, n)
+    out = jnp.full((m, n), -1, dtype=jnp.int32)
+    out = out.at[rows, cols].set(owners, mode="drop")
+    server_count = jnp.max(ring.owners) + 1
+    found = jnp.sum(first.astype(jnp.int32), axis=1)
+    complete = (found >= jnp.minimum(n, server_count)) | (window >= ring.size)
+    return out, complete

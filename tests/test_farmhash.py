@@ -119,3 +119,31 @@ def test_against_google_farmhash(tmp_path):
         ours, golden = map(int, line.split())
         assert ours == golden, f"len={len(c)}"
         assert farmhash32_py(c) == golden
+
+
+def test_view_checksums_native_matches_row_checksum():
+    """The threaded C batch kernel (rp_view_checksums) must be
+    bit-identical to the per-row path for random views."""
+    import numpy as np
+    from ringpop_tpu.models import checksum as cksum
+    from ringpop_tpu.models.swim_sim import NONE
+
+    from ringpop_tpu.models.swim_sim import ALIVE, FAULTY, LEAVE, SUSPECT
+
+    n = 97
+    book = cksum.AddressBook(cksum.default_addresses(n))
+    rng = np.random.default_rng(7)
+    vs = rng.choice(
+        [ALIVE, SUSPECT, FAULTY, LEAVE, NONE],
+        size=(n, n),
+        p=[0.5, 0.15, 0.15, 0.05, 0.15],
+    ).astype(np.int8)
+    vi = rng.integers(0, 1 << 30, size=(n, n), dtype=np.int32)
+    base = 1_400_000_000_000
+    batched = cksum.view_checksums(book, vs, vi, base)
+    for i in (0, 1, 13, 96):
+        assert batched[i] == cksum.row_checksum(book, vs[i], vi[i], base)
+    # Empty view row hashes the empty string deterministically.
+    vs_empty = np.full((n, n), NONE, dtype=np.int8)
+    empty = cksum.view_checksums(book, vs_empty, vi, base, [0])
+    assert empty[0] == cksum.row_checksum(book, vs_empty[0], vi[0], base)

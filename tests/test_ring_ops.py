@@ -1,0 +1,77 @@
+"""Device ring kernels vs the host HashRing (lib/ring.js contract)."""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.ops import ring_ops
+from ringpop_tpu.ops.farmhash import farmhash32
+
+SERVERS = [f"10.0.0.{i}:{3000 + i}" for i in range(20)]
+
+
+def host_ring() -> HashRing:
+    ring = HashRing()
+    ring.add_remove_servers(SERVERS, [])
+    return ring
+
+
+def test_lookup_matches_host_ring():
+    host = host_ring()
+    dev = ring_ops.build_ring(SERVERS)
+    rng = random.Random(2)
+    keys = [f"key-{rng.randrange(10 ** 12)}" for _ in range(1000)]
+    hashes = jnp.asarray(np.array([farmhash32(k) for k in keys], dtype=np.uint32))
+    owners = np.asarray(ring_ops.lookup_idx(dev, hashes))
+    for key, owner in zip(keys, owners):
+        assert SERVERS[owner] == host.lookup(key), key
+
+
+def test_lookup_on_device_hashing_matches():
+    host = host_ring()
+    dev = ring_ops.build_ring(SERVERS)
+    keys = [f"user:{i}" for i in range(257)]
+    bufs, lens = ring_ops.encode_strings(keys)
+    owners = np.asarray(
+        jax.jit(ring_ops.lookup_keys)(dev, jnp.asarray(bufs), jnp.asarray(lens))
+    )
+    for key, owner in zip(keys, owners):
+        assert SERVERS[owner] == host.lookup(key), key
+
+
+def test_build_ring_on_device_bit_identical():
+    dev_host = ring_ops.build_ring(SERVERS)
+    bufs, lens = ring_ops.encode_strings(SERVERS)
+    dev_dev = ring_ops.build_ring_on_device(jnp.asarray(bufs), jnp.asarray(lens))
+    assert np.array_equal(np.asarray(dev_host.hashes), np.asarray(dev_dev.hashes))
+    assert np.array_equal(np.asarray(dev_host.owners), np.asarray(dev_dev.owners))
+
+
+def test_lookup_n_matches_host_ring():
+    host = host_ring()
+    dev = ring_ops.build_ring(SERVERS)
+    rng = random.Random(5)
+    keys = [f"pref-{rng.randrange(10 ** 9)}" for _ in range(300)]
+    hashes = jnp.asarray(np.array([farmhash32(k) for k in keys], dtype=np.uint32))
+    n = 4
+    prefs, complete = ring_ops.lookup_n_idx(dev, hashes, n)
+    assert bool(np.asarray(complete).all())
+    prefs = np.asarray(prefs)
+    for key, row in zip(keys, prefs):
+        expect = host.lookup_n(key, n)
+        got = [SERVERS[i] for i in row if i >= 0]
+        assert got == expect, (key, got, expect)
+
+
+def test_exact_replica_hash_owns_itself():
+    """A key hashing exactly onto a replica point must resolve to that
+    replica's owner (equality-inclusive bound, rbtree.js:262-271)."""
+    dev = ring_ops.build_ring(SERVERS)
+    probe = jnp.asarray(np.asarray(dev.hashes)[7:8])
+    owner = int(ring_ops.lookup_idx(dev, probe)[0])
+    assert owner == int(np.asarray(dev.owners)[7])

@@ -145,3 +145,57 @@ def test_two_ringpops_converge_over_tcp():
             node.destroy()
 
     run(scenario(), timeout=30)
+
+
+def test_forwarding_over_tcp():
+    """handleOrProxy end to end across real sockets: the non-owner
+    forwards to the key's owner, which answers via the 'request' event
+    (test/integration/proxy-test.js shape, on the TCP transport)."""
+    from ringpop_tpu.clock import AsyncioScheduler
+    from ringpop_tpu.request_proxy.http import ProxyRequest, ProxyResponse
+    from ringpop_tpu.ringpop import RingPop
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        hosts = [f"127.0.0.1:{BASE + 50}", f"127.0.0.1:{BASE + 51}"]
+        nodes = []
+        for host_port in hosts:
+            channel = TcpChannel(host_port, loop)
+            node = RingPop(app="tcp-proxy", host_port=host_port,
+                           channel=channel, clock=AsyncioScheduler(loop))
+            node.setup_channel()
+            await channel.listen()
+            nodes.append(node)
+        boot = [loop.create_future() for _ in nodes]
+        for node, fut in zip(nodes, boot):
+            node.bootstrap(hosts, lambda err, joined=None, fut=fut:
+                           fut.set_result(err))
+        assert all(e is None for e in await asyncio.gather(*boot))
+        for _ in range(100):
+            if len({n.membership.checksum for n in nodes}) == 1:
+                break
+            await asyncio.sleep(0.05)
+
+        sender = nodes[0]
+        key = next(f"k{i}" for i in range(1000)
+                   if sender.lookup(f"k{i}") != sender.whoami())
+        owner = next(n for n in nodes if n.whoami() == sender.lookup(key))
+
+        def on_request(req, res, head):
+            assert head["ringpopKeys"] == [key]
+            res.status_code = 200
+            res.end(f"handled:{req.body}")
+
+        owner.on("request", on_request)
+
+        done: asyncio.Future = loop.create_future()
+        req = ProxyRequest(url="/data", method="PUT", body="payload")
+        res = ProxyResponse(lambda err, resp: done.set_result((err, resp)))
+        assert sender.handle_or_proxy(key, req, res) is None
+        err, resp = await asyncio.wait_for(done, 10)
+        assert err is None
+        assert resp.body == "handled:payload"
+        for node in nodes:
+            node.destroy()
+
+    run(scenario(), timeout=30)
