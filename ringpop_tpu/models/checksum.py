@@ -93,6 +93,12 @@ def view_checksums(
     if indices is None:
         indices = range(view_status.shape[0])
     rows = np.fromiter((int(i) for i in indices), dtype=np.int64)
+    n_rows_total = view_status.shape[0]
+    # NumPy-style negative indexing, validated BEFORE the indices reach
+    # C pointer arithmetic (which has no bounds checks).
+    rows = np.where(rows < 0, rows + n_rows_total, rows)
+    if ((rows < 0) | (rows >= n_rows_total)).any():
+        raise IndexError(f"row index out of range for {n_rows_total} rows")
     if len(rows):
         native = farmhash.view_checksums_native(
             np.asarray(view_status, dtype=np.int8),

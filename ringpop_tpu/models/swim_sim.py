@@ -261,13 +261,35 @@ def _choose_targets(pingable: jax.Array, key: jax.Array) -> tuple[jax.Array, jax
     The reference walks a per-round shuffled round-robin
     (membership-iterator.js:33-52); uniform sampling keeps the same
     distribution over targets without N x N iterator state.
-    """
+
+    Selection is an exact rank pick: one uniform per node chooses the
+    k-th pingable member via a row cumsum — O(N^2) cheap integer work
+    instead of an N x N counter-based-PRNG matrix (threefry bits were
+    half the tick's cost)."""
     n = pingable.shape[0]
-    g = jax.random.gumbel(key, (n, n), dtype=jnp.float32)
-    score = jnp.where(pingable, g, -jnp.inf)
-    target = jnp.argmax(score, axis=1).astype(jnp.int32)
-    has = jnp.any(pingable, axis=1)
+    count = jnp.sum(pingable, axis=1, dtype=jnp.int32)
+    u = jax.random.uniform(key, (n,))
+    kth = jnp.floor(u * count).astype(jnp.int32)  # uniform in [0, count)
+    csum = jnp.cumsum(pingable.astype(jnp.int32), axis=1)
+    hit = pingable & (csum == (kth + 1)[:, None])
+    target = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    has = count > 0
     return jnp.where(has, target, -1), has
+
+
+def _rand_scores(key: jax.Array, n: int) -> jax.Array:
+    """uint32[N, N] statistical-quality random scores from one scalar
+    draw + an integer mix per element.  Replaces an N x N threefry
+    tensor for witness sampling: the protocol needs unbiased *selection*,
+    not cryptographic bits, and threefry dominated the step cost."""
+    seed = jax.random.bits(key, dtype=jnp.uint32)
+    i = jnp.arange(n, dtype=jnp.uint32)
+    h = seed ^ (i[:, None] * jnp.uint32(0x9E3779B1)) ^ (
+        i[None, :] * jnp.uint32(0x85EBCA77)
+    )
+    h = (h ^ (h >> jnp.uint32(15))) * jnp.uint32(0xC2B2AE3D)
+    h = (h ^ (h >> jnp.uint32(13))) * jnp.uint32(0x27D4EB2F)
+    return h ^ (h >> jnp.uint32(16))
 
 
 def _choose_witnesses(
@@ -278,11 +300,20 @@ def _choose_witnesses(
     n = pingable.shape[0]
     cols = jnp.arange(n, dtype=jnp.int32)
     mask = pingable & (cols[None, :] != jnp.where(target < 0, n, target)[:, None])
-    g = jax.random.gumbel(key, (n, n), dtype=jnp.float32)
-    score = jnp.where(mask, g, -jnp.inf)
-    top = jax.lax.top_k(score, k)
-    valid = jnp.isfinite(top[0])
-    return top[1].astype(jnp.int32), valid
+    # 31-bit non-negative scores; invalid entries are -1.  k is tiny and
+    # static, so k argmax-and-mask passes select the top-k (lax.top_k on
+    # int32 hits a pathologically slow path: ~100x argmax).
+    score = jnp.where(
+        mask, (_rand_scores(key, n) >> jnp.uint32(1)).astype(jnp.int32), -1
+    )
+    picks = []
+    valids = []
+    for _ in range(k):
+        idx = jnp.argmax(score, axis=1).astype(jnp.int32)
+        picks.append(idx)
+        valids.append(jnp.take_along_axis(score, idx[:, None], axis=1)[:, 0] >= 0)
+        score = jnp.where(cols[None, :] == idx[:, None], -1, score)
+    return jnp.stack(picks, axis=1), jnp.stack(valids, axis=1)
 
 
 def _drop(key: jax.Array, shape: tuple, loss: float) -> jax.Array:

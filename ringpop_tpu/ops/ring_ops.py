@@ -44,16 +44,23 @@ class DeviceRing(NamedTuple):
 def build_ring(
     servers: Sequence[str], replica_points: int = DEFAULT_REPLICA_POINTS
 ) -> DeviceRing:
-    """Host-side build (C farmhash): one sorted table shipped to device.
-    Owner ids index into ``servers``."""
-    hashes = np.empty(len(servers) * replica_points, dtype=np.uint32)
-    owners = np.empty(len(servers) * replica_points, dtype=np.int32)
-    pos = 0
-    for idx, server in enumerate(servers):
-        for i in range(replica_points):
-            hashes[pos] = farmhash32(f"{server}{i}")
-            owners[pos] = idx
-            pos += 1
+    """Host-side build (one batched C farmhash call): a sorted table
+    shipped to device.  Owner ids index into ``servers``."""
+    from ringpop_tpu.ops.farmhash import farmhash32_batch
+
+    names = [
+        f"{server}{i}".encode()
+        for server in servers
+        for i in range(replica_points)
+    ]
+    buf = np.frombuffer(b"".join(names), dtype=np.uint8)
+    lens = np.array([len(b) for b in names], dtype=np.int64)
+    offsets = np.zeros(len(names), dtype=np.int64)
+    np.cumsum(lens[:-1], out=offsets[1:])
+    hashes = farmhash32_batch(buf, offsets, lens)
+    owners = np.repeat(
+        np.arange(len(servers), dtype=np.int32), replica_points
+    )
     # Hash ties break by server NAME, matching the host ring's
     # (hash, server) tuple order — not by position in `servers`.
     name_rank = np.argsort(np.argsort(np.array(servers, dtype=object)))
@@ -68,6 +75,8 @@ def encode_strings(strings: Sequence[str], pad_to: int | None = None) -> tuple[n
     device hash kernels consume."""
     raw = [s.encode() for s in strings]
     # the jittable farmhash kernel requires buffers of at least 25 bytes
+    if pad_to is not None and pad_to < 25:
+        raise ValueError("pad_to must be >= 25 (farmhash kernel minimum)")
     width = pad_to or max(max((len(b) for b in raw), default=1), 25)
     bufs = np.zeros((len(raw), width), dtype=np.uint8)
     lens = np.zeros((len(raw),), dtype=np.int32)
@@ -81,10 +90,17 @@ def build_ring_on_device(
     server_bufs: jax.Array,  # uint8[S, L] padded server-name bytes
     server_lens: jax.Array,  # int32[S]
     replica_points: int = DEFAULT_REPLICA_POINTS,
+    name_rank: jax.Array | None = None,  # int32[S] lexicographic rank
 ) -> DeviceRing:
     """Fully on-device build: hash every ``server + str(i)`` replica name
     (ring.js:54-57 concatenation) with the jittable farmhash kernel, then
-    sort.  Useful when the server set derives from simulation state."""
+    sort.  Useful when the server set derives from simulation state.
+
+    Replica-hash ties break by ``name_rank`` (each server's rank in
+    name-sorted order — what the host ring's (hash, server) tuple order
+    does).  Without it, ties break by position in ``server_bufs``; pass
+    name-sorted servers or supply ``name_rank`` for bit-parity with the
+    host ring on 32-bit hash collisions."""
     if replica_points > 1000:
         raise ValueError(
             "device ring build supports at most 1000 replica points"
@@ -124,7 +140,8 @@ def build_ring_on_device(
         lens.reshape(s * replica_points),
     )
     owners = jnp.repeat(jnp.arange(s, dtype=jnp.int32), replica_points)
-    order = jnp.lexsort((owners, hashes))
+    tie = owners if name_rank is None else jnp.asarray(name_rank)[owners]
+    order = jnp.lexsort((tie, hashes))
     return DeviceRing(hashes=hashes[order], owners=owners[order])
 
 

@@ -47,7 +47,10 @@ def test_lookup_on_device_hashing_matches():
 def test_build_ring_on_device_bit_identical():
     dev_host = ring_ops.build_ring(SERVERS)
     bufs, lens = ring_ops.encode_strings(SERVERS)
-    dev_dev = ring_ops.build_ring_on_device(jnp.asarray(bufs), jnp.asarray(lens))
+    name_rank = np.argsort(np.argsort(np.array(SERVERS, dtype=object))).astype(np.int32)
+    dev_dev = ring_ops.build_ring_on_device(
+        jnp.asarray(bufs), jnp.asarray(lens), name_rank=jnp.asarray(name_rank)
+    )
     assert np.array_equal(np.asarray(dev_host.hashes), np.asarray(dev_dev.hashes))
     assert np.array_equal(np.asarray(dev_host.owners), np.asarray(dev_dev.owners))
 
