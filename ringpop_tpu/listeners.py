@@ -52,6 +52,14 @@ def create_membership_update_listener(ringpop: Any):
                 ringpop.suspicion.stop(update)
             ringpop.dissemination.record_change(update)
 
+        if ringpop.damping is not None:
+            ringpop.damping.record_updates(updates)
+            ringpop.damping.decay_tick()
+            # damped members stay out of the ring until reinstated
+            servers_to_add = [
+                s for s in servers_to_add if not ringpop.damping.is_damped(s)
+            ]
+
         if servers_to_add or servers_to_remove:
             ring_changed = ringpop.ring.add_remove_servers(
                 servers_to_add, servers_to_remove

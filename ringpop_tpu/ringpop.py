@@ -74,6 +74,8 @@ class RingPop(EventEmitter):
         request_proxy_retry_schedule: list[float] | None = None,
         enforce_consistency: bool | None = None,
         faulty_probe_period: int | None = 10,
+        damping_enabled: bool = False,
+        damping_options: dict[str, float] | None = None,
     ):
         super().__init__()
 
@@ -117,6 +119,8 @@ class RingPop(EventEmitter):
             membership_update_flush_interval or MEMBERSHIP_UPDATE_FLUSH_INTERVAL
         )
 
+        self.damping = None  # set after wiring; listeners null-check it
+
         self.request_proxy = RequestProxy(
             self,
             max_retries=request_proxy_max_retries,
@@ -135,6 +139,14 @@ class RingPop(EventEmitter):
             self, flush_interval=self.membership_update_flush_interval
         )
         create_event_forwarder(self)
+
+        # EXTENSION: flap damping — documented by the reference
+        # (docs/architecture_design.md:73-82) but never implemented there
+        # (SURVEY §5.3).  Off by default for strict reference behavior.
+        if damping_enabled:
+            from ringpop_tpu.damping import MemberDamping
+
+            self.damping = MemberDamping(self, **(damping_options or {}))
 
         # rates tick on the injected clock so virtual-time runs stay
         # deterministic (Meter defaults to wall time otherwise)
@@ -314,6 +326,10 @@ class RingPop(EventEmitter):
     def ping_member_now(self, callback: Callable[..., None] | None = None) -> None:
         callback = callback or (lambda *a: None)
 
+        if self.damping is not None:
+            # a quiet cluster must still reinstate decayed members
+            self.damping.decay_tick()
+
         if self.is_pinging:
             self.logger.warn("aborting ping because one is in progress")
             return callback()
@@ -456,6 +472,7 @@ class RingPop(EventEmitter):
     def get_stats(self) -> dict[str, Any]:
         timestamp = self.clock.now()
         stats = {
+            "damping": self.damping.get_stats() if self.damping else None,
             "hooks": self.get_stats_hooks_stats(),
             "membership": self.membership.get_stats(),
             "process": {"pid": os.getpid()},

@@ -1,0 +1,132 @@
+"""Flap damping (EXTENSION — documented by the reference at
+docs/architecture_design.md:73-82, never implemented there)."""
+
+from __future__ import annotations
+
+from ringpop_tpu.harness import test_ringpop
+from ringpop_tpu.member import Status
+
+
+def make_rp(**damping_options):
+    return test_ringpop(
+        host_port="10.0.0.1:3000",
+        damping_enabled=True,
+        damping_options=damping_options,
+    )
+
+
+def flap(rp, addr: str, times: int, inc: int = 1) -> int:
+    """Drive alive<->suspect transitions through membership.update."""
+    for _ in range(times):
+        rp.membership.update(
+            {"address": addr, "status": Status.suspect, "incarnationNumber": inc}
+        )
+        inc += 1
+        rp.membership.update(
+            {"address": addr, "status": Status.alive, "incarnationNumber": inc}
+        )
+        inc += 1
+    return inc
+
+
+def test_flapping_member_gets_damped_and_leaves_ring():
+    rp = make_rp()
+    addr = "10.0.0.2:3000"
+    rp.membership.make_alive(addr, 1)
+    assert rp.ring.has_server(addr)
+
+    events = []
+    rp.on("memberDamped", lambda a: events.append(a))
+    flap(rp, addr, times=4)  # 8 flaps x 500 penalty > 2500 suppress limit
+
+    assert rp.damping.is_damped(addr)
+    assert events == [addr]
+    assert not rp.ring.has_server(addr)
+    # ...but membership still tracks it (damping is a ring-level quarantine)
+    assert rp.membership.find_member_by_address(addr) is not None
+
+
+def test_stable_member_never_damped():
+    rp = make_rp()
+    addr = "10.0.0.3:3000"
+    rp.membership.make_alive(addr, 1)
+    # Repeated same-status updates (fresh incarnations) are not flaps.
+    for inc in range(2, 20):
+        rp.membership.update(
+            {"address": addr, "status": Status.alive, "incarnationNumber": inc}
+        )
+    assert rp.damping.score_of(addr) == 0.0
+    assert rp.ring.has_server(addr)
+
+
+def test_score_decays_and_member_reinstated():
+    rp = make_rp(decay_half_life_ms=1000.0)
+    addr = "10.0.0.4:3000"
+    rp.membership.make_alive(addr, 1)
+    inc = flap(rp, addr, times=4)
+    assert rp.damping.is_damped(addr)
+
+    # Half-life 1s: after ~4s the score is ~1/16 of ~4000 < reuse limit.
+    rp.clock.advance(5000)
+    undamped = []
+    rp.on("memberUndamped", lambda a: undamped.append(a))
+    # Any ordinary update triggers re-evaluation via decay_tick.
+    rp.membership.update(
+        {"address": addr, "status": Status.alive, "incarnationNumber": inc + 1}
+    )
+    assert not rp.damping.is_damped(addr)
+    assert undamped == [addr]
+    assert rp.ring.has_server(addr)
+
+
+def test_damping_off_by_default_preserves_reference_behavior():
+    rp = test_ringpop(host_port="10.0.0.1:3000")
+    assert rp.damping is None
+    addr = "10.0.0.5:3000"
+    rp.membership.make_alive(addr, 1)
+    flap(rp, addr, times=10)
+    assert rp.ring.has_server(addr)  # never evicted without damping
+
+
+def test_damping_stats_surface():
+    rp = make_rp()
+    addr = "10.0.0.6:3000"
+    rp.membership.make_alive(addr, 1)
+    flap(rp, addr, times=4)
+    stats = rp.get_stats()["damping"]
+    assert stats["damped"] == [addr]
+    assert stats["scores"][addr] > 0
+
+
+def test_quiet_cluster_reinstates_via_protocol_period():
+    """Regression: reinstatement must not require new membership updates —
+    the protocol-period hook re-evaluates decayed scores."""
+    rp = make_rp(decay_half_life_ms=1000.0)
+
+    class DroppingChannel:  # the fixture has no transport; pings just fail
+        destroyed = False
+
+        def request(self, host, endpoint, head, body, timeout_ms, cb):
+            rp.clock.call_soon(lambda: cb(Exception("no transport")))
+
+    rp.channel = DroppingChannel()
+    addr = "10.0.0.7:3000"
+    rp.membership.make_alive(addr, 1)
+    flap(rp, addr, times=4)
+    assert rp.damping.is_damped(addr)
+
+    rp.clock.advance(6000)       # quiet: no updates at all
+    rp.ping_member_now()         # one protocol period fires decay_tick
+    assert not rp.damping.is_damped(addr)
+    assert rp.ring.has_server(addr)
+
+
+def test_damping_ring_changes_emit_ring_changed():
+    rp = make_rp(decay_half_life_ms=1000.0)
+    addr = "10.0.0.8:3000"
+    rp.membership.make_alive(addr, 1)
+    ring_events = []
+    rp.on("ringChanged", lambda *a: ring_events.append(1))
+    flap(rp, addr, times=4)
+    assert rp.damping.is_damped(addr)
+    assert ring_events, "damping eviction did not emit ringChanged"
