@@ -47,6 +47,8 @@ def save(cluster: SimCluster, path: str) -> None:
         "addresses": np.asarray(cluster.book.addresses, dtype=np.str_),
     }
     for name, leaf in cluster.state._asdict().items():
+        if leaf is None:  # optional extension tensors (damping)
+            continue
         arrays[f"state.{name}"] = np.asarray(leaf)
     for name, leaf in cluster.net._asdict().items():
         arrays[f"net.{name}"] = np.asarray(leaf)
@@ -70,12 +72,17 @@ def load(path: str, device: Any | None = None) -> SimCluster:
             addresses=addresses,
             base_inc=meta["base_inc"],
         )
-        cluster.state = ClusterState(
-            **{
-                name: jax.numpy.asarray(data[f"state.{name}"])
-                for name in ClusterState._fields
-            }
-        )
+        optional = {"damp", "damped"}  # extension tensors may be absent
+        leaves = {}
+        for name in ClusterState._fields:
+            key_name = f"state.{name}"
+            if key_name in data:
+                leaves[name] = jax.numpy.asarray(data[key_name])
+            elif name in optional:
+                leaves[name] = None
+            else:
+                raise KeyError(f"checkpoint missing required array {key_name}")
+        cluster.state = ClusterState(**leaves)
         cluster.net = NetState(
             **{name: jax.numpy.asarray(data[f"net.{name}"]) for name in NetState._fields}
         )

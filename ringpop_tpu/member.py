@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any
 
 
@@ -12,6 +13,23 @@ class Status:
     suspect = "suspect"
 
     ALL = (alive, faulty, leave, suspect)
+
+
+@dataclass(frozen=True)
+class MemberUpdate:
+    """Shape of a disseminated membership change (reference:
+    lib/member-update.js — a documentation-value record there too; the
+    wire shape is the dict produced by ``Member.to_change`` plus the
+    provenance fields stamped in membership.make_update,
+    dissemination.js:169-176)."""
+
+    id: str | None = None
+    source: str | None = None
+    source_incarnation_number: int | None = None
+    address: str | None = None
+    status: str | None = None
+    incarnation_number: int | None = None
+    timestamp: float | None = None
 
 
 class Member:

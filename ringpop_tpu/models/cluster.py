@@ -53,6 +53,7 @@ class SimCluster:
         inc: Sequence[int] | None = None,
         init: str = "converged",
         device: Any | None = None,
+        damping: bool = False,
     ):
         self.params = params
         self.book = cksum.AddressBook(addresses or cksum.default_addresses(n))
@@ -62,7 +63,9 @@ class SimCluster:
         rel = np.zeros(n, dtype=np.int32) if inc is None else (
             np.asarray(inc, dtype=np.int64) - base_inc
         ).astype(np.int32)
-        self.state: ClusterState = sim.init_state(n, jnp.asarray(rel), mode=init)
+        self.state: ClusterState = sim.init_state(
+            n, jnp.asarray(rel), mode=init, damping=damping
+        )
         self.net: NetState = sim.make_net(n)
         self.key = jax.random.PRNGKey(seed)
         self.metrics_log: list[dict[str, int]] = []
@@ -145,14 +148,27 @@ class SimCluster:
     def ring_for(self, viewer: int) -> HashRing:
         ring = HashRing()
         # alive members are added and faulty/leave removed; suspects stay
-        # in the ring (membership-update-listener.js:34-45)
+        # in the ring (membership-update-listener.js:34-45); damped
+        # members are quarantined from the ring (damping extension)
+        damped_row = (
+            np.asarray(self.state.damped[viewer])
+            if self.state.damped is not None
+            else None
+        )
         servers = [
             m["address"]
             for m in self.members(viewer)
             if m["status"] in ("alive", "suspect")
+            and (damped_row is None or not damped_row[self.book.index[m["address"]]])
         ]
         ring.add_remove_servers(servers, [])
         return ring
+
+    def damped_pairs(self) -> int:
+        """Total (viewer, subject) damped entries (damping extension)."""
+        if self.state.damped is None:
+            return 0
+        return int(jnp.sum(self.state.damped))
 
     def lookup(self, key: str, viewer: int = 0) -> str | None:
         return self.ring_for(viewer).lookup(key)

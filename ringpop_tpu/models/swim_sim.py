@@ -93,6 +93,14 @@ class SwimParams(NamedTuple):
     piggyback_factor: int = 15  # dissemination.js:133-136
     ping_req_size: int = 3  # index.js:99
     loss: float = 0.0  # iid per-message drop probability
+    # Flap damping (EXTENSION; active only when the state carries damp
+    # tensors — init_state(damping=True)).  Mirrors damping.py: penalty
+    # per flap, exponential decay, suppress/reuse hysteresis.  Default
+    # decay 0.5 ** (tick / half-life) with 60 s half-life @ 200 ms ticks.
+    damp_penalty: float = 500.0
+    damp_suppress: float = 2500.0
+    damp_reuse: float = 500.0
+    damp_decay_per_tick: float = 0.5 ** (0.2 / 60.0)
 
 
 class ClusterState(NamedTuple):
@@ -114,6 +122,10 @@ class ClusterState(NamedTuple):
     src_inc: jax.Array  # int32[N, N]
     suspect_at: jax.Array  # int32[N, N]
     tick: jax.Array  # int32[]
+    # Flap-damping extension (None = disabled, zero cost): viewer i's damp
+    # score for j and the hysteresis "currently damped" bit (damping.py).
+    damp: jax.Array | None = None  # float16[N, N]
+    damped: jax.Array | None = None  # bool[N, N]
 
     @property
     def n(self) -> int:
@@ -143,7 +155,11 @@ def make_net(n: int) -> NetState:
 
 
 def init_state(
-    n: int, inc: jax.Array | None = None, *, mode: str = "converged"
+    n: int,
+    inc: jax.Array | None = None,
+    *,
+    mode: str = "converged",
+    damping: bool = False,
 ) -> ClusterState:
     """Fresh cluster state.
 
@@ -172,6 +188,8 @@ def init_state(
         src_inc=jnp.full((n, n), -1, dtype=jnp.int32),
         suspect_at=jnp.full((n, n), -1, dtype=jnp.int32),
         tick=jnp.zeros((), dtype=jnp.int32),
+        damp=jnp.zeros((n, n), dtype=jnp.float16) if damping else None,
+        damped=jnp.zeros((n, n), dtype=bool) if damping else None,
     )
 
 
@@ -329,6 +347,7 @@ class _Merge(NamedTuple):
     state: ClusterState
     applied: jax.Array  # bool[N, N] — change applied (incl. refutations)
     refuted: jax.Array  # bool[N] — receiver re-asserted itself alive
+    flapped: jax.Array  # bool[N, N] — applied status transition touching alive
 
 
 def _merge_incoming(
@@ -374,6 +393,14 @@ def _merge_incoming(
         & ~eye  # self entries only change via refutation / local ops
     )
 
+    # Flap: an applied transition between alive and suspect/faulty in
+    # either direction (damping.py _FLAP_SET semantics; extension).
+    was = state.view_status
+    flapped = apply & (
+        ((was == ALIVE) & ((in_status == SUSPECT) | (in_status == FAULTY)))
+        | (((was == SUSPECT) | (was == FAULTY)) & (in_status == ALIVE))
+    )
+
     view_status = jnp.where(apply, in_status, state.view_status)
     view_inc = jnp.where(apply, in_inc, state.view_inc)
     src = jnp.where(apply, in_src, state.src)
@@ -410,6 +437,7 @@ def _merge_incoming(
         ),
         applied,
         refuted,
+        flapped,
     )
 
 
@@ -424,7 +452,7 @@ def _declare(
     viewer_mask: jax.Array,  # bool[N]
     subject: jax.Array,  # int32[N] (index per viewer; clipped where invalid)
     new_status: int,
-) -> ClusterState:
+) -> tuple[ClusterState, jax.Array]:
     """Local declaration (makeSuspect / makeFaulty, membership.js:141-156):
     viewer i re-labels ``subject[i]`` with its currently-known incarnation,
     applying only where the lattice admits it, and records a self-sourced
@@ -455,7 +483,8 @@ def _declare(
         sus = sus.at[ids, subj].set(
             jnp.where(ok, state.tick, sus[ids, subj]).astype(jnp.int32)
         )
-    return state._replace(view_status=vs, pb=pb, src=src, src_inc=src_inc, suspect_at=sus)
+    state = state._replace(view_status=vs, pb=pb, src=src, src_inc=src_inc, suspect_at=sus)
+    return state, ok
 
 
 # ---------------------------------------------------------------------------
@@ -627,7 +656,8 @@ def swim_step_impl(
     # inconclusive (:268-282)
     definite_fail = jnp.any(req_ok & ~wt_ok & relay_ok, axis=1)
     declare_suspect = failed & ~any_success & definite_fail
-    state = _declare(state, declare_suspect, t_safe, SUSPECT)
+    was_alive_at_target = state.view_status[ids, jnp.clip(t_safe, 0, n - 1)] == ALIVE
+    state, declared = _declare(state, declare_suspect, t_safe, SUSPECT)
 
     # -- phase 6: suspicion deadlines fire -> faulty (suspicion.js:66-69) --
     expired = (
@@ -645,6 +675,26 @@ def swim_step_impl(
         view_status=vs, pb=pb, src=src, src_inc=src_inc, suspect_at=sus
     )
 
+    # -- damping extension (active only with damp tensors present) ---------
+    n_damped = jnp.int32(0)
+    if state.damp is not None:
+        flaps = merged.flapped | merged2.flapped
+        # a viewer that itself declares alive->suspect flaps too (the host
+        # library scores these via the membership 'updated' event)
+        declare_flap = declared & was_alive_at_target
+        flaps = flaps.at[ids, jnp.clip(t_safe, 0, n - 1)].max(declare_flap)
+        damp = (
+            state.damp.astype(jnp.float32) * params.damp_decay_per_tick
+            + jnp.where(flaps, jnp.float32(params.damp_penalty), 0.0)
+        ).astype(jnp.float16)
+        damped = jnp.where(
+            damp > params.damp_suppress,
+            True,
+            jnp.where(damp < params.damp_reuse, False, state.damped),
+        )
+        state = state._replace(damp=damp, damped=damped)
+        n_damped = jnp.sum(damped, dtype=jnp.int32)
+
     state = state._replace(tick=state.tick + 1)
     metrics = {
         "pings_sent": jnp.sum(sends, dtype=jnp.int32),
@@ -655,6 +705,7 @@ def swim_step_impl(
         "ping_reqs": jnp.sum(failed, dtype=jnp.int32),
         "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
         "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
+        "damped_pairs": n_damped,
     }
     return state, metrics
 
@@ -741,7 +792,7 @@ def revive(state: ClusterState, node: int, inc: int) -> ClusterState:
     n = state.n
     row = jnp.where(jnp.arange(n) == node, ALIVE, NONE).astype(jnp.int8)
     inc_row = jnp.where(jnp.arange(n) == node, jnp.int32(inc), 0)
-    return state._replace(
+    state = state._replace(
         view_status=state.view_status.at[node].set(row),
         view_inc=state.view_inc.at[node].set(inc_row),
         pb=state.pb.at[node].set(-1),
@@ -749,3 +800,9 @@ def revive(state: ClusterState, node: int, inc: int) -> ClusterState:
         src_inc=state.src_inc.at[node].set(-1),
         suspect_at=state.suspect_at.at[node].set(-1),
     )
+    if state.damp is not None:  # a fresh process has no damp memory
+        state = state._replace(
+            damp=state.damp.at[node].set(jnp.float16(0)),
+            damped=state.damped.at[node].set(False),
+        )
+    return state

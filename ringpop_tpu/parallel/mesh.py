@@ -57,8 +57,9 @@ def make_mesh(n_devices: int | None = None, devices: Any = None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
-def state_sharding(mesh: Mesh) -> ClusterState:
-    """Pytree of NamedShardings matching ClusterState."""
+def state_sharding(mesh: Mesh, damping: bool = False) -> ClusterState:
+    """Pytree of NamedShardings matching ClusterState.  ``damping``
+    must match whether the state carries damp tensors (init_state)."""
     row = NamedSharding(mesh, P(AXIS, None))
     rep = NamedSharding(mesh, P())
     return ClusterState(
@@ -69,6 +70,8 @@ def state_sharding(mesh: Mesh) -> ClusterState:
         src_inc=row,
         suspect_at=row,
         tick=rep,
+        damp=row if damping else None,
+        damped=row if damping else None,
     )
 
 
@@ -85,32 +88,46 @@ def shard_cluster(
     d = mesh.devices.size
     if n % d != 0:
         raise ValueError(f"n={n} must be divisible by mesh size {d}")
+    damping = state.damp is not None
     return (
-        jax.device_put(state, state_sharding(mesh)),
+        jax.device_put(state, state_sharding(mesh, damping)),
         jax.device_put(net, net_sharding(mesh)),
     )
 
 
-def sharded_step(mesh: Mesh) -> Callable:
+def sharded_step(
+    mesh: Mesh, damping: bool = False, like: ClusterState | None = None
+) -> Callable:
     """``swim_step`` compiled for the mesh: (state, net, key, params) ->
-    (state, metrics), state rows pinned to their owning chips."""
+    (state, metrics), state rows pinned to their owning chips.
+
+    Pass ``like=state`` to infer the damping layout from the state itself
+    (a mismatched manual flag fails deep inside jit with an opaque
+    pytree-structure error)."""
+    if like is not None:
+        damping = like.damp is not None
     rep = NamedSharding(mesh, P())
     return jax.jit(
         swim_step_impl,
         static_argnames=("params",),
-        in_shardings=(state_sharding(mesh), net_sharding(mesh), rep),
-        out_shardings=(state_sharding(mesh), rep),
+        in_shardings=(state_sharding(mesh, damping), net_sharding(mesh), rep),
+        out_shardings=(state_sharding(mesh, damping), rep),
         donate_argnums=(0,),
     )
 
 
-def sharded_run(mesh: Mesh) -> Callable:
-    """``swim_run`` (lax.scan over ticks) compiled for the mesh."""
+def sharded_run(
+    mesh: Mesh, damping: bool = False, like: ClusterState | None = None
+) -> Callable:
+    """``swim_run`` (lax.scan over ticks) compiled for the mesh.  See
+    ``sharded_step`` for ``like``."""
+    if like is not None:
+        damping = like.damp is not None
     rep = NamedSharding(mesh, P())
     return jax.jit(
         swim_run_impl,
         static_argnames=("params", "ticks"),
-        in_shardings=(state_sharding(mesh), net_sharding(mesh), rep),
-        out_shardings=(state_sharding(mesh), rep),
+        in_shardings=(state_sharding(mesh, damping), net_sharding(mesh), rep),
+        out_shardings=(state_sharding(mesh, damping), rep),
         donate_argnums=(0,),
     )

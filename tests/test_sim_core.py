@@ -180,3 +180,61 @@ def test_swim_run_scan_matches_steps():
     # only assert structural invariants, not equality of random streams
     assert int(st_a.tick) == 4
     assert int(st_b.tick) == 4
+
+
+def test_sim_damping_flapping_node_quarantined_then_reinstated():
+    """Damping extension in the simulation: a node that flaps (driven by
+    forced suspect declarations + refutations) accumulates damp score at
+    its peers, crosses the suppress limit, disappears from derived rings,
+    then decays back in (mirrors damping.py semantics)."""
+    import numpy as np
+    from ringpop_tpu.models import swim_sim as sim
+    from ringpop_tpu.models.cluster import SimCluster
+
+    params = sim.SwimParams(
+        damp_penalty=1000.0,
+        damp_suppress=2000.0,
+        damp_reuse=400.0,
+        damp_decay_per_tick=0.98,
+    )
+    c = SimCluster(12, params, seed=3, damping=True)
+    flappy = 4
+
+    # Force flaps: repeatedly suspend flappy until peers suspect it, then
+    # resume so its refutation (alive) propagates — transitions touching
+    # alive on every peer that applies them.
+    for _ in range(8):
+        c.suspend(flappy)
+        c.tick(4)
+        c.resume(flappy)
+        c.tick(4)
+
+    assert c.damped_pairs() > 0, "no damped pairs after repeated flapping"
+    viewers = [i for i in range(12) if i != flappy]
+    damped_row = np.asarray(c.state.damped)
+    some_viewer = next(i for i in viewers if damped_row[i, flappy])
+    ring = c.ring_for(some_viewer)
+    assert not ring.has_server(c.book.addresses[flappy])
+
+    # Quiet decay: scores fall below reuse, damped bits clear.
+    c.tick(250)
+    assert c.damped_pairs() == 0
+    ring = c.ring_for(some_viewer)
+    assert ring.has_server(c.book.addresses[flappy])
+
+
+def test_sim_damping_checkpoint_roundtrip(tmp_path):
+    from ringpop_tpu import checkpoint
+    from ringpop_tpu.models import swim_sim as sim
+    from ringpop_tpu.models.cluster import SimCluster
+    import numpy as np
+
+    c = SimCluster(8, sim.SwimParams(), seed=1, damping=True)
+    c.tick(3)
+    path = str(tmp_path / "damp.npz")
+    checkpoint.save(c, path)
+    r = checkpoint.load(path)
+    assert r.state.damp is not None and r.state.damped is not None
+    assert np.array_equal(np.asarray(c.state.damp), np.asarray(r.state.damp))
+    r.tick(2); c.tick(2)
+    assert np.array_equal(np.asarray(c.state.damped), np.asarray(r.state.damped))
