@@ -72,7 +72,13 @@ def load(path: str, device: Any | None = None) -> SimCluster:
             addresses=addresses,
             base_inc=meta["base_inc"],
         )
-        optional = {"damp", "damped"}  # extension tensors may be absent
+        # Optional extension tensors (None-default fields) may be absent —
+        # derived from the NamedTuple defaults so save/load stay in lockstep.
+        optional = {
+            name
+            for name, default in ClusterState._field_defaults.items()
+            if default is None
+        }
         leaves = {}
         for name in ClusterState._fields:
             key_name = f"state.{name}"

@@ -1,0 +1,234 @@
+"""Pallas TPU kernel: batched FarmHash32 (Fingerprint32).
+
+The jnp implementation (farmhash_jax.py) expresses each dynamic byte
+fetch as a per-row ``dynamic_slice`` under ``vmap``, which XLA lowers to
+general gathers — serialized scalar traffic on TPU.  This kernel
+restructures the algorithm for the VPU:
+
+* one VMEM tile holds a block of rows; a **word plane** ``W[:, i]`` =
+  little-endian uint32 at byte offset ``i`` is built once from four
+  shifted static slices;
+* every *data-dependent* fetch (the head/tail reads whose offsets depend
+  on the string length) becomes a **masked reduction** over the word
+  plane — an 8x128 vector op, no gather;
+* the main >24-byte loop reads at *static* offsets (it always starts at
+  byte 0), so it unrolls into plain slices;
+* all four length variants are computed branchlessly and selected per
+  row, exactly like the jnp version.
+
+Bit-identical to ops/farmhash.py (C / Python) and farmhash_jax.py —
+cross-checked in tests/test_farmhash_pallas.py, which also runs the
+kernel in interpret mode so CPU CI covers it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+# Constants above 2**31 cannot appear as raw Python ints (x64-disabled
+# canonicalization overflows) nor as module-level jnp arrays (pallas
+# rejects captured consts) — _u32 creates scalar literals at trace time.
+def _u32(x: int):
+    return jnp.uint32(x)
+
+
+_C2 = 0x1B873593  # < 2**31: safe as a weak Python int
+
+
+def _c1():
+    return _u32(0xCC9E2D51)
+
+
+def _magic():
+    return _u32(0xE6546B64)
+
+ROW_BLOCK = 128
+
+
+def _rotr(v, s: int):
+    if s == 0:
+        return v
+    return (v >> s) | (v << (32 - s))
+
+
+def _fmix(h):
+    h = h ^ (h >> 16)
+    h = h * _u32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * _u32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _mur(a, h):
+    a = a * _c1()
+    a = _rotr(a, 17)
+    a = a * _C2
+    h = h ^ a
+    h = _rotr(h, 19)
+    return h * 5 + _magic()
+
+
+def _kernel(bufs_ref, lens_ref, out_ref):
+    bytes_u32 = bufs_ref[:].astype(jnp.uint32)  # [RB, L]
+    rb, L = bytes_u32.shape
+    n = lens_ref[:].astype(jnp.int32)  # [RB, 1]
+    nu = n.astype(jnp.uint32)
+
+    zero_col = jnp.zeros((rb, 1), dtype=jnp.uint32)
+
+    def shifted(k: int):
+        return jnp.concatenate(
+            [bytes_u32[:, k:]] + [zero_col] * k, axis=1
+        )
+
+    # word plane: W[:, i] = le-uint32 at byte offset i (i > L-4: garbage,
+    # never selected — offsets are clamped to L-4 like _fetch32's clip)
+    W = (
+        bytes_u32
+        | (shifted(1) << 8)
+        | (shifted(2) << 16)
+        | (shifted(3) << 24)
+    )
+    col = lax.broadcasted_iota(jnp.int32, (rb, L), 1)
+
+    def fetch(off):  # off int32[RB, 1] -> uint32[RB, 1]
+        off = jnp.clip(off, 0, L - 4)
+        return jnp.sum(
+            jnp.where(col == off, W, 0),
+            axis=1,
+            keepdims=True,
+            dtype=jnp.uint32,
+        )
+
+    def static_fetch(i: int):  # compile-time offset
+        return W[:, i : i + 1]
+
+    # -- len 0..4 ----------------------------------------------------------
+    b = jnp.zeros((rb, 1), dtype=jnp.uint32)
+    c = jnp.full((rb, 1), 9, dtype=jnp.uint32)
+    for i in range(4):
+        byte = bytes_u32[:, i : i + 1]
+        v = jnp.where(byte >= 128, byte - 256, byte)  # signed char
+        nb = b * _c1() + v
+        nc = c ^ nb
+        take = i < n
+        b = jnp.where(take, nb, b)
+        c = jnp.where(take, nc, c)
+    h04 = _fmix(_mur(b, _mur(nu, c)))
+
+    # -- len 5..12 ---------------------------------------------------------
+    a5 = nu + static_fetch(0)
+    b5 = nu * 5 + fetch(n - 4)
+    c5 = 9 + fetch((n >> 1) & 4)
+    d5 = nu * 5
+    h512 = _fmix(_mur(c5, _mur(b5, _mur(a5, d5))))
+
+    # -- len 13..24 --------------------------------------------------------
+    a = fetch((n >> 1) - 4)
+    bb = static_fetch(4)
+    cc = fetch(n - 8)
+    d = fetch(n >> 1)
+    e = static_fetch(0)
+    f = fetch(n - 4)
+    h = d * _c1() + nu
+    a = _rotr(a, 12) + f
+    h = _mur(cc, h) + a
+    a = _rotr(a, 3) + cc
+    h = _mur(e, h) + a
+    a = _rotr(a + f, 12) + d
+    h = _mur(bb, h) + a
+    h1324 = _fmix(h)
+
+    # -- len > 24 ----------------------------------------------------------
+    h = nu
+    g = _c1() * nu
+    f = g
+    a0 = _rotr(fetch(n - 4) * _c1(), 17) * _C2
+    a1 = _rotr(fetch(n - 8) * _c1(), 17) * _C2
+    a2 = _rotr(fetch(n - 16) * _c1(), 17) * _C2
+    a3 = _rotr(fetch(n - 12) * _c1(), 17) * _C2
+    a4 = _rotr(fetch(n - 20) * _c1(), 17) * _C2
+    h = h ^ a0
+    h = _rotr(h, 19)
+    h = h * 5 + _magic()
+    h = h ^ a2
+    h = _rotr(h, 19)
+    h = h * 5 + _magic()
+    g = g ^ a1
+    g = _rotr(g, 19)
+    g = g * 5 + _magic()
+    g = g ^ a3
+    g = _rotr(g, 19)
+    g = g * 5 + _magic()
+    f = f + a4
+    f = _rotr(f, 19) + 113
+    iters = (n - 1) // 20
+    for i in range((L - 1) // 20):  # static max; predicated per row
+        off = i * 20
+        a = static_fetch(off)
+        bq = static_fetch(off + 4)
+        cq = static_fetch(off + 8)
+        dq = static_fetch(off + 12)
+        eq = static_fetch(off + 16)
+        nh = h + a
+        ng = g + bq
+        nf = f + cq
+        nh = _mur(dq, nh) + eq
+        ng = _mur(cq, ng) + a
+        nf = _mur(bq + eq * _c1(), nf) + dq
+        nf = nf + ng
+        ng = ng + nf
+        take = i < iters
+        h = jnp.where(take, nh, h)
+        g = jnp.where(take, ng, g)
+        f = jnp.where(take, nf, f)
+    g = _rotr(g, 11) * _c1()
+    g = _rotr(g, 17) * _c1()
+    f = _rotr(f, 11) * _c1()
+    f = _rotr(f, 17) * _c1()
+    h = _rotr(h + g, 19)
+    h = h * 5 + _magic()
+    h = _rotr(h, 17) * _c1()
+    h = _rotr(h + f, 19)
+    h = h * 5 + _magic()
+    hlong = _rotr(h, 17) * _c1()
+
+    out_ref[:] = jnp.where(
+        n <= 4, h04, jnp.where(n <= 12, h512, jnp.where(n <= 24, h1324, hlong))
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def farmhash32_batch_pallas(
+    bufs: jax.Array, lens: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Fingerprint32 per row: bufs uint8[B, L] (L >= 25), lens int32[B].
+
+    Bit-identical to ``farmhash32_batch_jax``; rows are processed in
+    VMEM blocks of ``ROW_BLOCK``.  ``interpret=True`` runs the kernel in
+    the Pallas interpreter (CPU testing)."""
+    if bufs.shape[1] < 25:
+        raise ValueError("pad buffers to at least 25 bytes")
+    b, L = bufs.shape
+    padded = pl.cdiv(b, ROW_BLOCK) * ROW_BLOCK
+    if padded != b:
+        bufs = jnp.pad(bufs, ((0, padded - b), (0, 0)))
+        lens = jnp.pad(lens, (0, padded - b))
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((padded, 1), jnp.uint32),
+        grid=(padded // ROW_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((ROW_BLOCK, L), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_BLOCK, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLOCK, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(bufs, lens.astype(jnp.int32)[:, None])
+    return out[:b, 0]

@@ -1,0 +1,53 @@
+"""Pallas farmhash kernel vs the C oracle and the jnp kernel
+(interpret mode so CPU CI covers the kernel body)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.ops.farmhash import farmhash32
+from ringpop_tpu.ops.farmhash_jax import farmhash32_batch_jax
+from ringpop_tpu.ops.farmhash_pallas import farmhash32_batch_pallas
+
+
+def make_batch(lengths, L, seed=0):
+    rng = np.random.default_rng(seed)
+    bufs = np.zeros((len(lengths), L), dtype=np.uint8)
+    for i, n in enumerate(lengths):
+        bufs[i, :n] = rng.integers(0, 256, n, dtype=np.uint8)
+    return bufs, np.array(lengths, dtype=np.int32)
+
+
+@pytest.mark.parametrize("L", [25, 40, 64])
+def test_pallas_matches_c_all_lengths(L):
+    lengths = list(range(0, L + 1))
+    bufs, lens = make_batch(lengths, L, seed=L)
+    got = np.asarray(farmhash32_batch_pallas(bufs, lens, interpret=True))
+    for i, n in enumerate(lengths):
+        expect = farmhash32(bufs[i, :n].tobytes())
+        assert got[i] == expect, (n, got[i], expect)
+
+
+def test_pallas_matches_jnp_random_batch():
+    rng = np.random.default_rng(9)
+    L = 48
+    lengths = rng.integers(0, L + 1, 300).tolist()
+    bufs, lens = make_batch(lengths, L, seed=1)
+    got = np.asarray(farmhash32_batch_pallas(bufs, lens, interpret=True))
+    ref = np.asarray(farmhash32_batch_jax(bufs, lens))
+    assert np.array_equal(got, ref)
+
+
+def test_pallas_partial_block_padding():
+    # batch not a multiple of the 128-row block
+    bufs, lens = make_batch([7, 25, 33], 40, seed=2)
+    got = np.asarray(farmhash32_batch_pallas(bufs, lens, interpret=True))
+    for i in range(3):
+        assert got[i] == farmhash32(bufs[i, : lens[i]].tobytes())
+
+
+def test_pallas_rejects_short_buffers():
+    bufs, lens = make_batch([3], 24, seed=3)
+    with pytest.raises(ValueError):
+        farmhash32_batch_pallas(bufs, lens, interpret=True)
