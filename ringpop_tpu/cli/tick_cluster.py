@@ -10,11 +10,16 @@ them over ``/admin/*`` requests with keyboard commands (:250-331):
   l suspend (SIGSTOP, :432-446)  L resume  k kill (SIGKILL, :448-462)
   K revive (:418-430)   q quit
 
-Two execution modes:
+Three execution backends (``--backend``):
 * **proc** (default) — real OS processes (``python -m ringpop_tpu worker``)
   over the TCP transport, signals for fault injection: the reference's shape.
-* **sim** — the deterministic in-process ``harness.Cluster`` on virtual
-  time: same commands, instant and reproducible.
+* **host-sim** (``--sim``) — the deterministic in-process
+  ``harness.Cluster`` on virtual time: same commands, instant and
+  reproducible.
+* **tpu-sim** — the tensor simulation (``models/cluster.py``) behind the
+  same command surface: tens of thousands of virtual nodes on one chip,
+  with ``--loss`` (packet loss) and ``--damping`` (flap-damping
+  extension).
 
 Non-interactive automation: ``--script "j,w3000,t,t,q"`` runs comma-
 separated commands (``wN`` = wait N ms) and exits — used by the
@@ -347,6 +352,122 @@ class SimCluster(ClusterDriver):
         self.cluster.destroy_all()
 
 
+class TpuSimCluster(ClusterDriver):
+    """The TPU simulation backend behind the same command surface
+    (models/cluster.py SimCluster): tens of thousands of virtual nodes
+    on one chip.  ``wN`` advances N ms of protocol time
+    (= N / period_ms ticks)."""
+
+    def __init__(self, size: int, seed: int = 1, loss: float = 0.0,
+                 damping: bool = False):
+        import jax
+
+        # The environment may pre-register a TPU plugin and pin
+        # jax_platforms at the config level; honor JAX_PLATFORMS if the
+        # operator set it (e.g. =cpu to drive the sim without a chip).
+        platform = os.environ.get("JAX_PLATFORMS")
+        current = getattr(jax.config, "jax_platforms", None)
+        if platform and platform != current:
+            # The config must be restricted BEFORE touching devices() —
+            # otherwise backend discovery initializes every registered
+            # plugin, including a possibly-unreachable TPU tunnel.
+            jax.config.update("jax_platforms", platform)
+            try:
+                # Bare get_backend() (first device_put) can still route to
+                # a pre-registered TPU plugin; pin the default device too.
+                jax.config.update(
+                    "jax_default_device", jax.devices(platform.split(",")[0])[0]
+                )
+            except RuntimeError as e:
+                jax.config.update("jax_platforms", current)  # revert
+                print(
+                    f"warning: JAX_PLATFORMS={platform!r} failed to"
+                    f" initialize ({e}); continuing with {current!r}",
+                    file=sys.stderr,
+                )
+
+        from ringpop_tpu.models import swim_sim as sim
+        from ringpop_tpu.models.cluster import SimCluster
+
+        self.sim = sim
+        self.cluster = SimCluster(
+            size, sim.SwimParams(loss=loss), seed=seed, damping=damping
+        )
+        self._suspended: list[int] = []
+        self._killed: list[int] = []
+
+    def join_all(self) -> None:
+        print(f"join: {len(self.cluster.live_indices())} virtual nodes live")
+
+    def gossip_all(self) -> None:
+        print("gossip is implicit: every tick is one protocol period per node")
+
+    def tick_all(self) -> None:
+        t0 = time.perf_counter()
+        metrics = self.cluster.tick()
+        groups = self.cluster.checksum_groups()
+        line = format_groups(groups, (time.perf_counter() - t0) * 1000)
+        print(f"{line}  (pings={metrics['pings_sent']}"
+              f" full_syncs={metrics['full_syncs']})")
+
+    def stats(self) -> None:
+        groups = self.cluster.checksum_groups()
+        for checksum, addrs in sorted(groups.items(), key=lambda g: -len(g[1])):
+            sample = ", ".join(sorted(addrs)[:3])
+            more = f" (+{len(addrs) - 3} more)" if len(addrs) > 3 else ""
+            print(f"  checksum {checksum}: {len(addrs)} nodes [{sample}{more}]")
+
+    def protocol_stats(self) -> None:
+        log = self.cluster.metrics_log[-5:]
+        for i, metrics in enumerate(log):
+            print(f"  t-{len(log) - i}: {metrics}")
+
+    def debug_set(self, flag: str) -> None:
+        print("debug flags are a host-library feature; use metrics_log")
+
+    def debug_clear(self) -> None:
+        pass
+
+    def _live(self) -> list[int]:
+        return [int(i) for i in self.cluster.live_indices()]
+
+    def suspend_next(self) -> None:
+        live = [i for i in self._live() if i not in self._suspended]
+        if not live:
+            return print("no live node to suspend")
+        self.cluster.suspend(live[-1])
+        self._suspended.append(live[-1])
+        print(f"suspended node {live[-1]}")
+
+    def resume_all(self) -> None:
+        for index in self._suspended:
+            self.cluster.resume(index)
+        print(f"resumed {len(self._suspended)} nodes")
+        self._suspended.clear()
+
+    def kill_next(self) -> None:
+        live = self._live()
+        if not live:
+            return print("no live node to kill")
+        self.cluster.kill(live[-1])
+        self._killed.append(live[-1])
+        print(f"killed node {live[-1]}")
+
+    def revive_next(self) -> None:
+        if not self._killed:
+            return print("no dead node to revive")
+        index = self._killed.pop(0)
+        self.cluster.revive(index)
+        print(f"revived node {index}")
+
+    def wait(self, ms: float) -> None:
+        ticks = max(1, int(ms / self.cluster.params.period_ms))
+        self.cluster.tick(ticks)
+
+    def shutdown(self) -> None:
+        pass
+
+
 MENU = """commands:
   j join-all    g gossip-all   t tick (convergence)   s stats by checksum
   p protocol timing   d/D debug set/clear
@@ -390,6 +511,15 @@ def add_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--base-port", type=int, default=3000)
     parser.add_argument("--sim", action="store_true",
                         help="in-process deterministic cluster on virtual time")
+    parser.add_argument("--backend", choices=["proc", "host-sim", "tpu-sim"],
+                        default=None,
+                        help="proc: real processes; host-sim: in-process "
+                             "host library (= --sim); tpu-sim: the tensor "
+                             "simulation (scales to tens of thousands)")
+    parser.add_argument("--loss", type=float, default=0.0,
+                        help="tpu-sim: iid packet-loss probability")
+    parser.add_argument("--damping", action="store_true",
+                        help="tpu-sim: enable the flap-damping extension")
     parser.add_argument("--script", default=None,
                         help='non-interactive command list, e.g. "j,w3000,t,q"')
     parser.add_argument("--seed", type=int, default=1)
@@ -403,9 +533,13 @@ def main(argv: list[str] | None = None) -> None:
     add_args(parser)
     args = parser.parse_args(argv)
 
-    if args.sim:
+    backend = args.backend or ("host-sim" if args.sim else "proc")
+    if backend == "host-sim":
         driver: ClusterDriver = SimCluster(args.size, args.base_port,
                                            seed=args.seed)
+    elif backend == "tpu-sim":
+        driver = TpuSimCluster(args.size, seed=args.seed, loss=args.loss,
+                               damping=args.damping)
     else:
         cluster = ProcCluster(args.size, args.base_port,
                               log_level=args.log_level)

@@ -9,7 +9,9 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the ambient environment may carry
+# JAX_PLATFORMS=<tpu plugin>, and code under test consults the env var.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +24,12 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# The TPU plugin (registered at interpreter startup, before this file
+# runs) routes bare get_backend() — e.g. the first jnp.asarray's
+# device_put — to the TPU tunnel regardless of jax_platforms when
+# JAX_PLATFORMS was not in the environment at process start.  Pinning the
+# default device forces that path onto CPU too.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
 def pytest_configure(config):

@@ -70,3 +70,18 @@ def test_proc_tick_cluster_three_real_processes():
         assert "tick: CONVERGED [3]" in out
     finally:
         cluster.shutdown()
+
+
+def test_tpu_sim_tick_cluster_backend():
+    """The tensor-simulation backend behind the tick-cluster command
+    surface: kill -> faulty convergence at N-1, revive -> N."""
+    from ringpop_tpu.cli.tick_cluster import TpuSimCluster
+
+    driver = TpuSimCluster(size=24, seed=5, loss=0.02)
+    out = capture(lambda: run_script(
+        driver, "j,t,k,w6000,t,s,K,w8000,t,q"))
+    driver.shutdown()
+    lines = [l for l in out.splitlines() if l.startswith("tick:")]
+    assert lines[0].startswith("tick: CONVERGED [24]")
+    assert lines[1].startswith("tick: CONVERGED [23]")
+    assert lines[2].startswith("tick: CONVERGED [24]")
