@@ -10,22 +10,38 @@ advances 5*N node-rounds per second. ``vs_baseline`` is the speedup of
 the TPU simulation over that real-time rate at equal N (i.e. how many
 seconds of real-cluster protocol time one TPU-second simulates).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Robustness contract (the driver runs this unattended): the parent process
+NEVER touches JAX. It probes the accelerator and runs the measurement in
+subprocesses under hard timeouts, falls back to a clearly-labeled CPU
+number if the TPU tunnel is broken or hangs, and always prints exactly
+ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...[, "platform": ..., "error": ...]}
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-
-from ringpop_tpu.models import swim_sim as sim
 
 REFERENCE_ROUNDS_PER_NODE_SEC = 5.0  # 200 ms protocol period
 TICKS_PER_CALL = 20
 REPEATS = 3
+
+PROBE_TIMEOUT_S = 240
+TPU_BENCH_TIMEOUT_S = 900
+CPU_BENCH_TIMEOUT_S = 600
+
+# Dense-state sizes to attempt, largest first; OOM shrinks the cluster.
+TPU_SIZES = (32768, 16384, 10240, 8192, 4096, 2048, 1024)
+CPU_SIZES = (2048, 1024, 512)
+
+
+# ---------------------------------------------------------------------------
+# child: the actual measurement (runs with a live JAX backend)
+# ---------------------------------------------------------------------------
 
 
 def _sync(metrics) -> int:
@@ -41,12 +57,17 @@ def _sync(metrics) -> int:
 
 def bench_once(n: int) -> float:
     """Node-rounds/sec of an n-node simulation (best of REPEATS)."""
+    import jax
+
+    from ringpop_tpu.models import swim_sim as sim
+
     params = sim.SwimParams(loss=0.01)
     key = jax.random.PRNGKey(0)
     state = sim.init_state(n)
     net = sim.make_net(n)
     # Compile + warm up (state is donated; keep the chain alive).
     key, sub = jax.random.split(key)
+    print(f"# compiling n={n}", file=sys.stderr, flush=True)
     state, metrics = sim.swim_run(state, net, sub, params, TICKS_PER_CALL)
     _sync(metrics)
     best = 0.0
@@ -57,19 +78,28 @@ def bench_once(n: int) -> float:
         _sync(metrics)
         dt = time.perf_counter() - t0
         best = max(best, TICKS_PER_CALL * n / dt)
+        print(f"# n={n}: {best:.0f} node-rounds/s", file=sys.stderr, flush=True)
     return best
 
 
-def main() -> None:
+def child_main(sizes: list[int]) -> None:
+    """Measure at the largest size that fits; print one JSON line.
+
+    Only the first size is attempted per process on TPU: an OOM on the
+    tunneled backend leaves the client unusable (observed: every
+    subsequent allocation fails RESOURCE_EXHAUSTED), so the parent
+    retries smaller sizes in fresh processes.
+    """
     last_err = None
-    for n in (10240, 8192, 4096, 2048, 1024):
+    for n in sizes:
         try:
             value = bench_once(n)
         except Exception as e:  # OOM on smaller chips: shrink the cluster
             msg = str(e)
-            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg.lower():
+            if "RESOURCE_EXHAUSTED" not in msg and "out of memory" not in msg.lower():
                 raise
             last_err = e
+            print(f"# n={n}: OOM, shrinking", file=sys.stderr, flush=True)
             continue
         baseline = REFERENCE_ROUNDS_PER_NODE_SEC * n
         print(
@@ -80,11 +110,131 @@ def main() -> None:
                     "unit": "node-rounds/s",
                     "vs_baseline": round(value / baseline, 2),
                 }
-            )
+            ),
+            flush=True,
         )
         return
-    raise SystemExit(f"benchmark failed at every size: {last_err}") from last_err
+    raise SystemExit(f"benchmark failed at every size: {last_err}")
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration under watchdogs (never imports jax)
+# ---------------------------------------------------------------------------
+
+
+def _run_child(args: list[str], env: dict, timeout: int) -> tuple[int | None, str, str]:
+    """Run a subprocess; returns (rc, stdout, stderr); rc None on timeout."""
+    try:
+        p = subprocess.run(
+            [sys.executable, *args],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+        )
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        return None, out, err
+
+
+def _probe_tpu() -> str | None:
+    """Can the ambient accelerator initialize and run a matmul? -> error or None."""
+    rc, out, err = _run_child(
+        [
+            "-c",
+            "import jax, jax.numpy as jnp; x = jnp.ones((128, 128));"
+            "print('devices:', jax.devices(), float((x @ x).sum()))",
+        ],
+        env=dict(os.environ),
+        timeout=PROBE_TIMEOUT_S,
+    )
+    if rc == 0:
+        return None
+    if rc is None:
+        return f"accelerator probe timed out after {PROBE_TIMEOUT_S}s"
+    tail = (err or out).strip().splitlines()[-1:] or ["no output"]
+    return f"accelerator probe failed (rc={rc}): {tail[0][:300]}"
+
+
+def _extract_json(stdout: str) -> dict | None:
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    errors = []
+
+    tpu_err = _probe_tpu()
+    if tpu_err is None:
+        # One size per child: a TPU OOM poisons the tunneled client, so
+        # each size gets a fresh process; first success wins.
+        for n in TPU_SIZES:
+            rc, out, err = _run_child(
+                [os.path.abspath(__file__), "--child", str(n)],
+                env=dict(os.environ),
+                timeout=TPU_BENCH_TIMEOUT_S,
+            )
+            result = _extract_json(out)
+            if rc == 0 and result is not None:
+                print(json.dumps(result), flush=True)
+                return
+            reason = (
+                f"timed out after {TPU_BENCH_TIMEOUT_S}s" if rc is None else f"rc={rc}"
+            )
+            tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+            errors.append(f"tpu bench n={n} {reason}: {tail[0][:160]}")
+            print(f"# {errors[-1]}", file=sys.stderr, flush=True)
+            if rc is None:
+                break  # a hang at one size means the tunnel is sick; stop
+    else:
+        errors.append(tpu_err)
+    print(f"# falling back to CPU: {errors[-1]}", file=sys.stderr, flush=True)
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=os.environ.get("XLA_FLAGS", ""),
+    )
+    rc, out, err = _run_child(
+        [os.path.abspath(__file__), "--child", ",".join(map(str, CPU_SIZES))],
+        env=env,
+        timeout=CPU_BENCH_TIMEOUT_S,
+    )
+    result = _extract_json(out)
+    if rc == 0 and result is not None:
+        result["platform"] = "cpu-fallback"
+        result["error"] = "; ".join(errors)
+        print(json.dumps(result), flush=True)
+        return
+
+    reason = f"timed out after {CPU_BENCH_TIMEOUT_S}s" if rc is None else f"rc={rc}"
+    tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+    errors.append(f"cpu bench {reason}: {tail[0][:300]}")
+    print(
+        json.dumps(
+            {
+                "metric": "swim_sim_node_rounds_per_sec",
+                "value": 0,
+                "unit": "node-rounds/s",
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors),
+            }
+        ),
+        flush=True,
+    )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        child_main([int(s) for s in sys.argv[2].split(",")])
+    else:
+        main()
