@@ -90,7 +90,16 @@ class HashRing(EventEmitter):
             if not self.has_server(s) and s not in removing
         ]
         to_remove = [s for s in dict.fromkeys(removing) if self.has_server(s)]
+        # An absent server in both lists nets out, but sequential
+        # add-then-remove (ring.js:60-94) still counts as a change —
+        # checksum recomputed, True returned.  Match that.
+        transient = any(
+            s in removing and not self.has_server(s) for s in (servers_to_add or [])
+        )
         if not to_add and not to_remove:
+            if transient:
+                self.compute_checksum()
+                return True
             return False
         entries = self._entries
         if to_remove:

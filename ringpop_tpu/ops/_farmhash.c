@@ -295,13 +295,23 @@ int rp_view_checksums(const int8_t *status, const int32_t *inc_rel,
                       int64_t base_inc, const int64_t *sorted,
                       const uint8_t *addr_buf, const int64_t *addr_off,
                       const uint8_t *status_buf, const int64_t *status_off,
-                      int64_t n_nodes, int8_t none_code, const int64_t *rows,
-                      int64_t n_rows, uint32_t *out, int64_t n_threads) {
-    /* Worst-case per-row string: every member present. */
+                      int64_t n_statuses, int64_t n_nodes, int8_t none_code,
+                      const int64_t *rows, int64_t n_rows, uint32_t *out,
+                      int64_t n_threads) {
+    /* Worst-case per-row string: every member present.  The status budget
+     * is derived from the table, not hard-coded: a longer status name
+     * added Python-side must widen the scratch, not overflow it. */
+    size_t max_status = 0;
+    for (int64_t s = 0; s < n_statuses; s++) {
+        size_t len = (size_t)(status_off[s + 1] - status_off[s]);
+        if (len > max_status) {
+            max_status = len;
+        }
+    }
     size_t scratch = 1;
     for (int64_t j = 0; j < n_nodes; j++) {
         size_t addr_len = (size_t)(addr_off[j + 1] - addr_off[j]);
-        scratch += addr_len + 8 /* status */ + 21 /* inc */ + 1 /* ';' */;
+        scratch += addr_len + max_status + 21 /* inc */ + 1 /* ';' */;
     }
     if (n_threads < 1) {
         n_threads = 1;

@@ -227,6 +227,7 @@ def _load_c_lib():
         ctypes.c_void_p,  # addr_off int64[N+1]
         ctypes.c_char_p,  # status_buf
         ctypes.c_void_p,  # status_off int64[codes+1]
+        ctypes.c_int64,  # n_statuses (codes)
         ctypes.c_int64,  # n_nodes
         ctypes.c_int8,  # none_code
         ctypes.c_void_p,  # rows int64[n_rows]
@@ -317,6 +318,7 @@ def view_checksums_native(
         addr_off.ctypes.data,
         status_buf,
         status_off.ctypes.data,
+        len(status_off) - 1,
         status.shape[0],
         int(none_code),
         rows.ctypes.data,

@@ -149,7 +149,13 @@ def lookup_idx(ring: DeviceRing, key_hashes: jax.Array) -> jax.Array:
     """Owner index per key hash — ``searchsorted`` with wraparound.
 
     ``side='left'`` makes an exact hash hit own itself (the reference's
-    equality-inclusive upperBound, rbtree.js:262-271)."""
+    equality-inclusive upperBound, rbtree.js:262-271).
+
+    The ring must be non-empty: the host ``HashRing.lookup`` returns
+    ``None`` on an empty ring (ring.js:139-147), but a fixed-shape device
+    lookup has no None — callers gate on membership before building."""
+    if ring.size == 0:
+        raise ValueError("lookup on an empty DeviceRing (no servers)")
     idx = jnp.searchsorted(ring.hashes, key_hashes, side="left")
     idx = idx % ring.size  # wrap to min (ring.js:142-145)
     return ring.owners[idx]
@@ -173,6 +179,8 @@ def lookup_n_idx(
     ``min(n, server_count)`` distinct owners — callers re-resolve those
     rows with a larger window (or the host ring) rather than trusting
     the -1 padding."""
+    if ring.size == 0:
+        raise ValueError("lookupN on an empty DeviceRing (no servers)")
     if window is None:
         window = min(ring.size, 32 + 8 * n)
     window = min(window, ring.size)

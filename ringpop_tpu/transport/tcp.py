@@ -95,6 +95,10 @@ class _Conn:
     async def _read_loop(self) -> None:
         try:
             while True:
+                # readline raises (LimitOverrunError wrapped in ValueError)
+                # past the stream limit — set to MAX_FRAME_BYTES at
+                # connection setup; the default 64 KiB would kill the conn
+                # on any full-sync/stats body of a few hundred members.
                 line = await self.reader.readline()
                 if not line:
                     break
@@ -105,8 +109,8 @@ class _Conn:
                 except ValueError:
                     break
                 self.channel._on_frame(self, frame)
-        except (asyncio.CancelledError, ConnectionError, OSError):
-            pass
+        except (asyncio.CancelledError, ConnectionError, OSError, ValueError):
+            pass  # ValueError: oversized/garbage frame — close deliberately
         finally:
             self.close()
 
@@ -141,7 +145,9 @@ class TcpChannel:
 
     async def listen(self) -> None:
         host, port = parse_host_port(self.host_port)
-        self.server = await asyncio.start_server(self._on_accept, host, port)
+        self.server = await asyncio.start_server(
+            self._on_accept, host, port, limit=MAX_FRAME_BYTES
+        )
 
     def _on_accept(self, reader, writer) -> None:
         if self.destroyed:
@@ -207,7 +213,7 @@ class TcpChannel:
     async def _dial(self, host: str) -> None:
         try:
             h, p = parse_host_port(host)
-            reader, writer = await asyncio.open_connection(h, p)
+            reader, writer = await asyncio.open_connection(h, p, limit=MAX_FRAME_BYTES)
         except (ConnectionError, OSError, ValueError) as e:
             queued = self._dialing.pop(host, [])
             for frame, _ in queued:

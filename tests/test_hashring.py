@@ -124,3 +124,19 @@ def test_batch_duplicates_and_conflicts_are_idempotent():
     ring2.add_remove_servers(["c:1"], ["c:1"])
     assert not ring2.has_server("c:1")
     assert ring2.lookup("x") is None
+
+
+def test_transient_add_remove_of_absent_server_counts_as_change():
+    """An absent server in both lists nets out, but sequential
+    add-then-remove (ring.js:60-94) returns true and recomputes the
+    checksum; the batch path must match."""
+    ring = HashRing()
+    ring.add_server("a:1")
+    before = ring.checksum
+    events = []
+    ring.on("checksumComputed", lambda *a: events.append("checksum"))
+    changed = ring.add_remove_servers(["b:2"], ["b:2"])
+    assert changed is True
+    assert events == ["checksum"]
+    assert ring.checksum == before  # same membership, same checksum
+    assert not ring.has_server("b:2")

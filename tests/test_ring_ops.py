@@ -78,3 +78,16 @@ def test_exact_replica_hash_owns_itself():
     probe = jnp.asarray(np.asarray(dev.hashes)[7:8])
     owner = int(ring_ops.lookup_idx(dev, probe)[0])
     assert owner == int(np.asarray(dev.owners)[7])
+
+
+def test_empty_device_ring_lookup_raises():
+    """Host HashRing.lookup returns None on an empty ring; the fixed-shape
+    device path raises instead of dividing by zero."""
+    import pytest
+
+    empty = ring_ops.build_ring([])
+    key = jnp.zeros((1,), dtype=jnp.uint32)
+    with pytest.raises(ValueError):
+        ring_ops.lookup_idx(empty, key)
+    with pytest.raises(ValueError):
+        ring_ops.lookup_n_idx(empty, key, 3)

@@ -199,3 +199,29 @@ def test_forwarding_over_tcp():
             node.destroy()
 
     run(scenario(), timeout=30)
+
+
+def test_large_frame_roundtrip():
+    """Frames far beyond asyncio's default 64 KiB stream limit survive.
+
+    Join/full-sync/stats bodies exceed 64 KiB at a few hundred members
+    (reference bodies are unbounded JSON); the stream limit must be the
+    protocol's MAX_FRAME_BYTES, not asyncio's default."""
+    async def scenario():
+        a = TcpChannel(f"127.0.0.1:{BASE + 20}")
+        b = make_echo_channel(f"127.0.0.1:{BASE + 21}")
+        await a.listen()
+        await b.listen()
+        fut = asyncio.get_event_loop().create_future()
+        big = "x" * (512 * 1024)  # 512 KiB body
+        a.request(
+            b.host_port, "/echo", "HEAD", json.dumps({"x": big}), 10000,
+            lambda err, res1, res2=None: fut.set_result((err, res1, res2)),
+        )
+        err, res1, res2 = await fut
+        assert err is None
+        assert json.loads(res2)["echo"] == big
+        a.close()
+        b.close()
+
+    run(scenario())
