@@ -1,0 +1,113 @@
+"""Component-level timing of the SWIM step on the current backend.
+
+The tunneled TPU has ~70 ms dispatch/sync latency, so single-call timings
+are useless: each component is iterated REPS times inside one jitted
+lax.scan with a carried data dependency, and the marginal per-iteration
+cost is reported (sync overhead amortized to noise).
+
+    python benchmarks/profile_step.py [n]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from ringpop_tpu.models import swim_sim as sim
+
+REPS = 16
+
+
+def timed_scan(make_body, init_carry, label):
+    """Scan make_body REPS times; print marginal ms/iteration."""
+
+    @jax.jit
+    def run(carry, keys):
+        def body(c, k):
+            return make_body(c, k), None
+
+        out, _ = jax.lax.scan(body, carry, keys)
+        return out
+
+    keys = jax.random.split(jax.random.PRNGKey(1), REPS)
+    out = run(init_carry, keys)
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
+    float(jnp.sum(leaves[0][..., :1].astype(jnp.float32)).item())
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = run(init_carry, keys)
+        leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
+        float(jnp.sum(leaves[0][..., :1].astype(jnp.float32)).item())
+        best = min(best, time.perf_counter() - t0)
+    print(f"  {label:<24} {best / REPS * 1e3:8.2f} ms/iter")
+    return best / REPS
+
+
+def main(n: int) -> None:
+    params = sim.SwimParams(loss=0.01)
+    state = sim.init_state(n)
+    net = sim.make_net(n)
+    eye = jnp.eye(n, dtype=bool)
+    status = state.view_key & 7
+    pingable = ((status == sim.ALIVE) | (status == sim.SUSPECT)) & ~eye
+    target = jnp.zeros((n,), jnp.int32)
+
+    print(f"n={n}")
+
+    def full_body(st, k):
+        return sim.swim_step_impl(st, net, k, params)[0]
+
+    timed_scan(full_body, state, "FULL STEP")
+
+    def sel_body(p, k):
+        t, has, w, wv = sim._choose_targets_and_witnesses(p, 3, k)
+        return p ^ (t[:, None] == 0)
+
+    timed_scan(sel_body, pingable, "targets+witnesses")
+
+    def hash_body(vk, k):
+        h = sim._view_hash(state._replace(view_key=vk))
+        return vk + h[:, None].astype(jnp.int32)
+
+    timed_scan(hash_body, state.view_key, "view_hash (x2)")
+
+    def mpb_body(p, k):
+        m = sim._max_piggyback(p, 15)
+        return p ^ (m[:, None] == 0)
+
+    timed_scan(mpb_body, pingable, "max_piggyback")
+
+    in_key = jnp.broadcast_to(jnp.int32(8 + sim.ALIVE), (n, n))
+    active = jnp.ones((n,), bool)
+
+    def merge_body(st, k):
+        return sim._merge_incoming(st, in_key ^ (st.tick & 1), active, 26).state
+
+    timed_scan(merge_body, state, "merge_incoming (x2)")
+
+    def scatter_body(ko, k):
+        out = jnp.zeros((n, n), dtype=jnp.int32).at[target].max(ko)
+        return ko + (out & 1)
+
+    timed_scan(scatter_body, jnp.ones((n, n), jnp.int32), "row-scatter (x1)")
+
+    def gather_body(vk, k):
+        g = vk[target]
+        return vk + (g & 1)
+
+    timed_scan(gather_body, state.view_key, "row-gather (x~2)")
+
+    def bern1d_body(c, k):
+        return c ^ (jax.random.uniform(k, (n,)) < 0.01)
+
+    timed_scan(bern1d_body, jnp.zeros((n,), bool), "n bernoulli (x2)")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8192)

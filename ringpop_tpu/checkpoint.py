@@ -30,7 +30,7 @@ from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.models.cluster import SimCluster
 from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: packed view_key/pb/suspect_left state layout
 
 
 def save(cluster: SimCluster, path: str) -> None:
@@ -51,6 +51,8 @@ def save(cluster: SimCluster, path: str) -> None:
             continue
         arrays[f"state.{name}"] = np.asarray(leaf)
     for name, leaf in cluster.net._asdict().items():
+        if leaf is None:  # adj=None: healthy fully-connected network
+            continue
         arrays[f"net.{name}"] = np.asarray(leaf)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -72,26 +74,27 @@ def load(path: str, device: Any | None = None) -> SimCluster:
             addresses=addresses,
             base_inc=meta["base_inc"],
         )
-        # Optional extension tensors (None-default fields) may be absent —
+        # Optional (None-default) fields may be absent from the archive —
         # derived from the NamedTuple defaults so save/load stay in lockstep.
-        optional = {
-            name
-            for name, default in ClusterState._field_defaults.items()
-            if default is None
-        }
-        leaves = {}
-        for name in ClusterState._fields:
-            key_name = f"state.{name}"
-            if key_name in data:
-                leaves[name] = jax.numpy.asarray(data[key_name])
-            elif name in optional:
-                leaves[name] = None
-            else:
-                raise KeyError(f"checkpoint missing required array {key_name}")
-        cluster.state = ClusterState(**leaves)
-        cluster.net = NetState(
-            **{name: jax.numpy.asarray(data[f"net.{name}"]) for name in NetState._fields}
-        )
+        def load_tuple(cls, prefix):
+            optional = {
+                name
+                for name, default in cls._field_defaults.items()
+                if default is None
+            }
+            leaves = {}
+            for name in cls._fields:
+                key_name = f"{prefix}.{name}"
+                if key_name in data:
+                    leaves[name] = jax.numpy.asarray(data[key_name])
+                elif name in optional:
+                    leaves[name] = None
+                else:
+                    raise KeyError(f"checkpoint missing required array {key_name}")
+            return cls(**leaves)
+
+        cluster.state = load_tuple(ClusterState, "state")
+        cluster.net = load_tuple(NetState, "net")
         cluster.key = jax.numpy.asarray(data["key"])
     if device is not None:
         cluster.state = jax.device_put(cluster.state, device)

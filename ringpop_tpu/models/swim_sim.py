@@ -10,31 +10,48 @@ simultaneously.  The "network" is a boolean delivery mask — packet loss,
 partitions and suspended processes are all mask edits (the fault-injection
 surface replacing tick-cluster.js signals).
 
+State layout (6 bytes per (viewer, subject) pair — sized by HBM):
+
+* ``view_key: int32`` — the incarnation-precedence lattice key itself,
+  ``inc * 8 + status`` (0 = member unknown/nonexistent).  Storing the key
+  instead of (status int8, inc int32) makes every merge a plain int32
+  ``max``/compare with no unpacking on the hot path and drops a byte.
+* ``pb: int8`` — piggyback count (-1: no recorded change).  The budget
+  ``factor * ceil(log10(count+1))`` is <= 75 for N <= 99,999
+  (dissemination.js:38-55), clamped to 126 for safety.
+* ``suspect_left: int8`` — suspicion countdown in ticks (-1: no timer),
+  the tensor form of per-node Suspicion.timers (suspicion.js:27).
+
 Semantics parity map (reference file:line -> here):
 
-* membership-update-rules.js:25-59  -> ``_lattice_key`` / ``_apply_mask``:
-  the incarnation-precedence lattice is a total-order key
-  ``inc * 8 + rank`` (rank: alive<suspect<faulty<leave) plus two masks for
-  the non-total corners (leave is only ever overridden by a
-  strictly-newer alive; membership.js first-sight takes any change).
+* membership-update-rules.js:25-59  -> ``_apply_mask`` over stored keys:
+  the incarnation-precedence lattice is the total order of ``view_key``
+  plus two masks for the non-total corners (leave is only overridden by
+  alive; a first-sighted member takes any change).
 * membership.js:243-254             -> refutation: any suspect/faulty rumor
   about self re-asserts alive with ``max(self_inc, rumor_inc) + 1``.
 * dissemination.js:125-177          -> per-(viewer, subject) piggyback
-  counts; a recorded change is issued while ``pb < max_piggyback``, where
-  ``max_piggyback = factor * ceil(log10(server_count + 1))``
-  (dissemination.js:38-55), and evicted past it.  A change's payload is
-  always the viewer's current (status, incarnation) for the subject — the
-  reference's change buffer is keyed by address and overwritten on every
-  applied update, so only (pb, source, source_inc) need separate storage.
-* dissemination.js:86-98            -> anti-echo: replies drop changes whose
-  (source, sourceIncarnation) equal the ping sender's identity.
+  counts; a recorded change is issued while ``pb < max_piggyback`` and
+  evicted past it.  A change's payload is always the viewer's current
+  lattice key for the subject — the reference's change buffer is keyed by
+  address and overwritten on every applied update.
+* dissemination.js:86-98            -> anti-echo, value-form: a reply
+  omits claims identical to what the ping sender itself delivered this
+  tick.  The reference filters by (source, sourceIncarnation); the value
+  form suppresses exactly the claims the sender provably already holds,
+  so it cannot lose information — it trades the 8 bytes/pair of
+  (src, src_inc) for a bounded amount of redundant steady-state traffic
+  (claims learned from elsewhere that happen to equal the sender's).
 * dissemination.js:61-76,100-118    -> full sync: a receiver with nothing to
   piggyback but a checksum mismatch answers with its entire view row.
 * swim/ping-sender.js, ping-handler -> phase 2/3/4 of ``swim_step``.
 * swim/ping-req-sender.js:153-296   -> phase 5: k random witnesses, two-hop
   reachability, all-definite-failures => suspect.
-* swim/suspicion.js                 -> per-(viewer, subject) deadline ticks;
-  expiry declares faulty; alive stops the timer; re-suspect restarts it.
+* swim/suspicion.js                 -> ``suspect_left`` countdown; expiry
+  declares faulty; alive stops the timer; re-suspect restarts it.  The
+  countdown keeps running for suspended processes but only *fires* while
+  the viewer gossips (held at 0) — a SIGSTOPped node's timers fire on
+  resume, like real setTimeouts (tick-cluster.js:432-446).
 * membership-iterator.js            -> probe-target selection; the reference
   uses a reshuffled round-robin, the simulation samples uniformly among
   pingable members (distributionally equivalent; documented deviation).
@@ -55,13 +72,17 @@ defined order):
 * A receiver's reply piggyback counter advances by the number of inbound
   pings it served that tick, but all probers of the tick see the same
   issued set.
+* The piggyback budget and the probe-target/witness pool are computed
+  from the period-start view (the reference recomputes the budget on ring
+  change mid-period; one-tick lag, convergence-neutral).
 * The ping-req path probes reachability only; its piggyback exchange is
   omitted (convergence-neutral, traffic-level deviation).
 
-Incarnation numbers are stored as int32 offsets from a host-side base
-(``SimCluster`` keeps the absolute int ms base) so all device arithmetic is
-x64-free; the lattice key needs ``inc * 8`` to fit int32, so relative
-incarnations must stay below 2**27 (~37 hours of ms).
+Incarnation numbers are stored as non-negative int32 offsets from a
+host-side base (``SimCluster`` keeps the absolute int ms base) so all
+device arithmetic is x64-free; the lattice key needs ``inc * 8`` to fit
+int32, so relative incarnations must stay below 2**27 (~37 hours of ms) —
+``init_state``/``revive`` validate this at the host boundary.
 """
 
 from __future__ import annotations
@@ -72,7 +93,7 @@ import jax
 import jax.numpy as jnp
 
 
-# Status encoding: lattice rank == code - 1 (alive < suspect < faulty < leave,
+# Status encoding: lattice rank == code (alive < suspect < faulty < leave,
 # matching equal-incarnation precedence in membership-update-rules.js).
 NONE = 0
 ALIVE = 1
@@ -82,7 +103,7 @@ LEAVE = 4
 
 STATUS_NAMES = {ALIVE: "alive", SUSPECT: "suspect", FAULTY: "faulty", LEAVE: "leave"}
 
-_KEY_MIN = jnp.iinfo(jnp.int32).min
+INC_MAX = (1 << 27) - 1  # inc * 8 + status must fit int32
 
 
 class SwimParams(NamedTuple):
@@ -106,21 +127,15 @@ class SwimParams(NamedTuple):
 class ClusterState(NamedTuple):
     """Per-(viewer i, subject j) membership views + dissemination buffers.
 
-    ``view_status[i, j]`` / ``view_inc[i, j]``: node i's belief about j
-    (membership.js member records, one row per node).  ``pb[i, j]`` is the
-    piggyback count of i's recorded change about j (-1: no change
-    recorded); ``src``/``src_inc`` are the change's originator
-    (dissemination.js change.source / sourceIncarnationNumber; -1 absent).
-    ``suspect_at[i, j]``: tick when i started suspecting j (-1: no timer)
-    — the tensor form of per-node Suspicion.timers (suspicion.js:27).
+    ``view_key[i, j]``: node i's belief about j as a lattice key (see
+    module docstring).  ``pb[i, j]``: piggyback count of i's recorded
+    change about j (-1: none).  ``suspect_left[i, j]``: ticks until i
+    declares j faulty (-1: no timer running).
     """
 
-    view_status: jax.Array  # int8[N, N]
-    view_inc: jax.Array  # int32[N, N]
-    pb: jax.Array  # int16[N, N]
-    src: jax.Array  # int32[N, N]
-    src_inc: jax.Array  # int32[N, N]
-    suspect_at: jax.Array  # int32[N, N]
+    view_key: jax.Array  # int32[N, N]
+    pb: jax.Array  # int8[N, N]
+    suspect_left: jax.Array  # int8[N, N]
     tick: jax.Array  # int32[]
     # Flap-damping extension (None = disabled, zero cost): viewer i's damp
     # score for j and the hysteresis "currently damped" bit (damping.py).
@@ -129,7 +144,19 @@ class ClusterState(NamedTuple):
 
     @property
     def n(self) -> int:
-        return self.view_status.shape[0]
+        return self.view_key.shape[0]
+
+    # Unpacked views (host/test convenience; kernels use view_key directly).
+
+    @property
+    def view_status(self) -> jax.Array:
+        """int8[N, N] status codes (NONE where the member is unknown)."""
+        return (self.view_key & 7).astype(jnp.int8)
+
+    @property
+    def view_inc(self) -> jax.Array:
+        """int32[N, N] relative incarnations (0 where unknown)."""
+        return self.view_key >> 3
 
 
 class NetState(NamedTuple):
@@ -138,20 +165,44 @@ class NetState(NamedTuple):
     ``up``: process exists (kill -> False).  ``responsive``: process
     scheduled (SIGSTOP analog -> False; state is retained, the node just
     neither probes nor answers — tick-cluster.js:432-446).  ``adj``:
-    directed connectivity; partitions are block masks.
+    directed connectivity; partitions are block masks.  ``adj=None``
+    means fully connected — the healthy-network case never ships an
+    all-ones N x N mask through HBM (1 GB at 32k nodes).
     """
 
     up: jax.Array  # bool[N]
     responsive: jax.Array  # bool[N]
-    adj: jax.Array  # bool[N, N]
+    adj: jax.Array | None = None  # bool[N, N] or None (fully connected)
 
 
-def make_net(n: int) -> NetState:
+def make_net(n: int, *, partitioned: bool = False) -> NetState:
+    """Healthy network; ``partitioned=True`` materializes the adjacency
+    mask up front (callers that will edit it per-tick)."""
     return NetState(
         up=jnp.ones((n,), dtype=bool),
         responsive=jnp.ones((n,), dtype=bool),
-        adj=jnp.ones((n, n), dtype=bool),
+        adj=jnp.ones((n, n), dtype=bool) if partitioned else None,
     )
+
+
+def _adj(net: NetState, rows, cols) -> jax.Array | bool:
+    """Connectivity lookup that treats ``adj=None`` as all-connected."""
+    if net.adj is None:
+        return True
+    return net.adj[rows, cols]
+
+
+def _check_inc(inc: Any) -> None:
+    """Host-boundary validation of relative incarnations (see docstring)."""
+    try:
+        lo, hi = int(jnp.min(inc)), int(jnp.max(inc))
+    except jax.errors.ConcretizationTypeError:
+        return  # traced: caller is responsible
+    if lo < 0 or hi > INC_MAX:
+        raise ValueError(
+            f"relative incarnations must be in [0, {INC_MAX}] (got [{lo}, {hi}]); "
+            "rebase against a larger base_inc"
+        )
 
 
 def init_state(
@@ -171,22 +222,19 @@ def init_state(
     if inc is None:
         inc = jnp.zeros((n,), dtype=jnp.int32)
     inc = jnp.asarray(inc, dtype=jnp.int32)
+    _check_inc(inc)
+    alive_key = inc * 8 + ALIVE
     eye = jnp.eye(n, dtype=bool)
     if mode == "converged":
-        status = jnp.full((n, n), ALIVE, dtype=jnp.int8)
-        view_inc = jnp.broadcast_to(inc[None, :], (n, n)).astype(jnp.int32)
+        view_key = jnp.broadcast_to(alive_key[None, :], (n, n)).astype(jnp.int32)
     elif mode == "self":
-        status = jnp.where(eye, ALIVE, NONE).astype(jnp.int8)
-        view_inc = jnp.where(eye, inc[None, :], 0).astype(jnp.int32)
+        view_key = jnp.where(eye, alive_key[None, :], 0).astype(jnp.int32)
     else:
         raise ValueError(f"unknown init mode: {mode}")
     return ClusterState(
-        view_status=status,
-        view_inc=view_inc,
-        pb=jnp.full((n, n), -1, dtype=jnp.int16),
-        src=jnp.full((n, n), -1, dtype=jnp.int32),
-        src_inc=jnp.full((n, n), -1, dtype=jnp.int32),
-        suspect_at=jnp.full((n, n), -1, dtype=jnp.int32),
+        view_key=view_key,
+        pb=jnp.full((n, n), -1, dtype=jnp.int8),
+        suspect_left=jnp.full((n, n), -1, dtype=jnp.int8),
         tick=jnp.zeros((), dtype=jnp.int32),
         damp=jnp.zeros((n, n), dtype=jnp.float16) if damping else None,
         damped=jnp.zeros((n, n), dtype=bool) if damping else None,
@@ -194,39 +242,21 @@ def init_state(
 
 
 # ---------------------------------------------------------------------------
-# lattice (membership-update-rules.js as uint arithmetic)
+# lattice (membership-update-rules.js over stored keys)
 # ---------------------------------------------------------------------------
 
 
-def _lattice_key(status: jax.Array, inc: jax.Array) -> jax.Array:
-    """Total-order key of a (status, incarnation) claim; NONE -> minimum.
-
-    ``inc * 8 + rank + 1`` realizes: alive overrides at strictly newer
-    incarnation; suspect/faulty/leave override lower ranks at equal
-    incarnation and anything at newer incarnation.  The two places the
-    real lattice is *not* this total order are handled by ``_apply_mask``.
-    """
-    key = inc.astype(jnp.int32) * 8 + status.astype(jnp.int32)
-    return jnp.where(status == NONE, _KEY_MIN, key)
-
-
-def _apply_mask(
-    cur_status: jax.Array,
-    cur_key: jax.Array,
-    in_status: jax.Array,
-    in_key: jax.Array,
-) -> jax.Array:
+def _apply_mask(cur_key: jax.Array, in_key: jax.Array) -> jax.Array:
     """Does the incoming claim override the current view entry?
 
     key-greater, except: an existing ``leave`` entry is only overridden by
     ``alive`` (is_leave/suspect/faulty_override exclude leave members —
     membership-update-rules.js:31-42,54-59), while a first-sighted member
-    (cur NONE, key minimum) takes any change wholesale
-    (membership.js:230-247).
+    (cur == 0) takes any change wholesale (membership.js:230-247).
     """
     beats = in_key > cur_key
-    leave_guard = (cur_status == LEAVE) & (in_status != ALIVE)
-    return beats & ~leave_guard & (in_status != NONE)
+    leave_guard = ((cur_key & 7) == LEAVE) & ((in_key & 7) != ALIVE)
+    return beats & ~leave_guard & (in_key > 0)
 
 
 def _view_hash(state: ClusterState) -> jax.Array:
@@ -237,101 +267,101 @@ def _view_hash(state: ClusterState) -> jax.Array:
     Reported/parity checksums are the real farmhash over the reference's
     string format — see models/checksum.py.
     """
-    s = state.view_status.astype(jnp.uint32)
-    i = state.view_inc.astype(jnp.uint32)
-    h = (i ^ (s * jnp.uint32(0x9E3779B9))) * jnp.uint32(0x85EBCA6B)
+    k = state.view_key.astype(jnp.uint32)
+    h = (k * jnp.uint32(0x85EBCA6B)) ^ (k >> jnp.uint32(7))
     h = (h ^ (h >> jnp.uint32(13))) * jnp.uint32(0xC2B2AE35)
     h = h ^ (h >> jnp.uint32(16))
     idx = jnp.arange(state.n, dtype=jnp.uint32) * jnp.uint32(0x27D4EB2F)
-    h = jnp.where(state.view_status != NONE, h ^ idx, jnp.uint32(0))
+    h = jnp.where(state.view_key > 0, h ^ idx, jnp.uint32(0))
     return jnp.sum(h, axis=1, dtype=jnp.uint32)
 
 
-def _max_piggyback(state: ClusterState, factor: int) -> jax.Array:
+def _max_piggyback(status_ok: jax.Array, factor: int) -> jax.Array:
     """``factor * ceil(log10(server_count + 1))`` per node, exactly
     (dissemination.js:38-55); server count ~ members the node would have
     in its ring (alive + suspect — suspects stay in the ring,
-    membership-update-listener.js:34-45)."""
-    sc = jnp.sum(
-        (state.view_status == ALIVE) | (state.view_status == SUSPECT),
-        axis=1,
-        dtype=jnp.int32,
-    )
+    membership-update-listener.js:34-45).  Clamped to 126 so counts fit
+    the int8 ``pb`` store."""
+    sc = jnp.sum(status_ok, axis=1, dtype=jnp.int32)
     x = sc + 1
     digits = jnp.zeros_like(x)
     p = jnp.int32(1)
     for _ in range(10):
         digits = digits + (x > p).astype(jnp.int32)
         p = p * 10
-    return factor * digits
+    return jnp.minimum(factor * digits, 126)
 
 
-def _pingable(state: ClusterState) -> jax.Array:
-    """pingable = alive|suspect and not self (membership.js:135-139)."""
-    ok = (state.view_status == ALIVE) | (state.view_status == SUSPECT)
-    eye = jnp.eye(state.n, dtype=bool)
-    return ok & ~eye
+def _distinct_ranks(
+    count: jax.Array, m: int, key: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """``m`` distinct uniform ranks in ``[0, count)`` per row.
+
+    Sequential shifted-uniform sampling: the t-th draw is uniform over
+    ``count - t`` slots, then shifted past each previously-taken rank in
+    ascending order — exact sampling without replacement using only
+    O(N * m^2) scalar work (no N x N permutation/score tensor).
+    Returns (ranks int32[N, m], valid bool[N, m]); rank t is valid iff
+    ``count > t``.
+    """
+    n = count.shape[0]
+    u = jax.random.uniform(key, (n, m))
+    ranks: list[jax.Array] = []
+    valids = []
+    for t in range(m):
+        space = jnp.maximum(count - t, 1)
+        r = jnp.minimum((u[:, t] * space).astype(jnp.int32), space - 1)
+        # shift past taken ranks, ascending (insertion into the gap list)
+        for taken in sorted_all(ranks):
+            r = r + (r >= taken).astype(jnp.int32)
+        ranks.append(r)
+        valids.append(count > t)
+    return jnp.stack(ranks, axis=1), jnp.stack(valids, axis=1)
 
 
-def _choose_targets(pingable: jax.Array, key: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """One probe target per node, uniform among its pingable members.
+def sorted_all(xs: list[jax.Array]) -> list[jax.Array]:
+    """Elementwise-sorted copies of up to 3 equal-shaped int arrays."""
+    if len(xs) <= 1:
+        return list(xs)
+    if len(xs) == 2:
+        a, b = xs
+        return [jnp.minimum(a, b), jnp.maximum(a, b)]
+    if len(xs) == 3:
+        a, b, c = xs
+        lo = jnp.minimum(jnp.minimum(a, b), c)
+        hi = jnp.maximum(jnp.maximum(a, b), c)
+        mid = a + b + c - lo - hi
+        return [lo, mid, hi]
+    stacked = jnp.sort(jnp.stack(xs, axis=1), axis=1)
+    return [stacked[:, i] for i in range(len(xs))]
 
-    The reference walks a per-round shuffled round-robin
-    (membership-iterator.js:33-52); uniform sampling keeps the same
-    distribution over targets without N x N iterator state.
 
-    Selection is an exact rank pick: one uniform per node chooses the
-    k-th pingable member via a row cumsum — O(N^2) cheap integer work
-    instead of an N x N counter-based-PRNG matrix (threefry bits were
-    half the tick's cost)."""
+def _choose_targets_and_witnesses(
+    pingable: jax.Array, k: int, key: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Probe target + ``k`` ping-req witnesses per node, by exact rank.
+
+    Draws ``k + 1`` distinct uniform ranks among each row's pingable
+    members and locates them in one row cumsum: pick 0 is the probe
+    target (uniform among pingable — membership-iterator.js semantics),
+    picks 1..k are the witnesses (uniform among the rest, exactly
+    getRandomPingableMembers excluding the target,
+    ping-req-sender.js:292-295).  The cumsum is int16 when the member
+    count fits (half the HBM of an int32 score matrix, and no
+    ties/argmax-bias questions — ranks are exact)."""
     n = pingable.shape[0]
     count = jnp.sum(pingable, axis=1, dtype=jnp.int32)
-    u = jax.random.uniform(key, (n,))
-    kth = jnp.floor(u * count).astype(jnp.int32)  # uniform in [0, count)
-    csum = jnp.cumsum(pingable.astype(jnp.int32), axis=1)
-    hit = pingable & (csum == (kth + 1)[:, None])
-    target = jnp.argmax(hit, axis=1).astype(jnp.int32)
-    has = count > 0
-    return jnp.where(has, target, -1), has
-
-
-def _rand_scores(key: jax.Array, n: int) -> jax.Array:
-    """uint32[N, N] statistical-quality random scores from one scalar
-    draw + an integer mix per element.  Replaces an N x N threefry
-    tensor for witness sampling: the protocol needs unbiased *selection*,
-    not cryptographic bits, and threefry dominated the step cost."""
-    seed = jax.random.bits(key, dtype=jnp.uint32)
-    i = jnp.arange(n, dtype=jnp.uint32)
-    h = seed ^ (i[:, None] * jnp.uint32(0x9E3779B1)) ^ (
-        i[None, :] * jnp.uint32(0x85EBCA77)
-    )
-    h = (h ^ (h >> jnp.uint32(15))) * jnp.uint32(0xC2B2AE3D)
-    h = (h ^ (h >> jnp.uint32(13))) * jnp.uint32(0x27D4EB2F)
-    return h ^ (h >> jnp.uint32(16))
-
-
-def _choose_witnesses(
-    pingable: jax.Array, target: jax.Array, k: int, key: jax.Array
-) -> tuple[jax.Array, jax.Array]:
-    """k distinct random pingable members excluding the probe target
-    (ping-req-sender.js:292-295 / membership.getRandomPingableMembers)."""
-    n = pingable.shape[0]
-    cols = jnp.arange(n, dtype=jnp.int32)
-    mask = pingable & (cols[None, :] != jnp.where(target < 0, n, target)[:, None])
-    # 31-bit non-negative scores; invalid entries are -1.  k is tiny and
-    # static, so k argmax-and-mask passes select the top-k (lax.top_k on
-    # int32 hits a pathologically slow path: ~100x argmax).
-    score = jnp.where(
-        mask, (_rand_scores(key, n) >> jnp.uint32(1)).astype(jnp.int32), -1
-    )
+    cdtype = jnp.int16 if n - 1 <= 32767 else jnp.int32
+    csum = jnp.cumsum(pingable.astype(cdtype), axis=1)
+    ranks, valid = _distinct_ranks(count, k + 1, key)
     picks = []
-    valids = []
-    for _ in range(k):
-        idx = jnp.argmax(score, axis=1).astype(jnp.int32)
-        picks.append(idx)
-        valids.append(jnp.take_along_axis(score, idx[:, None], axis=1)[:, 0] >= 0)
-        score = jnp.where(cols[None, :] == idx[:, None], -1, score)
-    return jnp.stack(picks, axis=1), jnp.stack(valids, axis=1)
+    for t in range(k + 1):
+        want = (ranks[:, t] + 1).astype(cdtype)
+        hit = pingable & (csum == want[:, None])
+        picks.append(jnp.argmax(hit, axis=1).astype(jnp.int32))
+    target = jnp.where(valid[:, 0], picks[0], -1)
+    wit = jnp.stack(picks[1:], axis=1)
+    return target, valid[:, 0], wit, valid[:, 1:]
 
 
 def _drop(key: jax.Array, shape: tuple, loss: float) -> jax.Array:
@@ -352,11 +382,9 @@ class _Merge(NamedTuple):
 
 def _merge_incoming(
     state: ClusterState,
-    in_status: jax.Array,  # int8[N, N]: claim about j arriving at receiver r
-    in_inc: jax.Array,  # int32[N, N]
-    in_src: jax.Array,  # int32[N, N]
-    in_src_inc: jax.Array,  # int32[N, N]
+    in_key: jax.Array,  # int32[N, N]: claim about j arriving at receiver r (0 = none)
     active: jax.Array,  # bool[N]: receiver r processes input this tick
+    sl_start: int,  # suspicion countdown start value (ticks + 1)
 ) -> _Merge:
     """Apply one batch of incoming changes at every receiver.
 
@@ -369,71 +397,66 @@ def _merge_incoming(
     """
     n = state.n
     eye = jnp.eye(n, dtype=bool)
-
-    in_key = _lattice_key(in_status, in_inc)
-    cur_key = _lattice_key(state.view_status, state.view_inc)
+    cur_key = state.view_key
+    in_status = in_key & 7
 
     # Refutation (membership.js:243-254): any suspect/faulty rumor about
     # self — regardless of incarnation — re-asserts alive with an
     # incarnation beating both the rumor and the current self view.
     rumor_self = (
-        eye
-        & active[:, None]
-        & ((in_status == SUSPECT) | (in_status == FAULTY))
-        & (in_status != NONE)
+        eye & active[:, None] & ((in_status == SUSPECT) | (in_status == FAULTY))
     )
     refuted = jnp.any(rumor_self, axis=1)
-    self_inc = jnp.diagonal(state.view_inc)
-    rumor_inc = jnp.where(rumor_self, in_inc, _KEY_MIN).max(axis=1)
+    self_inc = jnp.diagonal(cur_key) >> 3
+    rumor_inc = jnp.where(rumor_self, in_key >> 3, -1).max(axis=1)
     new_self_inc = jnp.maximum(self_inc, rumor_inc) + 1
 
     apply = (
-        _apply_mask(state.view_status, cur_key, in_status, in_key)
+        _apply_mask(cur_key, in_key)
         & active[:, None]
         & ~eye  # self entries only change via refutation / local ops
     )
 
     # Flap: an applied transition between alive and suspect/faulty in
     # either direction (damping.py _FLAP_SET semantics; extension).
-    was = state.view_status
-    flapped = apply & (
-        ((was == ALIVE) & ((in_status == SUSPECT) | (in_status == FAULTY)))
-        | (((was == SUSPECT) | (was == FAULTY)) & (in_status == ALIVE))
-    )
+    flapped = jnp.zeros((), dtype=bool)
+    if state.damp is not None:
+        was = cur_key & 7
+        flapped = apply & (
+            ((was == ALIVE) & ((in_status == SUSPECT) | (in_status == FAULTY)))
+            | (((was == SUSPECT) | (was == FAULTY)) & (in_status == ALIVE))
+        )
 
-    view_status = jnp.where(apply, in_status, state.view_status)
-    view_inc = jnp.where(apply, in_inc, state.view_inc)
-    src = jnp.where(apply, in_src, state.src)
-    src_inc = jnp.where(apply, in_src_inc, state.src_inc)
-    pb = jnp.where(apply, jnp.int16(0), state.pb)
+    view_key = jnp.where(apply, in_key, cur_key)
+    pb = jnp.where(apply, jnp.int8(0), state.pb)
 
     # Refutation writes the diagonal and records a self-sourced alive change.
     ids = jnp.arange(n, dtype=jnp.int32)
-    diag_status = jnp.where(refuted, ALIVE, jnp.diagonal(view_status)).astype(jnp.int8)
-    diag_inc = jnp.where(refuted, new_self_inc, jnp.diagonal(view_inc))
-    view_status = _set_diag(view_status, diag_status)
-    view_inc = _set_diag(view_inc, diag_inc)
-    src = _set_diag(src, jnp.where(refuted, ids, jnp.diagonal(src)))
-    src_inc = _set_diag(src_inc, jnp.where(refuted, new_self_inc, jnp.diagonal(src_inc)))
-    pb = _set_diag(pb, jnp.where(refuted, jnp.int16(0), jnp.diagonal(pb)))
+    diag_key = jnp.where(
+        refuted, new_self_inc * 8 + ALIVE, jnp.diagonal(view_key)
+    ).astype(jnp.int32)
+    view_key = view_key.at[ids, ids].set(diag_key)
+    pb = pb.at[ids, ids].set(jnp.where(refuted, jnp.int8(0), jnp.diagonal(pb)))
 
     applied = apply | (eye & refuted[:, None])
 
     # Suspicion timers (suspicion.js:45-69 via update-listener:34-45):
-    # applied suspect (re)starts the deadline; applied alive stops it.
-    suspect_at = jnp.where(
-        applied & (view_status == SUSPECT), state.tick, state.suspect_at
+    # applied suspect (re)starts the countdown; applied alive stops it.
+    new_status = view_key & 7
+    suspect_left = jnp.where(
+        applied & (new_status == SUSPECT),
+        jnp.int8(sl_start),
+        state.suspect_left,
     )
-    suspect_at = jnp.where(applied & (view_status == ALIVE), -1, suspect_at)
+    suspect_left = jnp.where(
+        applied & (new_status == ALIVE), jnp.int8(-1), suspect_left
+    )
 
     return _Merge(
         state._replace(
-            view_status=view_status,
-            view_inc=view_inc,
+            view_key=view_key,
             pb=pb,
-            src=src,
-            src_inc=src_inc,
-            suspect_at=suspect_at,
+            suspect_left=suspect_left,
         ),
         applied,
         refuted,
@@ -441,17 +464,12 @@ def _merge_incoming(
     )
 
 
-def _set_diag(mat: jax.Array, d: jax.Array) -> jax.Array:
-    n = mat.shape[0]
-    ids = jnp.arange(n)
-    return mat.at[ids, ids].set(d.astype(mat.dtype))
-
-
 def _declare(
     state: ClusterState,
     viewer_mask: jax.Array,  # bool[N]
     subject: jax.Array,  # int32[N] (index per viewer; clipped where invalid)
     new_status: int,
+    sl_start: int,
 ) -> tuple[ClusterState, jax.Array]:
     """Local declaration (makeSuspect / makeFaulty, membership.js:141-156):
     viewer i re-labels ``subject[i]`` with its currently-known incarnation,
@@ -460,31 +478,19 @@ def _declare(
     n = state.n
     ids = jnp.arange(n, dtype=jnp.int32)
     subj = jnp.clip(subject, 0, n - 1)
-    cur_s = state.view_status[ids, subj]
-    cur_i = state.view_inc[ids, subj]
-    in_key = _lattice_key(jnp.full((n,), new_status, jnp.int8), cur_i)
-    cur_key = _lattice_key(cur_s, cur_i)
-    ok = (
-        viewer_mask
-        & (subj != ids)
-        & _apply_mask(cur_s, cur_key, jnp.full((n,), new_status, jnp.int8), in_key)
+    cur = state.view_key[ids, subj]
+    in_key = jnp.where(cur > 0, (cur >> 3) * 8 + new_status, 0)
+    ok = viewer_mask & (subj != ids) & _apply_mask(cur, in_key)
+    vk = state.view_key.at[ids, subj].set(jnp.where(ok, in_key, cur))
+    pb = state.pb.at[ids, subj].set(
+        jnp.where(ok, jnp.int8(0), state.pb[ids, subj])
     )
-    self_inc = jnp.diagonal(state.view_inc)
-    vs = state.view_status.at[ids, subj].set(
-        jnp.where(ok, jnp.int8(new_status), cur_s).astype(jnp.int8)
-    )
-    pb = state.pb.at[ids, subj].set(jnp.where(ok, jnp.int16(0), state.pb[ids, subj]))
-    src = state.src.at[ids, subj].set(jnp.where(ok, ids, state.src[ids, subj]))
-    src_inc = state.src_inc.at[ids, subj].set(
-        jnp.where(ok, self_inc, state.src_inc[ids, subj])
-    )
-    sus = state.suspect_at
+    sus = state.suspect_left
     if new_status == SUSPECT:
         sus = sus.at[ids, subj].set(
-            jnp.where(ok, state.tick, sus[ids, subj]).astype(jnp.int32)
+            jnp.where(ok, jnp.int8(sl_start), sus[ids, subj])
         )
-    state = state._replace(view_status=vs, pb=pb, src=src, src_inc=src_inc, suspect_at=sus)
-    return state, ok
+    return state._replace(view_key=vk, pb=pb, suspect_left=sus), ok
 
 
 # ---------------------------------------------------------------------------
@@ -498,191 +504,179 @@ def swim_step_impl(
     """One synchronized protocol period for every virtual node.
 
     Phases (intra-tick order convention, see module docstring):
-      1. probe-target selection          (membership-iterator.js)
-      2. sender piggyback issue          (dissemination.issueAsSender)
-      3. ping delivery + receiver merge  (ping-handler.js:34)
+      1. probe-target + witness selection   (membership-iterator.js)
+      2. sender piggyback issue             (dissemination.issueAsSender)
+      3. ping delivery + receiver merge     (ping-handler.js:34)
       4. receiver reply (+ full sync) + sender merge  (ping-handler.js:36-39)
       5. failed probes -> ping-req two-hop -> suspect  (ping-req-sender.js)
-      6. suspicion deadlines -> faulty   (suspicion.js:66-69)
+      6. suspicion countdowns fire -> faulty  (suspicion.js:66-69)
     """
     n = state.n
-    k_target, k_loss1, k_loss2, k_wit, k_loss3 = jax.random.split(key, 5)
+    k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
     ids = jnp.arange(n, dtype=jnp.int32)
-    maxpb = _max_piggyback(state, params.piggyback_factor)  # int32[N]
-    h_pre = _view_hash(state)  # sender checksum claim in the ping body
-    self_inc0 = jnp.diagonal(state.view_inc)  # sender identity claim
+    eye = jnp.eye(n, dtype=bool)
+    if params.suspicion_ticks > 126:
+        raise ValueError(
+            f"suspicion_ticks={params.suspicion_ticks} exceeds the int8 "
+            "countdown range (max 126); raise period_ms instead"
+        )
+    sl_start = int(params.suspicion_ticks) + 1
 
-    # -- phase 1: who probes whom ------------------------------------------
-    own_status = jnp.diagonal(state.view_status)
+    # -- phase 0: period-start derived views --------------------------------
+    status = state.view_key & 7
+    status_ok = (status == ALIVE) | (status == SUSPECT)
+    pingable = status_ok & ~eye
+    maxpb = _max_piggyback(status_ok, params.piggyback_factor)  # int32[N]
+    h_pre = _view_hash(state)  # sender checksum claim in the ping body
+
+    # -- phase 1: who probes whom; who witnesses ----------------------------
+    own_status = jnp.diagonal(status)
     gossiping = (
         net.up & net.responsive & ((own_status == ALIVE) | (own_status == SUSPECT))
     )
-    target, has_target = _choose_targets(_pingable(state), k_target)
+    target, has_target, wit, wit_valid = _choose_targets_and_witnesses(
+        pingable, params.ping_req_size, k_sel
+    )
+    # Barrier: the N x N random-score matrix must be dead before phase 3
+    # allocates its own N x N int32 buffers — without it XLA's scheduler
+    # overlaps their lifetimes and a 32k-node step blows past HBM.
+    target, has_target, wit, wit_valid = jax.lax.optimization_barrier(
+        (target, has_target, wit, wit_valid)
+    )
     sends = gossiping & has_target
     t_safe = jnp.where(sends, target, 0)
 
-    # -- phase 2: sender issues its active changes -------------------------
+    # -- phase 2: sender issues its active changes --------------------------
+    # All piggyback arithmetic stays in int8: stored pb <= 126 (the budget
+    # clamp), so pb + 1 <= 127 never overflows, and no N x N int32 pb
+    # temporary ever materializes (4 GB at n=32k).
+    maxpb8 = maxpb.astype(jnp.int8)[:, None]
     has_change = state.pb >= 0
-    pb_next = jnp.where(has_change & sends[:, None], state.pb + 1, state.pb)
-    issued_s = has_change & sends[:, None] & (pb_next <= maxpb[:, None].astype(jnp.int16))
+    bump = has_change & sends[:, None]
+    pb_next = jnp.where(bump, state.pb + jnp.int8(1), state.pb)
+    issued_s = bump & (pb_next <= maxpb8)
     # eviction past the budget, only on issue attempts (dissemination.js:
     # 147-151; counted even if the packet is then lost in the network)
-    pb_next = jnp.where(
-        sends[:, None] & (pb_next > maxpb[:, None].astype(jnp.int16)),
-        jnp.int16(-1),
-        pb_next,
-    )
+    pb_next = jnp.where(bump & (pb_next > maxpb8), jnp.int8(-1), pb_next)
     state = state._replace(pb=pb_next)
 
-    # -- phase 3: delivery + receiver-side merge ---------------------------
+    # -- phase 3: delivery + receiver-side merge ----------------------------
     resp = net.up & net.responsive
     fwd_ok = (
         sends
-        & net.adj[ids, t_safe]
+        & _adj(net, ids, t_safe)
         & ~_drop(k_loss1, (n,), params.loss)
         & resp[t_safe]
     )
-    # scatter-max incoming claims into receiver rows; ties share the key,
-    # payload (src, src_inc) resolved by two more masked scatter-maxes.
-    key_out = jnp.where(
-        issued_s & fwd_ok[:, None],
-        _lattice_key(state.view_status, state.view_inc),
-        _KEY_MIN,
-    )
-    best = jnp.full((n, n), _KEY_MIN, dtype=jnp.int32).at[t_safe].max(key_out)
-    winner = (key_out > _KEY_MIN) & (key_out == best[t_safe])
-    best_src = (
-        jnp.full((n, n), -1, dtype=jnp.int32)
+    # delivered[s, j]: sender s issued-and-delivered a claim about j this
+    # tick (the anti-echo reference — a pred, not a 4 GB key snapshot).
+    delivered = issued_s & fwd_ok[:, None]
+    # scatter-max into receiver rows; concurrent claims merge at the
+    # lattice maximum (documented tick convention).
+    in_key = (
+        jnp.zeros((n, n), dtype=jnp.int32)
         .at[t_safe]
-        .max(jnp.where(winner, state.src, -1))
+        .max(jnp.where(delivered, state.view_key, 0))
     )
-    src_winner = winner & (state.src == best_src[t_safe])
-    best_src_inc = (
-        jnp.full((n, n), -1, dtype=jnp.int32)
-        .at[t_safe]
-        .max(jnp.where(src_winner, state.src_inc, -1))
-    )
-    in_exists = best > _KEY_MIN
-    in_status = jnp.where(in_exists, (best % 8).astype(jnp.int8), jnp.int8(NONE))
-    in_inc = jnp.where(in_exists, best // 8, 0).astype(jnp.int32)
     inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(fwd_ok.astype(jnp.int32))
     got_ping = inbound > 0
 
-    merged = _merge_incoming(state, in_status, in_inc, best_src, best_src_inc, got_ping)
+    merged = _merge_incoming(state, in_key, got_ping, sl_start)
     state = merged.state
     ping_applied = jnp.sum(merged.applied, dtype=jnp.int32)
+    # Barrier: in_key (N x N int32) dies here, before phase 4's reply
+    # gather allocates (see phase-1 barrier comment).
+    state, ping_applied = jax.lax.optimization_barrier((state, ping_applied))
 
-    # -- phase 4: receiver replies; sender merges the ack ------------------
-    maxpb2 = _max_piggyback(state, params.piggyback_factor)
+    # -- phase 4: receiver replies; sender merges the ack -------------------
     has_change2 = state.pb >= 0
     # issue-as-receiver: one issued set per tick; counter advances by the
     # number of pings served (documented tick-model convention).
-    rep_issuable = has_change2 & got_ping[:, None] & (
-        (state.pb + 1).astype(jnp.int32) <= maxpb2[:, None]
+    rep_issuable = (
+        has_change2 & got_ping[:, None] & (state.pb + jnp.int8(1) <= maxpb8)
     )
+    # pb + inbound could exceed int8, but anything past the budget evicts
+    # to -1 anyway — test the eviction bound BEFORE adding (both sides
+    # int8-safe: maxpb <= 126, inbound clamps to 127) so the whole update
+    # stays int8 with no wider N x N temporary.
+    inb8 = jnp.minimum(inbound, 127).astype(jnp.int8)[:, None]
+    served = got_ping[:, None] & has_change2
+    evict = served & (state.pb > maxpb8 - inb8)
     pb_after = jnp.where(
-        has_change2 & got_ping[:, None],
-        state.pb + inbound[:, None].astype(jnp.int16),
-        state.pb,
-    )
-    pb_after = jnp.where(
-        got_ping[:, None] & (pb_after.astype(jnp.int32) > maxpb2[:, None]),
-        jnp.int16(-1),
-        pb_after,
+        evict, jnp.int8(-1), jnp.where(served, state.pb + inb8, state.pb)
     )
     state = state._replace(pb=pb_after)
 
     h_post = _view_hash(state)
-    # per-(sender i, receiver t) view of the reply: anti-echo filters
-    # changes i itself originated (dissemination.js:86-98)
-    rep_row = rep_issuable[t_safe]  # bool[N(sender), N(subject)]
-    echo = (state.src[t_safe] == ids[:, None]) & (
-        state.src_inc[t_safe] == self_inc0[:, None]
-    )
-    rep_row = rep_row & ~echo
+    # per-(sender s, receiver t) view of the reply: the receiver's current
+    # claims; anti-echo (value form, see module docstring) drops claims
+    # equal to what s itself holds now — s delivered the claim this tick,
+    # so equality means s provably already has it.
+    reply_key = state.view_key[t_safe]  # int32[N(sender), N(subject)]
+    rep_row = rep_issuable[t_safe] & ~(delivered & (reply_key == state.view_key))
     # full sync (dissemination.js:100-118): nothing to say but checksums
-    # disagree -> entire view row, self-sourced, no source incarnation
-    full_sync = (
-        fwd_ok & ~jnp.any(rep_row, axis=1) & (h_post[t_safe] != h_pre)
-    )
-    exists_row = state.view_status[t_safe] != NONE
-    send_row = jnp.where(full_sync[:, None], exists_row, rep_row)
+    # disagree -> entire view row
+    full_sync = fwd_ok & ~jnp.any(rep_row, axis=1) & (h_post[t_safe] != h_pre)
+    send_row = jnp.where(full_sync[:, None], reply_key > 0, rep_row)
 
-    bwd_ok = fwd_ok & net.adj[t_safe, ids] & ~_drop(k_loss2, (n,), params.loss)
-    ack = bwd_ok
+    ack = fwd_ok & _adj(net, t_safe, ids) & ~_drop(k_loss2, (n,), params.loss)
 
-    in2_mask = send_row & ack[:, None]
-    in2_status = jnp.where(in2_mask, state.view_status[t_safe], jnp.int8(NONE))
-    in2_inc = jnp.where(in2_mask, state.view_inc[t_safe], 0)
-    in2_src = jnp.where(
-        in2_mask,
-        jnp.where(full_sync[:, None], t_safe[:, None], state.src[t_safe]),
-        -1,
-    )
-    in2_src_inc = jnp.where(
-        in2_mask,
-        jnp.where(full_sync[:, None], -1, state.src_inc[t_safe]),
-        -1,
-    )
-    merged2 = _merge_incoming(state, in2_status, in2_inc, in2_src, in2_src_inc, ack)
+    in2_key = jnp.where(send_row & ack[:, None], reply_key, 0)
+    merged2 = _merge_incoming(state, in2_key, ack, sl_start)
     state = merged2.state
     ack_applied = jnp.sum(merged2.applied, dtype=jnp.int32)
 
-    # -- phase 5: ping-req for failed probes (ping-req-sender.js) ----------
+    # -- phase 5: ping-req for failed probes (ping-req-sender.js) -----------
     failed = sends & ~ack
-    wit, wit_valid = _choose_witnesses(_pingable(state), target, params.ping_req_size, k_wit)
     k_a, k_b, k_c, k_d = jax.random.split(k_loss3, 4)
     kshape = (n, params.ping_req_size)
     wit_safe = jnp.clip(wit, 0, n - 1)
     req_ok = (
         failed[:, None]
         & wit_valid
-        & net.adj[ids[:, None], wit_safe]
+        & _adj(net, ids[:, None], wit_safe)
         & ~_drop(k_a, kshape, params.loss)
         & resp[wit_safe]
     )
     wt_ok = (
         req_ok
-        & net.adj[wit_safe, t_safe[:, None]]
+        & _adj(net, wit_safe, t_safe[:, None])
         & ~_drop(k_b, kshape, params.loss)
         & resp[t_safe][:, None]
-        & net.adj[t_safe[:, None], wit_safe]
+        & _adj(net, t_safe[:, None], wit_safe)
         & ~_drop(k_c, kshape, params.loss)
     )
-    relay_ok = net.adj[wit_safe, ids[:, None]] & ~_drop(k_d, kshape, params.loss)
+    relay_ok = jnp.broadcast_to(
+        _adj(net, wit_safe, ids[:, None]) & ~_drop(k_d, kshape, params.loss), kshape
+    )
     any_success = jnp.any(wt_ok & relay_ok, axis=1)
     # all witnesses answered "target unreachable" and none succeeded ->
     # suspect (ping-req-sender.js:238-267); no witness response at all is
     # inconclusive (:268-282)
     definite_fail = jnp.any(req_ok & ~wt_ok & relay_ok, axis=1)
     declare_suspect = failed & ~any_success & definite_fail
-    was_alive_at_target = state.view_status[ids, jnp.clip(t_safe, 0, n - 1)] == ALIVE
-    state, declared = _declare(state, declare_suspect, t_safe, SUSPECT)
+    was_alive_at_target = (state.view_key[ids, t_safe] & 7) == ALIVE
+    state, declared = _declare(state, declare_suspect, t_safe, SUSPECT, sl_start)
 
-    # -- phase 6: suspicion deadlines fire -> faulty (suspicion.js:66-69) --
-    expired = (
-        (state.suspect_at >= 0)
-        & (state.tick - state.suspect_at >= params.suspicion_ticks)
-        & (state.view_status == SUSPECT)
-        & gossiping[:, None]  # a stopped/dead process fires no timers
+    # -- phase 6: suspicion countdowns fire -> faulty (suspicion.js:66-69) --
+    sl = state.suspect_left
+    sl1 = jnp.where(sl > 0, sl - 1, sl)
+    expired = (sl1 == 0) & ((state.view_key & 7) == SUSPECT) & gossiping[:, None]
+    vk = jnp.where(
+        expired, (state.view_key >> 3) * 8 + FAULTY, state.view_key
     )
-    vs = jnp.where(expired, jnp.int8(FAULTY), state.view_status)
-    pb = jnp.where(expired, jnp.int16(0), state.pb)
-    src = jnp.where(expired, ids[:, None], state.src)
-    src_inc = jnp.where(expired, jnp.diagonal(state.view_inc)[:, None], state.src_inc)
-    sus = jnp.where(expired, -1, state.suspect_at)
-    state = state._replace(
-        view_status=vs, pb=pb, src=src, src_inc=src_inc, suspect_at=sus
-    )
+    pb = jnp.where(expired, jnp.int8(0), state.pb)
+    sl1 = jnp.where(expired, jnp.int8(-1), sl1)
+    state = state._replace(view_key=vk, pb=pb, suspect_left=sl1)
 
-    # -- damping extension (active only with damp tensors present) ---------
+    # -- damping extension (active only with damp tensors present) ----------
     n_damped = jnp.int32(0)
     if state.damp is not None:
         flaps = merged.flapped | merged2.flapped
         # a viewer that itself declares alive->suspect flaps too (the host
         # library scores these via the membership 'updated' event)
         declare_flap = declared & was_alive_at_target
-        flaps = flaps.at[ids, jnp.clip(t_safe, 0, n - 1)].max(declare_flap)
+        flaps = flaps.at[ids, t_safe].max(declare_flap)
         damp = (
             state.damp.astype(jnp.float32) * params.damp_decay_per_tick
             + jnp.where(flaps, jnp.float32(params.damp_penalty), 0.0)
@@ -715,14 +709,15 @@ def swim_run_impl(
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """``ticks`` protocol periods under lax.scan (one compiled program)."""
 
-    def body(carry, subkey):
-        st, _ = carry
-        st, m = swim_step_impl(st, net, subkey, params)
-        return (st, m), None
+    def body(st, subkey):
+        return swim_step_impl(st, net, subkey, params)
 
     keys = jax.random.split(key, ticks)
-    st0, m0 = swim_step_impl(state, net, keys[0], params)
-    (state, metrics), _ = jax.lax.scan(body, (st0, m0), keys[1:])
+    # Carry is the state alone (scalar metrics stack as scan outputs): a
+    # (state, metrics) carry made XLA double-buffer the 4 GB view tensor
+    # inside the loop, the difference between fitting 32k nodes and OOM.
+    state, ms = jax.lax.scan(body, state, keys)
+    metrics = jax.tree_util.tree_map(lambda x: x[-1], ms)
     return state, metrics
 
 
@@ -742,63 +737,51 @@ def admin_join(state: ClusterState, joiner: int, seed: int) -> ClusterState:
     """Bootstrap join against a seed (join-sender.js + join-handler.js):
     the seed marks the joiner alive and answers with a full membership
     sync; the joiner adopts it wholesale and both record the changes."""
-    vs, vi = state.view_status, state.view_inc
-    j_inc = vi[joiner, joiner]
-    j_status = vs[joiner, joiner]
+    vk = state.view_key
+    j_key = vk[joiner, joiner]
+    j_inc = j_key >> 3
 
     # seed: makeAlive(joiner) (join-handler.js:90)
-    cur_key = _lattice_key(vs[seed, joiner], vi[seed, joiner])
-    in_key = _lattice_key(jnp.int8(ALIVE), j_inc)
-    ok = _apply_mask(vs[seed, joiner], cur_key, jnp.int8(ALIVE), in_key)
-    vs = vs.at[seed, joiner].set(jnp.where(ok, ALIVE, vs[seed, joiner]).astype(jnp.int8))
-    vi = vi.at[seed, joiner].set(jnp.where(ok, j_inc, vi[seed, joiner]))
+    in_key = j_inc * 8 + ALIVE
+    ok = _apply_mask(vk[seed, joiner], in_key)
+    vk = vk.at[seed, joiner].set(jnp.where(ok, in_key, vk[seed, joiner]))
     pb = state.pb.at[seed, joiner].set(
-        jnp.where(ok, 0, state.pb[seed, joiner]).astype(jnp.int16)
-    )
-    src = state.src.at[seed, joiner].set(jnp.where(ok, seed, state.src[seed, joiner]))
-    src_inc = state.src_inc.at[seed, joiner].set(
-        jnp.where(ok, vi[seed, seed], state.src_inc[seed, joiner])
+        jnp.where(ok, 0, state.pb[seed, joiner]).astype(jnp.int8)
     )
 
     # joiner: adopt the seed's row (full sync), keep own self entry, and
     # record everything learned (membership-set-listener.js:33-47)
-    row_s = vs[seed]
-    row_i = vi[seed]
-    learned = (row_s != NONE) & (jnp.arange(state.n) != joiner)
-    vs = vs.at[joiner].set(jnp.where(learned, row_s, vs[joiner]).astype(jnp.int8))
-    vi = vi.at[joiner].set(jnp.where(learned, row_i, vi[joiner]))
-    vs = vs.at[joiner, joiner].set(jnp.where(j_status == NONE, ALIVE, j_status).astype(jnp.int8))
-    pb = pb.at[joiner].set(jnp.where(learned, 0, pb[joiner]).astype(jnp.int16))
-    src = src.at[joiner].set(jnp.where(learned, seed, src[joiner]))
-    src_inc = src_inc.at[joiner].set(jnp.where(learned, row_i[seed], src_inc[joiner]))
-    return state._replace(view_status=vs, view_inc=vi, pb=pb, src=src, src_inc=src_inc)
+    row = vk[seed]
+    learned = (row > 0) & (jnp.arange(state.n) != joiner)
+    vk = vk.at[joiner].set(jnp.where(learned, row, vk[joiner]))
+    vk = vk.at[joiner, joiner].set(jnp.where(j_key == 0, jnp.int32(ALIVE), j_key))
+    pb = pb.at[joiner].set(jnp.where(learned, 0, pb[joiner]).astype(jnp.int8))
+    return state._replace(view_key=vk, pb=pb)
 
 
 def admin_leave(state: ClusterState, node: int) -> ClusterState:
     """makeLeave(self) (admin-leave-handler.js:48-52): the node marks
     itself leave (stopping its gossip via the own-status gate) and records
     the change for dissemination by peers that ping it."""
-    vs = state.view_status.at[node, node].set(LEAVE)
+    self_inc = state.view_key[node, node] >> 3
+    vk = state.view_key.at[node, node].set(self_inc * 8 + LEAVE)
     pb = state.pb.at[node, node].set(0)
-    src = state.src.at[node, node].set(node)
-    src_inc = state.src_inc.at[node, node].set(state.view_inc[node, node])
-    return state._replace(view_status=vs, pb=pb, src=src, src_inc=src_inc)
+    return state._replace(view_key=vk, pb=pb)
 
 
 def revive(state: ClusterState, node: int, inc: int) -> ClusterState:
     """A killed process restarts fresh (tick-cluster.js:418-430): wipe its
     row to self-only with a new (higher) incarnation; re-entry to the
     cluster is an ``admin_join``."""
+    _check_inc(inc)
     n = state.n
-    row = jnp.where(jnp.arange(n) == node, ALIVE, NONE).astype(jnp.int8)
-    inc_row = jnp.where(jnp.arange(n) == node, jnp.int32(inc), 0)
+    row = jnp.where(
+        jnp.arange(n) == node, jnp.int32(inc) * 8 + ALIVE, 0
+    ).astype(jnp.int32)
     state = state._replace(
-        view_status=state.view_status.at[node].set(row),
-        view_inc=state.view_inc.at[node].set(inc_row),
+        view_key=state.view_key.at[node].set(row),
         pb=state.pb.at[node].set(-1),
-        src=state.src.at[node].set(-1),
-        src_inc=state.src_inc.at[node].set(-1),
-        suspect_at=state.suspect_at.at[node].set(-1),
+        suspect_left=state.suspect_left.at[node].set(-1),
     )
     if state.damp is not None:  # a fresh process has no damp memory
         state = state._replace(

@@ -19,7 +19,7 @@ the TPU interconnect. (The reference's TChannel/NCCL-style point-to-point
 RPC — SURVEY §5.8 — has no place in an SPMD program; collectives are the
 TPU-native equivalent.)
 
-Scaling: one chip's HBM bounds N at roughly sqrt(HBM / ~19 bytes); row
+Scaling: one chip's HBM bounds N at roughly sqrt(HBM / ~6 bytes); row
 sharding across D chips raises the bound by sqrt(D) at fixed per-chip
 memory, which is how the 65k-node BASELINE config is reached on a pod
 slice.
@@ -63,21 +63,26 @@ def state_sharding(mesh: Mesh, damping: bool = False) -> ClusterState:
     row = NamedSharding(mesh, P(AXIS, None))
     rep = NamedSharding(mesh, P())
     return ClusterState(
-        view_status=row,
-        view_inc=row,
+        view_key=row,
         pb=row,
-        src=row,
-        src_inc=row,
-        suspect_at=row,
+        suspect_left=row,
         tick=rep,
         damp=row if damping else None,
         damped=row if damping else None,
     )
 
 
-def net_sharding(mesh: Mesh) -> NetState:
+def net_sharding(mesh: Mesh, like: NetState | None = None) -> NetState:
+    """Shardings for ``NetState``; default assumes the healthy network
+    (``adj=None``, the ``make_net`` default) — pass ``like=net`` when the
+    net carries a materialized adjacency mask."""
     rep = NamedSharding(mesh, P())
-    return NetState(up=rep, responsive=rep, adj=NamedSharding(mesh, P(AXIS, None)))
+    has_adj = like is not None and like.adj is not None
+    return NetState(
+        up=rep,
+        responsive=rep,
+        adj=NamedSharding(mesh, P(AXIS, None)) if has_adj else None,
+    )
 
 
 def shard_cluster(
@@ -91,43 +96,57 @@ def shard_cluster(
     damping = state.damp is not None
     return (
         jax.device_put(state, state_sharding(mesh, damping)),
-        jax.device_put(net, net_sharding(mesh)),
+        jax.device_put(net, net_sharding(mesh, like=net)),
     )
 
 
 def sharded_step(
-    mesh: Mesh, damping: bool = False, like: ClusterState | None = None
+    mesh: Mesh,
+    damping: bool = False,
+    like: ClusterState | None = None,
+    net_like: NetState | None = None,
 ) -> Callable:
     """``swim_step`` compiled for the mesh: (state, net, key, params) ->
     (state, metrics), state rows pinned to their owning chips.
 
-    Pass ``like=state`` to infer the damping layout from the state itself
-    (a mismatched manual flag fails deep inside jit with an opaque
-    pytree-structure error)."""
+    Pass ``like=state`` / ``net_like=net`` to infer the damping/adjacency
+    layout from the values themselves (a mismatched manual flag fails
+    deep inside jit with an opaque pytree-structure error)."""
     if like is not None:
         damping = like.damp is not None
     rep = NamedSharding(mesh, P())
     return jax.jit(
         swim_step_impl,
         static_argnames=("params",),
-        in_shardings=(state_sharding(mesh, damping), net_sharding(mesh), rep),
+        in_shardings=(
+            state_sharding(mesh, damping),
+            net_sharding(mesh, like=net_like),
+            rep,
+        ),
         out_shardings=(state_sharding(mesh, damping), rep),
         donate_argnums=(0,),
     )
 
 
 def sharded_run(
-    mesh: Mesh, damping: bool = False, like: ClusterState | None = None
+    mesh: Mesh,
+    damping: bool = False,
+    like: ClusterState | None = None,
+    net_like: NetState | None = None,
 ) -> Callable:
     """``swim_run`` (lax.scan over ticks) compiled for the mesh.  See
-    ``sharded_step`` for ``like``."""
+    ``sharded_step`` for ``like``/``net_like``."""
     if like is not None:
         damping = like.damp is not None
     rep = NamedSharding(mesh, P())
     return jax.jit(
         swim_run_impl,
         static_argnames=("params", "ticks"),
-        in_shardings=(state_sharding(mesh, damping), net_sharding(mesh), rep),
+        in_shardings=(
+            state_sharding(mesh, damping),
+            net_sharding(mesh, like=net_like),
+            rep,
+        ),
         out_shardings=(state_sharding(mesh, damping), rep),
         donate_argnums=(0,),
     )

@@ -20,9 +20,11 @@ pytestmark = pytest.mark.skipif(
 
 def test_mesh_and_placement():
     mesh = parallel.make_mesh(8)
-    state, net = parallel.shard_cluster(sim.init_state(64), sim.make_net(64), mesh)
+    state, net = parallel.shard_cluster(
+        sim.init_state(64), sim.make_net(64, partitioned=True), mesh
+    )
     # Rows really are distributed: 8 shards of 8 rows each.
-    shard_shapes = {s.data.shape for s in state.view_status.addressable_shards}
+    shard_shapes = {s.data.shape for s in state.view_key.addressable_shards}
     assert shard_shapes == {(8, 64)}
     assert len(net.adj.addressable_shards) == 8
 
