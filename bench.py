@@ -98,6 +98,13 @@ def child_main(sizes: list[int]) -> None:
     subsequent allocation fails RESOURCE_EXHAUSTED), so the parent
     retries smaller sizes in fresh processes.
     """
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The env var alone is not enough: the ambient TPU plugin still
+        # contacts the (possibly hung) tunnel on backend init.  The
+        # config-level pin keeps the CPU fallback truly tunnel-free.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     last_err = None
     for n in sizes:
         try:

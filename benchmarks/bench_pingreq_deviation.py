@@ -1,0 +1,116 @@
+"""Measured deviation bound for the sim's omitted ping-req piggyback.
+
+The reference ships piggybacked changes with the ping-req and applies
+them at the witness (lib/swim/ping-req-sender.js:80-86,
+server/ping-req-handler.js:37-59).  The host library here does the same;
+the TPU simulation's phase 5 probes reachability only (a documented,
+traffic-level deviation — swim_sim.py module docstring).
+
+This harness quantifies the deviation where it could matter: lossy
+networks, where failed direct pings make ping-reqs (and their omitted
+piggyback) frequent.  Metric: failure-detection-and-dissemination
+latency — protocol periods from killing one node of a converged cluster
+until EVERY live node has declared it faulty (suspect -> suspicion
+timeout -> faulty rumor spread, SURVEY §3.3).
+
+* host = the full library (WITH ping-req piggyback) over the in-process
+  transport with per-request loss, deterministic virtual time;
+* sim  = the tensor backend (WITHOUT it) at iid per-message loss.
+
+Prints one JSON line per (loss, backend) with mean/max periods over
+SEEDS runs, then a summary ratio.  Run: python benchmarks/bench_pingreq_deviation.py
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+
+# n=8 protocol-behavior measurement: CPU-only by design, and pinned at
+# the config level — the env var alone still lets the ambient TPU plugin
+# contact the (possibly hung) tunnel on backend init.
+jax.config.update("jax_platforms", "cpu")
+
+from ringpop_tpu.harness import Cluster
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import SwimParams
+
+N = 8
+VICTIM = 2
+SEEDS = 5
+PERIOD_MS = 200.0
+LOSSES = (0.01, 0.05)
+MAX_PERIODS = 2000
+
+
+def host_periods_to_detect(loss: float, seed: int) -> float:
+    cluster = Cluster(size=N, seed=seed)
+    cluster.bootstrap_all()
+    assert cluster.run_until_converged(), "host bootstrap did not converge"
+    cluster.network.set_drop_rate(loss)
+    victim_addr = cluster.host_ports[VICTIM]
+    t0 = cluster.scheduler.now()
+    cluster.kill(VICTIM)
+    live = [n for i, n in enumerate(cluster.nodes) if i != VICTIM]
+    for _ in range(MAX_PERIODS):
+        if all(
+            (m := n.membership.find_member_by_address(victim_addr)) is not None
+            and m.status == "faulty"
+            for n in live
+        ):
+            return (cluster.scheduler.now() - t0) / PERIOD_MS
+        cluster.run(PERIOD_MS)
+    raise AssertionError(f"host never detected the death (loss={loss})")
+
+
+def sim_ticks_to_detect(loss: float, seed: int) -> float:
+    simc = SimCluster(N, SwimParams(loss=loss), seed=seed)
+    simc.kill(VICTIM)
+    live = [i for i in range(N) if i != VICTIM]
+    for tick in range(1, MAX_PERIODS + 1):
+        simc.tick()
+        status = np.asarray(simc.state.view_status[:, VICTIM])
+        if all(status[i] == sim.FAULTY for i in live):
+            return float(tick)
+    raise AssertionError(f"sim never detected the death (loss={loss})")
+
+
+def main() -> None:
+    summary = {}
+    for loss in LOSSES:
+        host = [host_periods_to_detect(loss, s) for s in range(SEEDS)]
+        simv = [sim_ticks_to_detect(loss, s) for s in range(SEEDS)]
+        for name, vals in (("host_with_pingreq_piggyback", host), ("sim_without", simv)):
+            print(
+                json.dumps(
+                    {
+                        "metric": f"death_detect_periods_{name}_loss{loss}",
+                        "mean": round(statistics.mean(vals), 1),
+                        "max": round(max(vals), 1),
+                        "unit": "protocol-periods",
+                    }
+                ),
+                flush=True,
+            )
+        summary[loss] = statistics.mean(simv) / statistics.mean(host)
+    print(
+        json.dumps(
+            {
+                "metric": "pingreq_piggyback_deviation_ratio",
+                "value": {str(k): round(v, 2) for k, v in summary.items()},
+                "unit": "sim/host mean detection latency (1.0 = no deviation)",
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

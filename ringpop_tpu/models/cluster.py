@@ -109,7 +109,9 @@ class SimCluster:
 
     def live_indices(self) -> np.ndarray:
         up = np.asarray(self.net.up) & np.asarray(self.net.responsive)
-        own = np.asarray(jnp.diagonal(self.state.view_status))
+        # Diagonal first, then unpack: the view_status property would
+        # materialize the full N x N unpacked tensor.
+        own = np.asarray(jnp.diagonal(self.state.view_key)) & 7
         gossiping = up & ((own == sim.ALIVE) | (own == sim.SUSPECT))
         return np.flatnonzero(gossiping)
 
@@ -123,10 +125,17 @@ class SimCluster:
     def checksums(self, indices: Sequence[int] | None = None) -> dict[str, int]:
         """Reference-format membership checksum per (live) node address."""
         idx = self.live_indices() if indices is None else np.asarray(indices)
-        vs = np.asarray(self.state.view_status)
-        vi = np.asarray(self.state.view_inc)
-        sums = cksum.view_checksums(self.book, vs, vi, self.base_inc, idx)
-        return {self.book.addresses[i]: c for i, c in sums.items()}
+        # Pull only the requested rows, unpacking on host (row-sized work;
+        # the view_status/view_inc properties would unpack all N x N).
+        keys = np.asarray(self.state.view_key[jnp.asarray(idx)])
+        sums = cksum.view_checksums(
+            self.book,
+            (keys & 7).astype(np.int8),
+            keys >> 3,
+            self.base_inc,
+            np.arange(len(idx)),
+        )
+        return {self.book.addresses[i]: c for i, c in zip(idx, sums.values())}
 
     def checksum_groups(self) -> dict[int, list[str]]:
         groups: dict[int, list[str]] = {}
@@ -136,9 +145,8 @@ class SimCluster:
 
     def members(self, viewer: int) -> list[dict]:
         """The viewer's member list, reference getStats shape."""
-        vs = np.asarray(self.state.view_status[viewer])
-        vi = np.asarray(self.state.view_inc[viewer])
-        return cksum.row_members(self.book, vs, vi, self.base_inc)
+        row = np.asarray(self.state.view_key[viewer])
+        return cksum.row_members(self.book, row & 7, row >> 3, self.base_inc)
 
     # -- lookup (ring derived from a node's view, lib/ring.js) ---------------
 
@@ -185,7 +193,9 @@ class SimCluster:
         """Restart a killed node as a fresh process and re-join it
         (tick-cluster.js:418-430 -> admin-join-handler.js:47-51)."""
         if inc is None:
-            inc = int(jnp.max(self.state.view_inc)) + 1000
+            # max(view_key) >> 3 == max(view_inc): the key is monotone in
+            # inc (status occupies only the low 3 bits).
+            inc = int(jnp.max(self.state.view_key) >> 3) + 1000
         else:
             inc = inc - self.base_inc
         self.state = sim.revive(self.state, i, inc)
@@ -215,9 +225,14 @@ class SimCluster:
         self.net = self.net._replace(adj=jnp.asarray(same))
 
     def heal_partition(self) -> None:
-        # Back to fully connected: drop the mask entirely (adj=None) so the
-        # healthy steady state pays no N x N adjacency traffic.
-        self.net = self.net._replace(adj=None)
+        # Keep the pytree structure stable: a net that has carried an
+        # adjacency mask heals to an all-ones mask (a compiled
+        # sharded_step's in_shardings would otherwise mismatch on
+        # adj array -> None); a never-partitioned net stays adj=None.
+        if self.net.adj is not None:
+            self.net = self.net._replace(
+                adj=jnp.ones((self.n, self.n), dtype=bool)
+            )
 
     def set_loss(self, p: float) -> None:
         self.params = self.params._replace(loss=float(p))
@@ -225,7 +240,7 @@ class SimCluster:
     # -- stats ---------------------------------------------------------------
 
     def status_counts(self, viewer: int) -> dict[str, int]:
-        vs = np.asarray(self.state.view_status[viewer])
+        vs = np.asarray(self.state.view_key[viewer]) & 7
         return {
             name: int((vs == code).sum()) for code, name in sim.STATUS_NAMES.items()
         }

@@ -319,7 +319,9 @@ def view_checksums_native(
         status_buf,
         status_off.ctypes.data,
         len(status_off) - 1,
-        status.shape[0],
+        # n_nodes is the member/column count — NOT the row count: callers
+        # may pass a row subset (rows x n_nodes), e.g. live views only.
+        status.shape[1] if status.ndim == 2 else status.shape[0],
         int(none_code),
         rows.ctypes.data,
         len(rows),

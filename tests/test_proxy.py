@@ -219,3 +219,144 @@ def test_proxy_req_validates_props():
     with pytest.raises(errors.OptionsRequiredError):
         c.nodes[0].proxy_req(None)
     c.destroy_all()
+
+
+def two_keys_that_diverge(cluster, owner):
+    """Two keys owned by `owner` now that split to different survivors
+    once the owner leaves the ring (computed on a scratch ring)."""
+    from ringpop_tpu.hashring import HashRing
+
+    scratch = HashRing()
+    scratch.add_remove_servers(
+        [n.whoami() for n in cluster.nodes if n is not owner], []
+    )
+    by_new_owner = {}
+    for i in range(20000):
+        key = f"div-{i}"
+        if owner.lookup(key) != owner.whoami():
+            continue
+        new_owner = scratch.lookup(key)
+        if new_owner not in by_new_owner:
+            by_new_owner[new_owner] = key
+        if len(by_new_owner) >= 2:
+            return list(by_new_owner.values())[:2]
+    raise AssertionError("no diverging key pair found")
+
+
+def test_key_divergence_aborts_retry():
+    """A multi-key proxied request whose keys re-resolve to more than one
+    destination on retry aborts with KeysDivergedError
+    (send.js:90-103; reference proxy-test.js 'aborts retry on key
+    divergence')."""
+    c = converged_cluster(3)
+    sender = c.nodes[0]
+    owner = c.nodes[1]
+    k1, k2 = two_keys_that_diverge(c, owner)
+    assert sender.lookup(k1) == owner.whoami() == sender.lookup(k2)
+
+    events = []
+    sender.on("requestProxy.retryAborted", lambda *a: events.append("aborted"))
+    done = []
+    req = ProxyRequest(url="/multi", method="POST", body="payload")
+    res = ProxyResponse(lambda err, resp: done.append((err, resp)))
+    # Owner dies first; the ring still routes both keys to it, so the
+    # send times out.  The (single) retry fires only after the cluster
+    # has declared the owner faulty — by then the two keys re-resolve to
+    # two different survivors and the retry must abort.
+    c.kill(1)
+    sender.proxy_req(
+        {
+            "keys": [k1, k2],
+            "dest": owner.whoami(),
+            "req": req,
+            "res": res,
+            "timeout": 500,
+            "retrySchedule": [30.0],
+        }
+    )
+    c.run(120000)
+    assert c.run_until_converged(60000)
+    c.run(5000)
+
+    assert done, "proxy response never fired"
+    # Proxy errors surface as a 500 response to the app caller
+    # (request-proxy/index.js sendError), not a transport error.
+    err, resp = done[0]
+    assert err is None
+    assert resp.status_code == 500
+    assert "diverged" in resp.body
+    assert events == ["aborted"]
+    # both keys now resolve away from the dead owner, to two nodes
+    assert sender.lookup(k1) != sender.lookup(k2)
+    c.destroy_all()
+
+
+def test_endpoint_override():
+    """proxyReq forwards to a custom endpoint instead of /proxy/req when
+    opts.endpoint is set (reference proxy-test.js 'endpoint overridden')."""
+    c = converged_cluster(3)
+    sender = c.nodes[0]
+    key = key_not_owned_by(c, sender)
+    dest = sender.lookup(key)
+    dest_node = next(n for n in c.nodes if n.whoami() == dest)
+
+    hits = []
+
+    def custom_handler(head, body, src, respond):
+        hits.append((json.loads(head)["url"], body))
+        respond(None, json.dumps({"statusCode": 299, "headers": {}}), "custom-body")
+
+    dest_node.channel.register({"/custom/forward": custom_handler})
+
+    done = []
+    req = ProxyRequest(url="/x", method="GET", body="b")
+    res = ProxyResponse(lambda err, resp: done.append((err, resp)))
+    sender.proxy_req(
+        {
+            "keys": [key],
+            "dest": dest,
+            "req": req,
+            "res": res,
+            "endpoint": "/custom/forward",
+        }
+    )
+    c.run(2000)
+    err, resp = done[0]
+    assert err is None
+    assert hits and hits[0][0] == "/x"
+    assert resp.status_code == 299
+    assert resp.body == "custom-body"
+    c.destroy_all()
+
+
+def test_destroy_cancels_inflight_retries():
+    """destroy() cancels scheduled proxy retries (request-proxy/index.js
+    in-flight send tracking; reference proxy-test.js 'sends cleaned up')."""
+    c = converged_cluster(3)
+    sender = c.nodes[0]
+    key = key_not_owned_by(c, sender)
+    dest = sender.lookup(key)
+
+    attempts = []
+    sender.on("requestProxy.retryAttempted", lambda *a: attempts.append(1))
+    done = []
+    req = ProxyRequest(url="/x", method="GET")
+    res = ProxyResponse(lambda err, resp: done.append(err))
+    c.kill([n.whoami() for n in c.nodes].index(dest))
+    sender.proxy_req(
+        {
+            "keys": [key],
+            "dest": dest,
+            "req": req,
+            "res": res,
+            "timeout": 500,
+            "retrySchedule": [5.0],  # long enough to destroy before it fires
+        }
+    )
+    c.run(1000)  # request times out -> retry scheduled at +5 s
+    assert sender.request_proxy.sends, "send not tracked in-flight"
+    sender.destroy()
+    assert not sender.request_proxy.sends, "destroy left sends tracked"
+    c.run(20000)  # past the retry deadline: canceled timer must not fire
+    assert attempts == []
+    c.destroy_all()

@@ -84,3 +84,45 @@ def test_member_list_shape_matches_host():
     )
     assert simc.members(0) == members
     host.destroy_all()
+
+
+def test_trajectory_parity_bootstrap_from_scratch():
+    """Both backends bootstrap from zero knowledge through their own join
+    paths and must converge to bit-identical reference-format checksums.
+
+    Host side: five RingPops bootstrap over the in-process transport
+    (join-sender.js semantics -> full-sync join responses -> gossip).
+    Sim side: five virtual nodes start mode='self' (each knows only
+    itself, at the same incarnations the host nodes booted with), join
+    through admin_join (join-handler.js full-sync semantics), and gossip
+    to convergence with swim_step.  This is SURVEY §7's minimum
+    end-to-end slice proven end to end, not from a seeded state.
+    """
+    host = _host_cluster_converged(5)
+    host_sums = set(host.checksums().values())
+    assert len(host_sums) == 1
+    members = host.nodes[0].membership.get_stats()["members"]
+    by_addr = {m["address"]: m for m in members}
+    assert all(m["status"] == "alive" for m in members)
+
+    simc = SimCluster(
+        5,
+        addresses=host.host_ports,
+        base_inc=min(m["incarnationNumber"] for m in members),
+        inc=[by_addr[a]["incarnationNumber"] for a in host.host_ports],
+        init="self",
+    )
+    # Pre-join: nobody agrees (each node sees only itself).
+    assert not simc.converged()
+    # tick-cluster 'j': every node admin-joins against the first
+    # bootstrap host; the seed answers with a full sync
+    # (join-handler.js:90-97) and gossip spreads the rest.
+    for j in range(1, 5):
+        simc.join(j, 0)
+    assert simc.run_until_converged(200) > 0
+    sim_sums = set(simc.checksums().values())
+    assert sim_sums == host_sums
+
+    # Same member list content, not just same hash.
+    assert simc.members(0) == members
+    host.destroy_all()
