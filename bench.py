@@ -87,7 +87,58 @@ def bench_once(n: int) -> float:
         dt = time.perf_counter() - t0
         best = max(best, TICKS_PER_CALL * n / dt)
         print(f"# n={n}: {best:.0f} node-rounds/s", file=sys.stderr, flush=True)
+    _device_kernel_checks(state, n)
     return best
+
+
+def _device_kernel_checks(state, n: int) -> None:
+    """Exercise the device kernels on the benched backend (stderr only).
+
+    (a) Pallas farmhash32 against golden vectors — its scheduled
+    on-hardware execution (tests run it in interpret mode on CPU);
+    (b) the on-device reference-format checksum of live view rows
+    against the threaded C kernel at the benched cluster size.
+    Failures surface loudly but never corrupt the JSON contract.
+    """
+    import numpy as np
+
+    try:
+        import jax
+
+        if jax.default_backend() != "cpu":
+            from ringpop_tpu.ops import ring_ops
+            from ringpop_tpu.ops.farmhash import farmhash32
+            from ringpop_tpu.ops.farmhash_pallas import farmhash32_batch_pallas
+
+            vecs = [b"test", b"", b"127.0.0.1:3000", b"x" * 100]
+            bufs, lens = ring_ops.encode_strings([v.decode() for v in vecs], pad_to=128)
+            got = np.asarray(farmhash32_batch_pallas(bufs, lens))
+            want = np.array([farmhash32(v) for v in vecs], dtype=np.uint32)
+            assert (got == want).all(), f"pallas farmhash mismatch: {got} != {want}"
+            print("# pallas farmhash32 on-chip: ok", file=sys.stderr, flush=True)
+
+        from ringpop_tpu.models import checksum as cksum
+        from ringpop_tpu.models.cluster import DEFAULT_BASE_INC
+        from ringpop_tpu.ops import checksum_device as ckdev
+
+        rows = list(range(0, n, max(1, n // 8)))[:8]
+        book_addrs = cksum.default_addresses(n)
+        dev_book = ckdev.DeviceBook(book_addrs, DEFAULT_BASE_INC)
+        import jax.numpy as jnp
+
+        keys = state.view_key[jnp.asarray(rows)]
+        dev = np.asarray(ckdev.view_checksums_device(dev_book, keys))
+        want = cksum.view_checksums_packed(
+            cksum.AddressBook(book_addrs), np.asarray(keys), DEFAULT_BASE_INC
+        )
+        assert (dev == want).all(), "device checksum mismatch vs C kernel"
+        print(
+            f"# device checksum vs C kernel at n={n}: ok ({len(rows)} rows)",
+            file=sys.stderr,
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill the bench
+        print(f"# device kernel check FAILED: {e!r}", file=sys.stderr, flush=True)
 
 
 def child_main(sizes: list[int]) -> None:

@@ -2,14 +2,25 @@
 5%/tick join/leave, key-movement count.
 
 Measures consistent hashing's defining property (how few keys move under
-churn, ring.js replica-point design) and the ring update throughput."""
+churn, ring.js replica-point design) and the ring update throughput.
+
+The key re-resolution after every churn tick runs on BOTH paths and
+cross-checks them:
+* host: per-key rbtree-equivalent lookup (hashring.py);
+* device: one batched ``lookup_keys`` over the ``DeviceRing`` —
+  farmhash on device + one searchsorted for the whole key batch
+  (ops/ring_ops.py), asserted bit-identical to the host owners.
+"""
 
 from __future__ import annotations
 
 import random
 import time
 
+import numpy as np
+
 from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.ops import ring_ops
 
 
 def run(n: int = 10000, churn: float = 0.05, ticks: int = 5,
@@ -20,14 +31,17 @@ def run(n: int = 10000, churn: float = 0.05, ticks: int = 5,
     ring = HashRing()
     ring.add_remove_servers(servers, [])
     keys = [f"key-{rng.randrange(10 ** 12)}" for _ in range(n_keys)]
+    key_bufs, key_lens = ring_ops.encode_strings(keys)
     owners = {k: ring.lookup(k) for k in keys}
 
     in_ring = set(servers)
     spare = [f"10.200.{i // 256}.{i % 256}:3000" for i in range(n)]
     moved_total = 0
     churn_count = int(n * churn)
-    t0 = time.perf_counter()
+    device_lookup_s = 0.0
+    wall = 0.0  # host-path churn+lookup only (the pre-existing metric)
     for _ in range(ticks):
+        t0 = time.perf_counter()
         leavers = rng.sample(sorted(in_ring), churn_count)
         joiners = [spare.pop() for _ in range(churn_count)]
         ring.add_remove_servers(joiners, leavers)
@@ -36,7 +50,21 @@ def run(n: int = 10000, churn: float = 0.05, ticks: int = 5,
         new_owners = {k: ring.lookup(k) for k in keys}
         moved_total += sum(1 for k in keys if new_owners[k] != owners[k])
         owners = new_owners
-    wall = time.perf_counter() - t0
+        wall += time.perf_counter() - t0
+
+        # Device path (untimed by wall_s_per_tick): one batched lookup of
+        # every key, cross-checked bit-identical against the host
+        # rbtree-equivalent path.
+        server_list = sorted(in_ring)
+        dring = ring_ops.build_ring(server_list)
+        t1 = time.perf_counter()
+        dev_idx = np.asarray(ring_ops.lookup_keys(dring, key_bufs, key_lens))
+        device_lookup_s += time.perf_counter() - t1
+        dev_owners = [server_list[i] for i in dev_idx]
+        mismatches = sum(
+            1 for k, o in zip(keys, dev_owners) if owners[k] != o
+        )
+        assert mismatches == 0, f"device ring diverged on {mismatches} keys"
 
     moved_frac = moved_total / (n_keys * ticks)
     return [
@@ -46,5 +74,7 @@ def run(n: int = 10000, churn: float = 0.05, ticks: int = 5,
             "unit": "fraction_keys_moved_per_tick",
             "expected_fraction": round(2 * churn, 4),  # leave + join movement
             "wall_s_per_tick": round(wall / ticks, 3),
+            "device_lookups_per_s": round(n_keys * ticks / device_lookup_s),
+            "device_vs_host": "bit-identical",
         }
     ]

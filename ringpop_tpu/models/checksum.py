@@ -120,6 +120,22 @@ def view_checksums(
     }
 
 
+def view_checksums_packed(
+    book: AddressBook, keys_rows: np.ndarray, base_inc: int
+) -> np.ndarray:
+    """Checksums of packed ``view_key`` rows (swim_sim layout), in row
+    order — the single unpack point for every host-side caller."""
+    keys_rows = np.asarray(keys_rows)
+    out = view_checksums(
+        book,
+        (keys_rows & 7).astype(np.int8),
+        keys_rows >> 3,
+        base_inc,
+        np.arange(keys_rows.shape[0]),
+    )
+    return np.array([out[i] for i in range(keys_rows.shape[0])], dtype=np.uint32)
+
+
 def row_members(
     book: AddressBook,
     row_status: np.ndarray,

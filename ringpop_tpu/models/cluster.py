@@ -23,6 +23,7 @@ import numpy as np
 from ringpop_tpu.hashring import HashRing
 from ringpop_tpu.models import checksum as cksum
 from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.ops import checksum_device as ckdev
 from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 
 DEFAULT_BASE_INC = 1_400_000_000_000  # host clock epoch (clock.SimScheduler)
@@ -66,6 +67,7 @@ class SimCluster:
         self.net: NetState = sim.make_net(n)
         self.key = jax.random.PRNGKey(seed)
         self.metrics_log: list[dict[str, int]] = []
+        self._device_book = None  # lazy ckdev.DeviceBook (device checksums)
         if device is not None:
             self.state = jax.device_put(self.state, device)
             self.net = jax.device_put(self.net, device)
@@ -122,20 +124,32 @@ class SimCluster:
         XLA recompile every time the live count changes."""
         return bool(_converged_impl(self.state, self.net))
 
-    def checksums(self, indices: Sequence[int] | None = None) -> dict[str, int]:
-        """Reference-format membership checksum per (live) node address."""
+    def checksums(
+        self,
+        indices: Sequence[int] | None = None,
+        backend: str = "host",
+    ) -> dict[str, int]:
+        """Reference-format membership checksum per (live) node address.
+
+        ``backend='host'``: threaded C kernel over pulled rows (default).
+        ``backend='device'``: string assembly + farmhash entirely on
+        device (ops/checksum_device.py) — only the uint32 results leave
+        HBM; the right choice for whole-cluster sweeps at large N.
+        """
         idx = self.live_indices() if indices is None else np.asarray(indices)
+        if backend == "device":
+            if self._device_book is None:
+                self._device_book = ckdev.DeviceBook(
+                    self.book.addresses, self.base_inc
+                )
+            rows = self.state.view_key[jnp.asarray(idx)]
+            sums = np.asarray(ckdev.view_checksums_device(self._device_book, rows))
+            return {self.book.addresses[i]: int(c) for i, c in zip(idx, sums)}
         # Pull only the requested rows, unpacking on host (row-sized work;
         # the view_status/view_inc properties would unpack all N x N).
         keys = np.asarray(self.state.view_key[jnp.asarray(idx)])
-        sums = cksum.view_checksums(
-            self.book,
-            (keys & 7).astype(np.int8),
-            keys >> 3,
-            self.base_inc,
-            np.arange(len(idx)),
-        )
-        return {self.book.addresses[i]: c for i, c in zip(idx, sums.values())}
+        sums = cksum.view_checksums_packed(self.book, keys, self.base_inc)
+        return {self.book.addresses[i]: int(c) for i, c in zip(idx, sums)}
 
     def checksum_groups(self) -> dict[int, list[str]]:
         groups: dict[int, list[str]] = {}

@@ -524,7 +524,9 @@ def swim_step_impl(
             f"suspicion_ticks={params.suspicion_ticks} exceeds the int8 "
             "countdown range (max 126); raise period_ms instead"
         )
-    max_digits = len(str(n + 1))
+    # _max_piggyback's digit count maxes at len(str(n)): x = count+1 <= n+1
+    # and the strict '>' comparisons give ceil(log10(x)) = len(str(x-1)).
+    max_digits = len(str(n))
     if params.piggyback_factor * max_digits > 126:
         raise ValueError(
             f"piggyback_factor={params.piggyback_factor} can exceed the "

@@ -1,0 +1,105 @@
+"""Device-side checksum path vs the C/host kernel: bit parity.
+
+The device path (ops/checksum_device.py) assembles the reference
+checksum string (membership.js:70-93 format) and farmhash32's it without
+leaving the device; the host path is the threaded C kernel
+(models/checksum.py -> ops/_farmhash.c).  Both must agree byte-for-byte
+and hash-for-hash on every view composition.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models import checksum as cksum
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.ops import checksum_device as ckdev
+
+BASE = 1_400_000_000_000
+
+
+def host_sums(addresses, view_key, base_inc, rows):
+    book = cksum.AddressBook(addresses)
+    keys = np.asarray(view_key[np.asarray(rows)])
+    return cksum.view_checksums_packed(book, keys, base_inc)
+
+
+def test_device_checksum_matches_c_kernel_converged():
+    n = 33
+    addresses = cksum.default_addresses(n)
+    inc = jnp.arange(n, dtype=jnp.int32) * 17 + 3
+    state = sim.init_state(n, inc)
+    book = ckdev.DeviceBook(addresses, BASE)
+    dev = np.asarray(ckdev.view_checksums_device(book, state.view_key))
+    host = host_sums(addresses, state.view_key, BASE, list(range(n)))
+    np.testing.assert_array_equal(dev, np.asarray(host, dtype=np.uint32))
+    # converged views agree with each other too
+    assert len(set(dev.tolist())) == 1
+
+
+def test_device_checksum_mixed_statuses_and_absent_members():
+    n = 12
+    addresses = cksum.default_addresses(n)
+    state = sim.init_state(n, mode="self")
+    for j in range(1, 9):
+        state = sim.admin_join(state, j, 0)
+    # sprinkle every status + carry boundary incarnations
+    vk = state.view_key
+    vk = vk.at[0, 3].set(134_000_000 * 8 + sim.SUSPECT)  # near INC_MAX
+    vk = vk.at[0, 4].set(5 * 8 + sim.FAULTY)
+    vk = vk.at[0, 5].set(123_456 * 8 + sim.LEAVE)
+    state = state._replace(view_key=vk)
+    book = ckdev.DeviceBook(addresses, BASE)
+    rows = list(range(n))
+    dev = np.asarray(ckdev.view_checksums_device(book, state.view_key))
+    host = host_sums(addresses, state.view_key, BASE, rows)
+    np.testing.assert_array_equal(dev, np.asarray(host, dtype=np.uint32))
+
+
+def test_device_checksum_small_base_inc():
+    # base_inc < 1e9: the hi limb is zero and widths go fully dynamic
+    n = 7
+    addresses = cksum.default_addresses(n)
+    inc = jnp.asarray([0, 1, 9, 99, 12345, 10**6, 5], dtype=jnp.int32)
+    state = sim.init_state(n, inc)
+    book = ckdev.DeviceBook(addresses, base_inc=7)
+    dev = np.asarray(ckdev.view_checksums_device(book, state.view_key))
+    host = host_sums(addresses, state.view_key, 7, list(range(n)))
+    np.testing.assert_array_equal(dev, np.asarray(host, dtype=np.uint32))
+
+
+def test_device_checksum_carry_across_1e9():
+    # base_lo + inc crosses 1e9: the carry must propagate into hi
+    n = 4
+    addresses = cksum.default_addresses(n)
+    base = 1_999_999_999_000  # lo = 999_999_999_000 % 1e9 = 999_999_000
+    inc = jnp.asarray([0, 999, 1000, 2000], dtype=jnp.int32)
+    state = sim.init_state(n, inc)
+    book = ckdev.DeviceBook(addresses, base)
+    dev = np.asarray(ckdev.view_checksums_device(book, state.view_key))
+    host = host_sums(addresses, state.view_key, base, list(range(n)))
+    np.testing.assert_array_equal(dev, np.asarray(host, dtype=np.uint32))
+
+
+def test_device_row_string_exact_bytes():
+    """The assembled string itself (not just its hash) matches the
+    reference format."""
+    addresses = ["b:2", "a:1", "c:3"]
+    state = sim.init_state(3, jnp.asarray([5, 6, 7], dtype=jnp.int32))
+    book = ckdev.DeviceBook(addresses, base_inc=100)
+    bufs, lens = ckdev.row_strings(book, state.view_key)
+    got = bytes(np.asarray(bufs[0][: int(lens[0])]))
+    assert got == b"a:1alive106;b:2alive105;c:3alive107"
+
+
+def test_simcluster_device_backend_matches_host():
+    from ringpop_tpu.models.cluster import SimCluster
+
+    simc = SimCluster(16, sim.SwimParams(loss=0.0), seed=3)
+    simc.kill(5)
+    simc.tick(40)
+    host = simc.checksums()
+    dev = simc.checksums(backend="device")
+    assert dev == host and len(dev) == 15
