@@ -1,0 +1,130 @@
+"""Cross-ref benchmark regression harness.
+
+The reference's ``benchmarks/run.js:83-102`` runs a benchmark file at
+every git ref in a range and diffs the ``ops/sec`` lines.  This is that
+tool for this repo: run the benchmark suite at two (or more) refs in
+throwaway worktrees, join results by metric name, and print the delta
+table — the perf-regression gate for ring/membership/simulation changes.
+
+Usage:
+    python benchmarks/compare_refs.py REF [REF2] [-- run_all args...]
+
+With one REF, compares it against the working tree.  Extra args after
+``--`` pass through to ``benchmarks.run_all`` (default: ``--fast``).
+Exit code 1 when any shared metric regressed by more than REGRESS_PCT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REGRESS_PCT = 20.0  # noise floor for the 1-core CI box
+
+# metrics where higher is better and gate the exit code; run_all's other
+# units (fractions, tick counts) are informational
+RATE_UNITS = {"ops/sec"}
+
+
+def run_suite(tree: str, label: str, extra: list[str]) -> dict[str, dict]:
+    """Run the suite; a nonzero exit or an empty result FAILS the gate
+    loudly (a silently-shrunken metric set would pass regressions)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.run_all", *extra],
+        cwd=tree,
+        capture_output=True,
+        text=True,
+        timeout=3600,
+        env=env,
+    )
+    out: dict[str, dict] = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in rec:
+            out[rec["metric"]] = rec
+    if proc.returncode != 0 or not out:
+        tail = proc.stderr.strip().splitlines()[-3:]
+        raise SystemExit(
+            f"suite at {label} failed (rc={proc.returncode}, "
+            f"{len(out)} metrics): " + " | ".join(tail)
+        )
+    return out
+
+
+def at_ref(ref: str, extra: list[str]) -> dict[str, dict]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory(prefix=f"bench-{ref.replace('/', '_')}-") as tmp:
+        subprocess.run(
+            ["git", "worktree", "add", "--detach", tmp, ref],
+            cwd=repo,
+            check=True,
+            capture_output=True,
+        )
+        try:
+            return run_suite(tmp, ref, extra)
+        finally:
+            subprocess.run(
+                ["git", "worktree", "remove", "--force", tmp],
+                cwd=repo,
+                capture_output=True,
+            )
+
+
+def main(argv: list[str]) -> int:
+    args = argv[1:]
+    extra = ["--fast"]
+    if "--" in args:
+        split = args.index("--")
+        args, extra = args[:split], args[split + 1 :]
+    if not args:
+        print(__doc__)
+        return 2
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    refs: list[tuple[str, dict[str, dict]]] = []
+    for ref in args:
+        print(f"# running suite at {ref} ...", file=sys.stderr, flush=True)
+        refs.append((ref, at_ref(ref, extra)))
+    if len(refs) == 1:  # single REF: compare against the working tree
+        print("# running suite at working tree ...", file=sys.stderr, flush=True)
+        refs.append(("worktree", run_suite(repo, "worktree", extra)))
+
+    base_name, base = refs[0]
+    regressed = []
+    for name, results in refs[1:]:
+        print(f"\n== {base_name} -> {name} ==")
+        for metric in sorted(set(base) & set(results)):
+            v0, v1 = base[metric].get("value"), results[metric].get("value")
+            if not isinstance(v0, (int, float)) or not isinstance(v1, (int, float)):
+                continue
+            delta = (v1 - v0) / v0 * 100 if v0 else float("nan")
+            unit = results[metric].get("unit", "")
+            flag = ""
+            if unit in RATE_UNITS and delta < -REGRESS_PCT:
+                flag = "  <-- REGRESSION"
+                regressed.append((metric, delta))
+            print(f"{metric:<48} {v0:>14.4g} -> {v1:>14.4g}  {delta:+7.1f}%{flag}")
+        only_base = set(base) - set(results)
+        only_new = set(results) - set(base)
+        for m in sorted(only_base):
+            print(f"{m:<48} (removed)")
+        for m in sorted(only_new):
+            print(f"{m:<48} (new)")
+    if regressed:
+        print(f"\n{len(regressed)} regression(s) beyond {REGRESS_PCT}%", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
