@@ -126,6 +126,15 @@ class SwimParams(NamedTuple):
     damp_suppress: float = 2500.0
     damp_reuse: float = 500.0
     damp_decay_per_tick: float = 0.5 ** (0.2 / 60.0)
+    # Sparse dissemination (0 = dense).  When > 0, each ping/ack carries
+    # at most ``sparse_cap`` changes as a compact (subject, key) list
+    # applied by point scatters — the steady-state fast path.  Piggyback
+    # counters stay bit-identical to the dense step; view propagation is
+    # bit-identical whenever no row has more than ``sparse_cap`` active
+    # changes (steady state), and degrades to bounded-message semantics
+    # (overflowed changes ship on later pings) under churn bursts.  Full
+    # syncs always take the exact dense reply path via lax.cond.
+    sparse_cap: int = 0
 
 
 class ClusterState(NamedTuple):
@@ -502,23 +511,21 @@ def _declare(
 # ---------------------------------------------------------------------------
 
 
-def swim_step_impl(
-    state: ClusterState, net: NetState, key: jax.Array, params: SwimParams
-) -> tuple[ClusterState, dict[str, jax.Array]]:
-    """One synchronized protocol period for every virtual node.
+class _Selection(NamedTuple):
+    """Phases 0-1: period-start views + probe/witness selection (shared
+    by the dense and sparse steps so they cannot drift)."""
 
-    Phases (intra-tick order convention, see module docstring):
-      1. probe-target + witness selection   (membership-iterator.js)
-      2. sender piggyback issue             (dissemination.issueAsSender)
-      3. ping delivery + receiver merge     (ping-handler.js:34)
-      4. receiver reply (+ full sync) + sender merge  (ping-handler.js:36-39)
-      5. failed probes -> ping-req two-hop -> suspect  (ping-req-sender.js)
-      6. suspicion countdowns fire -> faulty  (suspicion.js:66-69)
-    """
-    n = state.n
-    k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
-    ids = jnp.arange(n, dtype=jnp.int32)
-    eye = jnp.eye(n, dtype=bool)
+    gossiping: jax.Array  # bool[N]
+    sends: jax.Array  # bool[N]
+    t_safe: jax.Array  # int32[N]
+    wit: jax.Array  # int32[N, k]
+    wit_valid: jax.Array  # bool[N, k]
+    maxpb8: jax.Array  # int8[N, 1]
+    h_pre: jax.Array  # uint32[N]
+
+
+def _validate_params(n: int, params: SwimParams) -> int:
+    """Static int8-range guards; returns the suspicion countdown start."""
     if params.suspicion_ticks > 126:
         raise ValueError(
             f"suspicion_ticks={params.suspicion_ticks} exceeds the int8 "
@@ -533,16 +540,21 @@ def swim_step_impl(
             f"int8 piggyback budget at n={n} "
             f"(factor * {max_digits} digits > 126)"
         )
-    sl_start = int(params.suspicion_ticks) + 1
+    return int(params.suspicion_ticks) + 1
 
-    # -- phase 0: period-start derived views --------------------------------
+
+def _phase01_select(
+    state: ClusterState, net: NetState, k_sel: jax.Array, params: SwimParams
+) -> _Selection:
+    """Phase 0 (derived views) + phase 1 (probe targets and witnesses)."""
+    n = state.n
+    eye = jnp.eye(n, dtype=bool)
     status = state.view_key & 7
     status_ok = (status == ALIVE) | (status == SUSPECT)
     pingable = status_ok & ~eye
-    maxpb = _max_piggyback(status_ok, params.piggyback_factor)  # int32[N]
-    h_pre = _view_hash(state)  # sender checksum claim in the ping body
+    maxpb = _max_piggyback(status_ok, params.piggyback_factor)
+    h_pre = _view_hash(state)
 
-    # -- phase 1: who probes whom; who witnesses ----------------------------
     own_status = jnp.diagonal(status)
     gossiping = (
         net.up & net.responsive & ((own_status == ALIVE) | (own_status == SUSPECT))
@@ -550,20 +562,111 @@ def swim_step_impl(
     target, has_target, wit, wit_valid = _choose_targets_and_witnesses(
         pingable, params.ping_req_size, k_sel
     )
-    # Barrier: the N x N random-score matrix must be dead before phase 3
-    # allocates its own N x N int32 buffers — without it XLA's scheduler
+    # Barrier: the N x N selection cumsum must be dead before phase 3
+    # allocates its own N x N buffers — without it XLA's scheduler
     # overlaps their lifetimes and a 32k-node step blows past HBM.
     target, has_target, wit, wit_valid = jax.lax.optimization_barrier(
         (target, has_target, wit, wit_valid)
     )
     sends = gossiping & has_target
     t_safe = jnp.where(sends, target, 0)
+    return _Selection(
+        gossiping, sends, t_safe, wit, wit_valid, maxpb.astype(jnp.int8)[:, None], h_pre
+    )
+
+
+def _phase5_pingreq(
+    state: ClusterState,
+    net: NetState,
+    k_loss3: jax.Array,
+    sel: _Selection,
+    ack: jax.Array,
+    sl_start: int,
+    params: SwimParams,
+) -> tuple[ClusterState, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Phase 5: failed probes -> ping-req two-hop -> suspect
+    (ping-req-sender.js).  Returns (state, failed, declare_suspect,
+    declared, was_alive_at_target)."""
+    n = state.n
+    ids = jnp.arange(n, dtype=jnp.int32)
+    resp = net.up & net.responsive
+    t_safe = sel.t_safe
+    failed = sel.sends & ~ack
+    k_a, k_b, k_c, k_d = jax.random.split(k_loss3, 4)
+    kshape = (n, params.ping_req_size)
+    wit_safe = jnp.clip(sel.wit, 0, n - 1)
+    req_ok = (
+        failed[:, None]
+        & sel.wit_valid
+        & _adj(net, ids[:, None], wit_safe)
+        & ~_drop(k_a, kshape, params.loss)
+        & resp[wit_safe]
+    )
+    wt_ok = (
+        req_ok
+        & _adj(net, wit_safe, t_safe[:, None])
+        & ~_drop(k_b, kshape, params.loss)
+        & resp[t_safe][:, None]
+        & _adj(net, t_safe[:, None], wit_safe)
+        & ~_drop(k_c, kshape, params.loss)
+    )
+    relay_ok = jnp.broadcast_to(
+        _adj(net, wit_safe, ids[:, None]) & ~_drop(k_d, kshape, params.loss), kshape
+    )
+    any_success = jnp.any(wt_ok & relay_ok, axis=1)
+    # all witnesses answered "target unreachable" and none succeeded ->
+    # suspect (ping-req-sender.js:238-267); no witness response at all is
+    # inconclusive (:268-282)
+    definite_fail = jnp.any(req_ok & ~wt_ok & relay_ok, axis=1)
+    declare_suspect = failed & ~any_success & definite_fail
+    was_alive_at_target = (state.view_key[ids, t_safe] & 7) == ALIVE
+    state, declared = _declare(state, declare_suspect, t_safe, SUSPECT, sl_start)
+    return state, failed, declare_suspect, declared, was_alive_at_target
+
+
+def _phase6_expiry(
+    state: ClusterState, gossiping: jax.Array
+) -> tuple[ClusterState, jax.Array]:
+    """Phase 6: suspicion countdowns fire -> faulty (suspicion.js:66-69)."""
+    sl = state.suspect_left
+    sl1 = jnp.where(sl > 0, sl - 1, sl)
+    expired = (sl1 == 0) & ((state.view_key & 7) == SUSPECT) & gossiping[:, None]
+    vk = jnp.where(expired, (state.view_key >> 3) * 8 + FAULTY, state.view_key)
+    pb = jnp.where(expired, jnp.int8(0), state.pb)
+    sl1 = jnp.where(expired, jnp.int8(-1), sl1)
+    return state._replace(view_key=vk, pb=pb, suspect_left=sl1), expired
+
+
+
+def swim_step_impl(
+    state: ClusterState, net: NetState, key: jax.Array, params: SwimParams
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """One synchronized protocol period for every virtual node.
+
+    Phases (intra-tick order convention, see module docstring):
+      1. probe-target + witness selection   (membership-iterator.js)
+      2. sender piggyback issue             (dissemination.issueAsSender)
+      3. ping delivery + receiver merge     (ping-handler.js:34)
+      4. receiver reply (+ full sync) + sender merge  (ping-handler.js:36-39)
+      5. failed probes -> ping-req two-hop -> suspect  (ping-req-sender.js)
+      6. suspicion countdowns fire -> faulty  (suspicion.js:66-69)
+    """
+    if params.sparse_cap:
+        return _swim_step_sparse(state, net, key, params)
+    n = state.n
+    k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    sl_start = _validate_params(n, params)
+
+    # -- phases 0-1: derived views + probe/witness selection ----------------
+    sel = _phase01_select(state, net, k_sel, params)
+    gossiping, sends, t_safe = sel.gossiping, sel.sends, sel.t_safe
+    maxpb8, h_pre = sel.maxpb8, sel.h_pre
 
     # -- phase 2: sender issues its active changes --------------------------
     # All piggyback arithmetic stays in int8: stored pb <= 126 (the budget
     # clamp), so pb + 1 <= 127 never overflows, and no N x N int32 pb
     # temporary ever materializes (4 GB at n=32k).
-    maxpb8 = maxpb.astype(jnp.int8)[:, None]
     has_change = state.pb >= 0
     bump = has_change & sends[:, None]
     pb_next = jnp.where(bump, state.pb + jnp.int8(1), state.pb)
@@ -639,48 +742,13 @@ def swim_step_impl(
     state = merged2.state
     ack_applied = jnp.sum(merged2.applied, dtype=jnp.int32)
 
-    # -- phase 5: ping-req for failed probes (ping-req-sender.js) -----------
-    failed = sends & ~ack
-    k_a, k_b, k_c, k_d = jax.random.split(k_loss3, 4)
-    kshape = (n, params.ping_req_size)
-    wit_safe = jnp.clip(wit, 0, n - 1)
-    req_ok = (
-        failed[:, None]
-        & wit_valid
-        & _adj(net, ids[:, None], wit_safe)
-        & ~_drop(k_a, kshape, params.loss)
-        & resp[wit_safe]
+    # -- phase 5: ping-req for failed probes --------------------------------
+    state, failed, declare_suspect, declared, was_alive_at_target = _phase5_pingreq(
+        state, net, k_loss3, sel, ack, sl_start, params
     )
-    wt_ok = (
-        req_ok
-        & _adj(net, wit_safe, t_safe[:, None])
-        & ~_drop(k_b, kshape, params.loss)
-        & resp[t_safe][:, None]
-        & _adj(net, t_safe[:, None], wit_safe)
-        & ~_drop(k_c, kshape, params.loss)
-    )
-    relay_ok = jnp.broadcast_to(
-        _adj(net, wit_safe, ids[:, None]) & ~_drop(k_d, kshape, params.loss), kshape
-    )
-    any_success = jnp.any(wt_ok & relay_ok, axis=1)
-    # all witnesses answered "target unreachable" and none succeeded ->
-    # suspect (ping-req-sender.js:238-267); no witness response at all is
-    # inconclusive (:268-282)
-    definite_fail = jnp.any(req_ok & ~wt_ok & relay_ok, axis=1)
-    declare_suspect = failed & ~any_success & definite_fail
-    was_alive_at_target = (state.view_key[ids, t_safe] & 7) == ALIVE
-    state, declared = _declare(state, declare_suspect, t_safe, SUSPECT, sl_start)
 
-    # -- phase 6: suspicion countdowns fire -> faulty (suspicion.js:66-69) --
-    sl = state.suspect_left
-    sl1 = jnp.where(sl > 0, sl - 1, sl)
-    expired = (sl1 == 0) & ((state.view_key & 7) == SUSPECT) & gossiping[:, None]
-    vk = jnp.where(
-        expired, (state.view_key >> 3) * 8 + FAULTY, state.view_key
-    )
-    pb = jnp.where(expired, jnp.int8(0), state.pb)
-    sl1 = jnp.where(expired, jnp.int8(-1), sl1)
-    state = state._replace(view_key=vk, pb=pb, suspect_left=sl1)
+    # -- phase 6: suspicion countdowns fire -> faulty -----------------------
+    state, expired = _phase6_expiry(state, gossiping)
 
     # -- damping extension (active only with damp tensors present) ----------
     n_damped = jnp.int32(0)
@@ -715,6 +783,241 @@ def swim_step_impl(
         "damped_pairs": n_damped,
     }
     return state, metrics
+
+
+# ---------------------------------------------------------------------------
+# sparse dissemination (the steady-state fast path, SwimParams.sparse_cap)
+# ---------------------------------------------------------------------------
+
+
+def _compact_rows(mask: jax.Array, cap: int) -> jax.Array:
+    """Column indices of the first ``cap`` True entries per row, -1 padded.
+
+    int32[N, cap]; the cumsum stays int16 when the row length allows.
+    """
+    n = mask.shape[1]
+    cdtype = jnp.int16 if n <= 32767 else jnp.int32
+    cidx = jnp.cumsum(mask.astype(cdtype), axis=1)
+    pos = jnp.where(mask & (cidx <= cap), (cidx - 1).astype(jnp.int32), cap)
+    cols = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], mask.shape)
+    rows = jnp.broadcast_to(
+        jnp.arange(mask.shape[0], dtype=jnp.int32)[:, None], mask.shape
+    )
+    out = jnp.full((mask.shape[0], cap), -1, dtype=jnp.int32)
+    return out.at[rows, pos].set(cols, mode="drop")
+
+
+def _point_merge(
+    state: ClusterState,
+    r_idx: jax.Array,  # int32[B, C] receiver per claim
+    subj: jax.Array,  # int32[B, C] subject per claim (-1 = none)
+    claim_key: jax.Array,  # int32[B, C]
+    valid: jax.Array,  # bool[B, C]
+    sl_start: int,
+) -> tuple[ClusterState, jax.Array, jax.Array]:
+    """Apply compact claim lists by point scatters (the sparse analog of
+    ``_merge_incoming``; same lattice, refutation, and bookkeeping, but
+    touching only the claimed (receiver, subject) points plus masked int8
+    passes — no N x N int32 claim matrix).
+
+    Intra-tick convention difference vs the dense merge (documented): the
+    dense path evaluates the override mask on the per-point lattice
+    *maximum* claim, the sparse path per claim — they differ only when
+    simultaneous claims about one subject straddle a ``leave`` guard,
+    where the reference itself is arrival-order-dependent.
+
+    Returns (state, applied bool[N, N], refuted bool[N]).
+    """
+    n = state.n
+    ids = jnp.arange(n, dtype=jnp.int32)
+    subj_safe = jnp.clip(subj, 0, n - 1)
+    r_safe = jnp.clip(r_idx, 0, n - 1)
+    cur = state.view_key[r_safe, subj_safe]
+    self_claim = valid & (subj_safe == r_safe)
+    normal = valid & (subj_safe != r_safe) & _apply_mask(cur, claim_key)
+
+    vk = state.view_key.at[r_safe, subj_safe].max(jnp.where(normal, claim_key, 0))
+
+    # Refutation (membership.js:243-254), matching the dense convention:
+    # the lattice-maximum self-claim decides; a rumor re-asserts alive.
+    self_key = (
+        jnp.zeros((n,), jnp.int32)
+        .at[jnp.where(self_claim, r_safe, n)]
+        .max(jnp.where(self_claim, claim_key, 0), mode="drop")
+    )
+    rumor_status = self_key & 7
+    refuted = (rumor_status == SUSPECT) | (rumor_status == FAULTY)
+    self_inc = jnp.diagonal(state.view_key) >> 3
+    new_self_inc = jnp.maximum(self_inc, self_key >> 3) + 1
+    vk = vk.at[ids, ids].set(
+        jnp.where(refuted, new_self_inc * 8 + ALIVE, jnp.diagonal(vk))
+    )
+
+    applied = (
+        jnp.zeros((n, n), dtype=bool)
+        .at[r_safe, subj_safe]
+        .max(normal)
+        .at[ids, ids]
+        .max(refuted)
+    )
+    pb = jnp.where(applied, jnp.int8(0), state.pb)
+    new_status = vk & 7
+    sl = jnp.where(
+        applied & (new_status == SUSPECT), jnp.int8(sl_start), state.suspect_left
+    )
+    sl = jnp.where(applied & (new_status == ALIVE), jnp.int8(-1), sl)
+    return state._replace(view_key=vk, pb=pb, suspect_left=sl), applied, refuted
+
+
+def _swim_step_sparse(
+    state: ClusterState, net: NetState, key: jax.Array, params: SwimParams
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """The protocol period with compact change lists (see SwimParams.sparse_cap).
+
+    Phases 0-2, 5, 6 are the dense code paths (cheap int8/pred work);
+    phases 3-4 move the claim traffic onto [N, cap] lists.
+    """
+    if state.damp is not None:
+        raise NotImplementedError("sparse_cap does not support damping tensors")
+    n = state.n
+    cap = int(params.sparse_cap)
+    k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    sl_start = _validate_params(n, params)
+
+    # -- phases 0-1: shared with the dense step -----------------------------
+    sel = _phase01_select(state, net, k_sel, params)
+    gossiping, sends, t_safe = sel.gossiping, sel.sends, sel.t_safe
+    maxpb8, h_pre = sel.maxpb8, sel.h_pre
+
+    # -- phase 2: capped issue; only SENT changes consume budget ------------
+    # Entries that would be sent but fall past the cap window neither bump
+    # nor evict — they stay active and ship on later pings (otherwise a
+    # churn burst of > cap changes would age out entirely unsent).
+    has_change = state.pb >= 0
+    bump = has_change & sends[:, None]
+    pb1 = jnp.where(bump, state.pb + jnp.int8(1), state.pb)
+    issue_ok = bump & (pb1 <= maxpb8)
+    cdtype = jnp.int16 if n <= 32767 else jnp.int32
+    within = issue_ok & (jnp.cumsum(issue_ok.astype(cdtype), axis=1) <= cap)
+    overflow_send = issue_ok & ~within
+    bump_eff = bump & ~overflow_send
+    pb_next = jnp.where(bump_eff, state.pb + jnp.int8(1), state.pb)
+    issued_s = within
+    pb_next = jnp.where(bump_eff & (pb_next > maxpb8), jnp.int8(-1), pb_next)
+    state = state._replace(pb=pb_next)
+
+    # -- phase 3: compact delivery + point merge ----------------------------
+    resp = net.up & net.responsive
+    fwd_ok = (
+        sends
+        & _adj(net, ids, t_safe)
+        & ~_drop(k_loss1, (n,), params.loss)
+        & resp[t_safe]
+    )
+    subj = _compact_rows(issued_s, cap)  # int32[N, cap], -1 padded
+    subj_safe = jnp.clip(subj, 0, n - 1)
+    claim_key = state.view_key[ids[:, None], subj_safe]
+    valid_claim = (subj >= 0) & fwd_ok[:, None]
+    # the sent set as a bitmap (anti-echo reference; capped, unlike the
+    # dense `delivered`, because only these entries were actually sent)
+    delivered = (
+        jnp.zeros((n, n), dtype=bool)
+        .at[ids[:, None], subj_safe]
+        .max(valid_claim)
+    )
+    inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(fwd_ok.astype(jnp.int32))
+    got_ping = inbound > 0
+
+    r_idx = jnp.broadcast_to(t_safe[:, None], (n, cap))
+    state, applied3, _ = _point_merge(
+        state, r_idx, subj, claim_key, valid_claim, sl_start
+    )
+    ping_applied = jnp.sum(applied3, dtype=jnp.int32)
+    state, delivered = jax.lax.optimization_barrier((state, delivered))
+
+    # -- phase 4a: receiver piggyback bookkeeping ---------------------------
+    # Dense semantics except the cap: issuable entries past the cap window
+    # are not sent this tick, so they keep their budget (see phase 2).
+    has_change2 = state.pb >= 0
+    rep_issuable = (
+        has_change2 & got_ping[:, None] & (state.pb + jnp.int8(1) <= maxpb8)
+    )
+    within_rep = rep_issuable & (
+        jnp.cumsum(rep_issuable.astype(cdtype), axis=1) <= cap
+    )
+    overflow_rep = rep_issuable & ~within_rep
+    inb8 = jnp.minimum(inbound, 127).astype(jnp.int8)[:, None]
+    served = got_ping[:, None] & has_change2 & ~overflow_rep
+    evict = served & (state.pb > maxpb8 - inb8)
+    pb_after = jnp.where(
+        evict, jnp.int8(-1), jnp.where(served, state.pb + inb8, state.pb)
+    )
+    state = state._replace(pb=pb_after)
+    h_post = _view_hash(state)
+
+    # -- phase 4b: full-sync detection without a dense reply matrix ---------
+    # any non-echo issuable claim for sender s = receiver's issuable count
+    # minus the issuable-and-echo entries among s's sent subjects.
+    rep_count = jnp.sum(within_rep, axis=1, dtype=jnp.int32)
+    rcv_key_at = state.view_key[r_idx, subj_safe]
+    snd_key_at = state.view_key[ids[:, None], subj_safe]
+    echo_issuable = (
+        valid_claim
+        & within_rep[r_idx, subj_safe]
+        & (rcv_key_at == snd_key_at)
+    )
+    rep_any = rep_count[t_safe] > jnp.sum(echo_issuable, axis=1, dtype=jnp.int32)
+    full_sync = fwd_ok & ~rep_any & (h_post[t_safe] != h_pre)
+    ack = fwd_ok & _adj(net, t_safe, ids) & ~_drop(k_loss2, (n,), params.loss)
+
+    def dense_reply(st):
+        reply_key = st.view_key[t_safe]
+        rep_row = within_rep[t_safe] & ~(delivered & (reply_key == st.view_key))
+        send_row = jnp.where(full_sync[:, None], reply_key > 0, rep_row)
+        in2_key = jnp.where(send_row & ack[:, None], reply_key, 0)
+        merged2 = _merge_incoming(st, in2_key, ack, sl_start)
+        return merged2.state, jnp.sum(merged2.applied, dtype=jnp.int32)
+
+    def sparse_reply(st):
+        rsubj = _compact_rows(within_rep, cap)  # per receiver
+        subj2 = rsubj[t_safe]  # [N(sender), cap]
+        subj2_safe = jnp.clip(subj2, 0, n - 1)
+        key2 = st.view_key[t_safe[:, None], subj2_safe]
+        echo2 = delivered[ids[:, None], subj2_safe] & (
+            key2 == st.view_key[ids[:, None], subj2_safe]
+        )
+        valid2 = (subj2 >= 0) & ack[:, None] & ~echo2
+        sidx = jnp.broadcast_to(ids[:, None], (n, cap))
+        st2, applied4, _ = _point_merge(st, sidx, subj2, key2, valid2, sl_start)
+        return st2, jnp.sum(applied4, dtype=jnp.int32)
+
+    state, ack_applied = jax.lax.cond(
+        jnp.any(full_sync), dense_reply, sparse_reply, state
+    )
+
+    # -- phase 5: ping-req (shared with the dense step) ---------------------
+    state, failed, declare_suspect, _, _ = _phase5_pingreq(
+        state, net, k_loss3, sel, ack, sl_start, params
+    )
+
+    # -- phase 6: suspicion countdowns (shared) -----------------------------
+    state, expired = _phase6_expiry(state, gossiping)
+
+    state = state._replace(tick=state.tick + 1)
+    metrics = {
+        "pings_sent": jnp.sum(sends, dtype=jnp.int32),
+        "acks": jnp.sum(ack, dtype=jnp.int32),
+        "ping_changes_applied": ping_applied,
+        "ack_changes_applied": ack_applied,
+        "full_syncs": jnp.sum(full_sync, dtype=jnp.int32),
+        "ping_reqs": jnp.sum(failed, dtype=jnp.int32),
+        "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
+        "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
+        "damped_pairs": jnp.int32(0),
+    }
+    return state, metrics
+
 
 
 def swim_run_impl(

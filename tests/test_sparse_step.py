@@ -1,0 +1,136 @@
+"""Sparse dissemination path (SwimParams.sparse_cap) vs the dense step.
+
+Contract (swim_sim.py): with the same PRNG keys, the sparse step is
+bit-identical to the dense step whenever no row carries more than
+``sparse_cap`` active changes; under overflow it degrades to
+bounded-message semantics but must still converge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models import swim_sim as sim
+
+
+def assert_states_equal(a: sim.ClusterState, b: sim.ClusterState, tick: int):
+    np.testing.assert_array_equal(
+        np.asarray(a.view_key), np.asarray(b.view_key), err_msg=f"view_key tick {tick}"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.pb), np.asarray(b.pb), err_msg=f"pb tick {tick}"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.suspect_left),
+        np.asarray(b.suspect_left),
+        err_msg=f"suspect_left tick {tick}",
+    )
+
+
+def run_both(n, ticks, dense_params, sparse_params, mutate=None, init="converged"):
+    dense = sim.init_state(n, mode=init)
+    sparse = sim.init_state(n, mode=init)
+    net = sim.make_net(n)
+    if mutate:
+        dense, sparse, net = mutate(dense, sparse, net)
+    key = jax.random.PRNGKey(42)
+    for t in range(ticks):
+        key, sub = jax.random.split(key)
+        dense, md = sim.swim_step(dense, net, sub, dense_params)
+        sparse, ms = sim.swim_step(sparse, net, sub, sparse_params)
+        yield t, dense, sparse, md, ms
+
+
+def test_bit_identical_steady_state_with_loss():
+    """Converged cluster + 5% loss: suspects, refutations, ping-reqs and
+    suspicion expiries all occur, and every tick must match bit-for-bit
+    (active-change counts stay far below the cap)."""
+    n = 24
+    dense_p = sim.SwimParams(loss=0.05)
+    sparse_p = dense_p._replace(sparse_cap=n)  # cap >= n: never overflows
+    for t, dense, sparse, md, ms in run_both(n, 50, dense_p, sparse_p):
+        assert_states_equal(dense, sparse, t)
+        for k in md:
+            if k == "damped_pairs":
+                continue
+            assert int(md[k]) == int(ms[k]), f"metric {k} tick {t}"
+
+
+def test_bit_identical_through_kill_and_fault_detection():
+    n = 16
+    dense_p = sim.SwimParams(loss=0.0, suspicion_ticks=5)
+    sparse_p = dense_p._replace(sparse_cap=n)
+
+    def mutate(dense, sparse, net):
+        net = net._replace(up=net.up.at[3].set(False))
+        return dense, sparse, net
+
+    last = None
+    for t, dense, sparse, _, _ in run_both(n, 30, dense_p, sparse_p, mutate):
+        assert_states_equal(dense, sparse, t)
+        last = dense
+    # the dead node was declared faulty everywhere (sanity)
+    vs = np.asarray(last.view_key) & 7
+    live = [i for i in range(n) if i != 3]
+    assert all(vs[i, 3] == sim.FAULTY for i in live)
+
+
+def test_overflow_still_converges():
+    """cap far below the active-change count (bootstrap burst): messages
+    truncate, but gossip + full-sync fallback still converge the views."""
+    n = 32
+    params = sim.SwimParams(loss=0.0, sparse_cap=4)
+    state = sim.init_state(n, mode="self")
+    for j in range(1, n):
+        state = sim.admin_join(state, j, 0)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+    for _ in range(300):
+        key, sub = jax.random.split(key)
+        state, _ = sim.swim_step(state, net, sub, params)
+        vk = np.asarray(state.view_key)
+        if (vk == vk[0]).all() and ((vk[0] & 7) == sim.ALIVE).all():
+            break
+    vk = np.asarray(state.view_key)
+    assert (vk == vk[0]).all(), "sparse overflow mode failed to converge"
+    assert ((np.asarray(state.view_key[0]) & 7) == sim.ALIVE).all()
+
+
+def test_full_sync_dense_fallback_fires():
+    """A node with a stale view and nothing piggybacked gets repaired by
+    a full-sync reply; the sparse step must take the dense reply branch
+    (dissemination.js:100-118) and adopt the whole row."""
+    n = 8
+    params = sim.SwimParams(loss=0.0, sparse_cap=8)
+    # cluster converged with node 5 at incarnation 50 ...
+    inc = jnp.zeros((n,), jnp.int32).at[5].set(50)
+    state = sim.init_state(n, inc)
+    # ... except node 1 holds a stale inc-0 view of node 5, and no change
+    # is recorded anywhere (pb=-1): only a full sync can repair node 1.
+    state = state._replace(view_key=state.view_key.at[1, 5].set(0 * 8 + sim.ALIVE))
+    want = 50 * 8 + sim.ALIVE
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(1)
+    saw_full_sync = False
+    for _ in range(60):
+        key, sub = jax.random.split(key)
+        state, m = sim.swim_step(state, net, sub, params)
+        saw_full_sync = saw_full_sync or int(m["full_syncs"]) > 0
+        if int(state.view_key[1, 5]) == want:
+            break
+    assert saw_full_sync, "no full sync occurred"
+    assert int(state.view_key[1, 5]) == want, "stale view never repaired"
+
+
+def test_sparse_rejects_damping():
+    state = sim.init_state(8, damping=True)
+    with pytest.raises(NotImplementedError):
+        sim.swim_step_impl(
+            state,
+            sim.make_net(8),
+            jax.random.PRNGKey(0),
+            sim.SwimParams(sparse_cap=4),
+        )
