@@ -128,13 +128,23 @@ class SwimParams(NamedTuple):
     damp_decay_per_tick: float = 0.5 ** (0.2 / 60.0)
     # Sparse dissemination (0 = dense).  When > 0, each ping/ack carries
     # at most ``sparse_cap`` changes as a compact (subject, key) list
-    # applied by point scatters — the steady-state fast path.  Piggyback
-    # counters stay bit-identical to the dense step; view propagation is
-    # bit-identical whenever no row has more than ``sparse_cap`` active
-    # changes (steady state), and degrades to bounded-message semantics
-    # (overflowed changes ship on later pings) under churn bursts.  Full
-    # syncs always take the exact dense reply path via lax.cond.
+    # applied by point scatters — the steady-state fast path.  The whole
+    # step (views AND piggyback counters) is bit-identical to the dense
+    # step whenever no row has more than ``sparse_cap`` active changes
+    # (steady state); under churn bursts it degrades to bounded-message
+    # semantics: overflowed changes neither send nor consume budget and
+    # ship on later pings.  Full syncs always take the exact dense reply
+    # path via lax.cond.
     sparse_cap: int = 0
+    # Probe-target policy.  "uniform": sample among pingable members
+    # (default; distributionally matches the reference's reshuffled
+    # round-robin marginally).  "sweep": deterministic rotation
+    # ``(start_i + tick) mod n`` with a uniform fallback when the swept
+    # slot is not pingable — restores the reference iterator's guarantee
+    # that every stable member is probed once per n-tick round
+    # (membership-iterator.js:33-40), bounding worst-case detection
+    # latency without the coupon-collector tail.
+    probe: str = "uniform"
 
 
 class ClusterState(NamedTuple):
@@ -562,6 +572,30 @@ def _phase01_select(
     target, has_target, wit, wit_valid = _choose_targets_and_witnesses(
         pingable, params.ping_req_size, k_sel
     )
+    if params.probe == "sweep":
+        # Deterministic rotation restores the reference iterator's
+        # probe-every-member-per-round guarantee; the rank-picked target
+        # remains the fallback when the swept slot is not pingable (and
+        # the witness source either way).
+        ids = jnp.arange(n, dtype=jnp.int32)
+        # static stagger: the multiplier must be coprime to n or whole
+        # residue classes share a start and probe the same slot forever
+        import math
+
+        mult = 0x9E37
+        while math.gcd(mult, n) != 1:
+            mult += 1
+        start = (ids * jnp.int32(mult)) % jnp.int32(n)
+        swept = (start + state.tick) % jnp.int32(n)
+        ok = pingable[ids, swept]
+        target = jnp.where(ok, swept, target)
+        has_target = has_target | ok
+        # witnesses were drawn excluding the rank-picked target; also
+        # drop any that collide with the swept one (ping-req-sender.js
+        # excludes the probe target from the witness pool)
+        wit_valid = wit_valid & (wit != target[:, None])
+    elif params.probe != "uniform":
+        raise ValueError(f"unknown probe policy: {params.probe!r}")
     # Barrier: the N x N selection cumsum must be dead before phase 3
     # allocates its own N x N buffers — without it XLA's scheduler
     # overlaps their lifetimes and a 32k-node step blows past HBM.
@@ -934,7 +968,9 @@ def _swim_step_sparse(
         state, r_idx, subj, claim_key, valid_claim, sl_start
     )
     ping_applied = jnp.sum(applied3, dtype=jnp.int32)
-    state, delivered = jax.lax.optimization_barrier((state, delivered))
+    state, delivered, ping_applied = jax.lax.optimization_barrier(
+        (state, delivered, ping_applied)
+    )
 
     # -- phase 4a: receiver piggyback bookkeeping ---------------------------
     # Dense semantics except the cap: issuable entries past the cap window

@@ -134,3 +134,37 @@ def test_sparse_rejects_damping():
             jax.random.PRNGKey(0),
             sim.SwimParams(sparse_cap=4),
         )
+
+
+def test_sweep_probe_covers_every_member_each_round():
+    """probe='sweep' restores the reference iterator's guarantee
+    (membership-iterator.js:33-40): in any n consecutive ticks of a
+    stable cluster, every viewer probes every other member.  Observable
+    through the suspect trail: every live node must personally have
+    probed (and therefore suspected) a dead node within one n-tick round
+    — uniform sampling only guarantees that in expectation, with
+    coupon-collector tails."""
+    n = 12
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=126, probe="sweep")
+    state = sim.init_state(n)
+    net = sim.make_net(n)
+    net = net._replace(up=net.up.at[4].set(False))
+    key = jax.random.PRNGKey(0)
+    for _ in range(n + 1):  # one full sweep round (+1 for phase offsets)
+        key, sub = jax.random.split(key)
+        state, _ = sim.swim_step(state, net, sub, params)
+    vs = np.asarray(state.view_key) & 7
+    live = [i for i in range(n) if i != 4]
+    # every live node personally probed node 4 within the round and
+    # (with no witnesses reaching it either) declared it suspect
+    assert all(vs[i, 4] == sim.SUSPECT for i in live), vs[:, 4]
+
+
+def test_sweep_probe_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        sim.swim_step_impl(
+            sim.init_state(4),
+            sim.make_net(4),
+            jax.random.PRNGKey(0),
+            sim.SwimParams(probe="banana"),
+        )
