@@ -82,11 +82,55 @@ def sim_ticks_to_detect(loss: float, seed: int) -> float:
     raise AssertionError(f"sim never detected the death (loss={loss})")
 
 
+def _sweep(loss: float, seeds: int) -> tuple[list[float], list[float]]:
+    host = [host_periods_to_detect(loss, s) for s in range(seeds)]
+    simv = [sim_ticks_to_detect(loss, s) for s in range(seeds)]
+    return host, simv
+
+
+def _run_records(seeds: int) -> list[dict]:
+    out = []
+    for loss in LOSSES:
+        host, simv = _sweep(loss, seeds)
+        out.append(
+            {
+                "metric": f"pingreq_piggyback_deviation_loss{loss}",
+                "value": round(statistics.mean(simv) / statistics.mean(host), 2),
+                "unit": "sim/host mean detection latency",
+                "host_mean_periods": round(statistics.mean(host), 1),
+                "sim_mean_ticks": round(statistics.mean(simv), 1),
+            }
+        )
+    return out
+
+
+def run(seeds: int = 2) -> list[dict]:
+    """run_all interface.  Executes in a FRESH subprocess: the CPU pin at
+    the top of this module only takes effect before any JAX backend
+    initializes, and run_all's earlier sim benches have already
+    initialized one (possibly the TPU this bench must avoid)."""
+    import os
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--run-json", str(seeds)],
+        capture_output=True,
+        text=True,
+        timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"deviation sweep failed rc={proc.returncode}: "
+            + (proc.stderr.strip().splitlines() or ["?"])[-1]
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     summary = {}
     for loss in LOSSES:
-        host = [host_periods_to_detect(loss, s) for s in range(SEEDS)]
-        simv = [sim_ticks_to_detect(loss, s) for s in range(SEEDS)]
+        host, simv = _sweep(loss, SEEDS)
         for name, vals in (("host_with_pingreq_piggyback", host), ("sim_without", simv)):
             print(
                 json.dumps(
@@ -113,4 +157,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--run-json":
+        print(json.dumps(_run_records(int(sys.argv[2]))))
+    else:
+        main()

@@ -25,6 +25,7 @@ HOST_BENCHES = [
 SIM_BENCHES = [
     "bench_sim_convergence",
     "bench_partition_heal",
+    "bench_pingreq_deviation",
 ]
 
 

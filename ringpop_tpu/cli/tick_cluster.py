@@ -359,7 +359,8 @@ class TpuSimCluster(ClusterDriver):
     (= N / period_ms ticks)."""
 
     def __init__(self, size: int, seed: int = 1, loss: float = 0.0,
-                 damping: bool = False):
+                 damping: bool = False, sparse_cap: int = 0,
+                 probe: str = "uniform"):
         import jax
 
         # The environment may pre-register a TPU plugin and pin
@@ -391,7 +392,10 @@ class TpuSimCluster(ClusterDriver):
 
         self.sim = sim
         self.cluster = SimCluster(
-            size, sim.SwimParams(loss=loss), seed=seed, damping=damping
+            size,
+            sim.SwimParams(loss=loss, sparse_cap=sparse_cap, probe=probe),
+            seed=seed,
+            damping=damping,
         )
         self._suspended: list[int] = []
         self._killed: list[int] = []
@@ -518,6 +522,13 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                              "simulation (scales to tens of thousands)")
     parser.add_argument("--loss", type=float, default=0.0,
                         help="tpu-sim: iid packet-loss probability")
+    parser.add_argument("--sparse-cap", type=int, default=0,
+                        help="tpu-sim: cap changes per message (sparse "
+                             "dissemination fast path; 0 = dense)")
+    parser.add_argument("--probe", choices=["uniform", "sweep"],
+                        default="uniform",
+                        help="tpu-sim: probe-target policy (sweep = "
+                             "round-robin per-round coverage guarantee)")
     parser.add_argument("--damping", action="store_true",
                         help="tpu-sim: enable the flap-damping extension")
     parser.add_argument("--script", default=None,
@@ -539,6 +550,7 @@ def main(argv: list[str] | None = None) -> None:
                                            seed=args.seed)
     elif backend == "tpu-sim":
         driver = TpuSimCluster(args.size, seed=args.seed, loss=args.loss,
+                               sparse_cap=args.sparse_cap, probe=args.probe,
                                damping=args.damping)
     else:
         cluster = ProcCluster(args.size, args.base_port,
