@@ -1,0 +1,52 @@
+"""Timing of the sparse-dissemination step vs dense on the live backend.
+
+    python benchmarks/profile_sparse.py [n] [cap]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+sys.path.insert(0, ".")
+
+from ringpop_tpu.models import swim_sim as sim
+
+REPS = 16
+
+
+def run_cfg(n: int, params: sim.SwimParams, label: str) -> float:
+    state = sim.init_state(n)
+    net = sim.make_net(n)
+    keys = jax.random.split(jax.random.PRNGKey(1), 3 * REPS)
+    it = iter(keys)
+    state, m = sim.swim_step(state, net, next(it), params)
+    int(m["pings_sent"])
+    for _ in range(REPS - 1):
+        state, m = sim.swim_step(state, net, next(it), params)
+    int(m["pings_sent"])
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            state, m = sim.swim_step(state, net, next(it), params)
+        int(m["pings_sent"])
+        best = min(best, (time.perf_counter() - t0) / REPS)
+    print(f"  {label:<24} {best * 1e3:8.2f} ms/tick  "
+          f"({n / best:,.0f} node-rounds/s)")
+    return best
+
+
+def main(n: int, cap: int) -> None:
+    print(f"n={n}")
+    run_cfg(n, sim.SwimParams(loss=0.01), "dense")
+    run_cfg(n, sim.SwimParams(loss=0.01, sparse_cap=cap), f"sparse cap={cap}")
+
+
+if __name__ == "__main__":
+    main(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 16384,
+        int(sys.argv[2]) if len(sys.argv) > 2 else 16,
+    )
