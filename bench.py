@@ -34,9 +34,21 @@ PROBE_TIMEOUT_S = 240
 TPU_BENCH_TIMEOUT_S = 900
 CPU_BENCH_TIMEOUT_S = 600
 
-# Dense-state sizes to attempt, largest first; OOM shrinks the cluster.
-TPU_SIZES = (32768, 16384, 10240, 8192, 4096, 2048, 1024)
-CPU_SIZES = (2048, 1024, 512)
+# (layout, n) attempts, first success wins.  The delta layout
+# (models/swim_delta.py, O(N*C) state) is the 65k+ north-star path; the
+# dense N x N layout is the fallback.  OOM shrinks the cluster.
+TPU_ATTEMPTS = (
+    ("delta", 65536),
+    ("delta", 32768),
+    ("dense", 32768),
+    ("dense", 16384),
+    ("dense", 10240),
+    ("dense", 8192),
+    ("dense", 4096),
+    ("dense", 2048),
+    ("dense", 1024),
+)
+CPU_ATTEMPTS = (("dense", 2048), ("dense", 1024), ("dense", 512))
 
 
 # ---------------------------------------------------------------------------
@@ -55,43 +67,60 @@ def _sync(metrics) -> int:
     return int(metrics["pings_sent"])
 
 
-def bench_once(n: int) -> float:
+def bench_once(n: int, layout: str = "dense") -> float:
     """Node-rounds/sec of an n-node simulation (best of REPEATS)."""
     import jax
 
     from ringpop_tpu.models import swim_sim as sim
 
-    params = sim.SwimParams(loss=0.01)
+    if layout == "delta":
+        from ringpop_tpu.models import swim_delta as sd
+
+        params = sd.DeltaParams(
+            swim=sim.SwimParams(loss=0.01), wire_cap=16, claim_grid=64
+        )
+        state = sd.init_delta(n, capacity=256)
+        step = sd.delta_step
+    else:
+        params = sim.SwimParams(loss=0.01)
+        state = sim.init_state(n)
+        step = sim.swim_step
     key = jax.random.PRNGKey(0)
-    state = sim.init_state(n)
     net = sim.make_net(n)
-    # Python-level tick loop over the donated swim_step: async dispatch
+    # Python-level tick loop over the donated step: async dispatch
     # amortizes the tunnel latency across TICKS_PER_CALL enqueued steps
     # (one host sync per batch), and — unlike lax.scan — donation keeps
     # the state strictly in-place: the scan carry double-buffered the 4 GB
     # view tensor, the difference between fitting 32k nodes and OOM.
     keys = jax.random.split(key, (REPEATS + 1) * TICKS_PER_CALL)
-    print(f"# compiling n={n}", file=sys.stderr, flush=True)
-    state, metrics = sim.swim_step(state, net, keys[0], params)
+    print(f"# compiling {layout} n={n}", file=sys.stderr, flush=True)
+    state, metrics = step(state, net, keys[0], params)
     _sync(metrics)
     it = iter(keys[1:])
     for _ in range(TICKS_PER_CALL - 1):  # warm the steady-state timing
-        state, metrics = sim.swim_step(state, net, next(it), params)
+        state, metrics = step(state, net, next(it), params)
     _sync(metrics)
     best = 0.0
     for _ in range(REPEATS):
         t0 = time.perf_counter()
         for _ in range(TICKS_PER_CALL):
-            state, metrics = sim.swim_step(state, net, next(it), params)
+            state, metrics = step(state, net, next(it), params)
         _sync(metrics)
         dt = time.perf_counter() - t0
         best = max(best, TICKS_PER_CALL * n / dt)
-        print(f"# n={n}: {best:.0f} node-rounds/s", file=sys.stderr, flush=True)
-    _device_kernel_checks(state, n)
+        print(f"# {layout} n={n}: {best:.0f} node-rounds/s", file=sys.stderr, flush=True)
+    if layout == "delta":
+        print(
+            f"# delta occupancy max={int(metrics['max_occupancy'])}"
+            f" overflow_drops={int(metrics['overflow_drops'])}",
+            file=sys.stderr,
+            flush=True,
+        )
+    _device_kernel_checks(state, n, layout)
     return best
 
 
-def _device_kernel_checks(state, n: int) -> None:
+def _device_kernel_checks(state, n: int, layout: str = "dense") -> None:
     """Exercise the device kernels on the benched backend (stderr only).
 
     (a) Pallas farmhash32 against golden vectors — its scheduled
@@ -126,7 +155,12 @@ def _device_kernel_checks(state, n: int) -> None:
         dev_book = ckdev.DeviceBook(book_addrs, DEFAULT_BASE_INC)
         import jax.numpy as jnp
 
-        keys = state.view_key[jnp.asarray(rows)]
+        if layout == "delta":
+            from ringpop_tpu.models import swim_delta as sd
+
+            keys = sd.materialize_rows(state, jnp.asarray(rows))
+        else:
+            keys = state.view_key[jnp.asarray(rows)]
         dev = np.asarray(ckdev.view_checksums_device(dev_book, keys))
         want = cksum.view_checksums_packed(
             cksum.AddressBook(book_addrs), np.asarray(keys), DEFAULT_BASE_INC
@@ -141,10 +175,10 @@ def _device_kernel_checks(state, n: int) -> None:
         print(f"# device kernel check FAILED: {e!r}", file=sys.stderr, flush=True)
 
 
-def child_main(sizes: list[int]) -> None:
-    """Measure at the largest size that fits; print one JSON line.
+def child_main(attempts: list[tuple[str, int]]) -> None:
+    """Measure at the first (layout, size) that fits; print one JSON line.
 
-    Only the first size is attempted per process on TPU: an OOM on the
+    Only the first attempt is tried per process on TPU: an OOM on the
     tunneled backend leaves the client unusable (observed: every
     subsequent allocation fails RESOURCE_EXHAUSTED), so the parent
     retries smaller sizes in fresh processes.
@@ -157,21 +191,22 @@ def child_main(sizes: list[int]) -> None:
 
         jax.config.update("jax_platforms", "cpu")
     last_err = None
-    for n in sizes:
+    for layout, n in attempts:
         try:
-            value = bench_once(n)
+            value = bench_once(n, layout)
         except Exception as e:  # OOM on smaller chips: shrink the cluster
             msg = str(e)
             if "RESOURCE_EXHAUSTED" not in msg and "out of memory" not in msg.lower():
                 raise
             last_err = e
-            print(f"# n={n}: OOM, shrinking", file=sys.stderr, flush=True)
+            print(f"# {layout} n={n}: OOM, shrinking", file=sys.stderr, flush=True)
             continue
         baseline = REFERENCE_ROUNDS_PER_NODE_SEC * n
+        name = "swim_delta" if layout == "delta" else "swim_sim"
         print(
             json.dumps(
                 {
-                    "metric": f"swim_sim_node_rounds_per_sec_n{n}",
+                    "metric": f"{name}_node_rounds_per_sec_n{n}",
                     "value": round(value, 1),
                     "unit": "node-rounds/s",
                     "vs_baseline": round(value / baseline, 2),
@@ -241,11 +276,11 @@ def main() -> None:
 
     tpu_err = _probe_tpu()
     if tpu_err is None:
-        # One size per child: a TPU OOM poisons the tunneled client, so
-        # each size gets a fresh process; first success wins.
-        for n in TPU_SIZES:
+        # One attempt per child: a TPU OOM poisons the tunneled client, so
+        # each (layout, size) gets a fresh process; first success wins.
+        for layout, n in TPU_ATTEMPTS:
             rc, out, err = _run_child(
-                [os.path.abspath(__file__), "--child", str(n)],
+                [os.path.abspath(__file__), "--child", f"{layout}:{n}"],
                 env=dict(os.environ),
                 timeout=TPU_BENCH_TIMEOUT_S,
             )
@@ -257,7 +292,7 @@ def main() -> None:
                 f"timed out after {TPU_BENCH_TIMEOUT_S}s" if rc is None else f"rc={rc}"
             )
             tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
-            errors.append(f"tpu bench n={n} {reason}: {tail[0][:160]}")
+            errors.append(f"tpu bench {layout} n={n} {reason}: {tail[0][:160]}")
             print(f"# {errors[-1]}", file=sys.stderr, flush=True)
             if rc is None:
                 break  # a hang at one size means the tunnel is sick; stop
@@ -271,7 +306,11 @@ def main() -> None:
         XLA_FLAGS=os.environ.get("XLA_FLAGS", ""),
     )
     rc, out, err = _run_child(
-        [os.path.abspath(__file__), "--child", ",".join(map(str, CPU_SIZES))],
+        [
+            os.path.abspath(__file__),
+            "--child",
+            ",".join(f"{lo}:{n}" for lo, n in CPU_ATTEMPTS),
+        ],
         env=env,
         timeout=CPU_BENCH_TIMEOUT_S,
     )
@@ -299,8 +338,13 @@ def main() -> None:
     )
 
 
+def _parse_attempt(s: str) -> tuple[str, int]:
+    layout, _, n = s.partition(":")
+    return (layout, int(n)) if n else ("dense", int(layout))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
-        child_main([int(s) for s in sys.argv[2].split(",")])
+        child_main([_parse_attempt(s) for s in sys.argv[2].split(",")])
     else:
         main()

@@ -1,0 +1,134 @@
+"""Per-piece timing of delta_step on the ambient accelerator.
+
+Times jitted sub-functions of the delta backend at a given n to locate
+which phase dominates a tick (usage: python -m benchmarks.profile_delta
+[n] [capacity]).  Pieces overlap deliberately — the goal is attribution,
+not an exact decomposition.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+
+def timeit(name, fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # host transfer as an unfakeable barrier (see bench.py _sync)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    leaves = jax.tree_util.tree_leaves(out)
+    _ = jax.device_get(leaves[0].ravel()[0] if hasattr(leaves[0], "ravel") else leaves[0])
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:35s} {dt * 1000:9.2f} ms")
+    return out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    cap = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    w, grid = 16, 64
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.01), wire_cap=w, claim_grid=grid
+    )
+    print(f"platform={jax.default_backend()} n={n} capacity={cap}")
+    state = sd.init_delta(n, capacity=cap)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+
+    # a few steps to produce a realistic (non-empty) divergence state
+    step_nodon = jax.jit(sd.delta_step_impl, static_argnames=("params",))
+    for i in range(5):
+        key, sub = jax.random.split(key)
+        state, m = step_nodon(state, net, sub, params)
+    print("occupancy:", int(m["max_occupancy"]))
+
+    timeit("full delta_step", step_nodon, state, net, key, params)
+
+    stats = timeit(
+        "phase0 stats",
+        jax.jit(sd._phase0_stats),
+        state,
+    )
+
+    k_sel = jax.random.PRNGKey(1)
+    sel = timeit(
+        "selection (phase 1)",
+        jax.jit(sd._selection, static_argnames=("params",)),
+        state, stats, net, k_sel, params,
+    )
+
+    # claim routing: realistic shapes
+    send_subj = jnp.where(
+        jnp.arange(w)[None, :] < 2, jnp.arange(n, dtype=jnp.int32)[:, None] % n,
+        sd.SENTINEL,
+    )
+    send_key = jnp.full((n, w), 9, jnp.int32)
+    send_valid = send_subj < sd.SENTINEL
+    recv = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, n, dtype=jnp.int32)
+    timeit(
+        "route_claims (sort+align)",
+        jax.jit(sd._route_claims, static_argnames=("n", "grid")),
+        n, send_subj, send_key, send_valid, recv, grid,
+    )
+
+    g_subj = jnp.where(jnp.arange(grid)[None, :] < 2,
+                       jnp.arange(n, dtype=jnp.int32)[:, None], sd.SENTINEL)
+    g_key = jnp.full((n, grid), 9, jnp.int32)
+    g_valid = g_subj < sd.SENTINEL
+    timeit(
+        "merge_claims (grid)",
+        jax.jit(sd._merge_claims, static_argnames=("sl_start",)),
+        state, g_subj, g_key, g_valid, 26,
+    )
+
+    timeit(
+        "compact_true [N,C]->W",
+        jax.jit(lambda m: sd._compact_true(m, w)),
+        state.d_pb >= -1,
+    )
+
+    timeit(
+        "sort_claim_rows [N,W]",
+        jax.jit(sd._sort_claim_rows),
+        send_subj, send_key, send_valid,
+    )
+
+    timeit(
+        "row sort [N,C] (jnp.sort)",
+        jax.jit(lambda x: jnp.sort(x, axis=1)),
+        state.d_subj,
+    )
+
+    timeit(
+        "row searchsorted [N,C]x[N,W]",
+        jax.jit(lambda a, q: sd._lookup_pos(a, q)[1]),
+        state.d_subj, jnp.clip(send_subj, 0, n - 1),
+    )
+
+    timeit(
+        "lax.sort 3x[N*W] num_keys=2",
+        jax.jit(lambda a, b, c: jax.lax.sort((a, b, c), num_keys=2)),
+        jnp.arange(n * w, dtype=jnp.int32) % n,
+        jnp.arange(n * w, dtype=jnp.int32) % 7,
+        jnp.zeros(n * w, jnp.int32),
+    )
+
+    timeit(
+        "view_lookup [N]",
+        jax.jit(sd.view_lookup),
+        state, jnp.arange(n, dtype=jnp.int32),
+    )
+
+
+if __name__ == "__main__":
+    main()

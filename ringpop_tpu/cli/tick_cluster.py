@@ -360,7 +360,8 @@ class TpuSimCluster(ClusterDriver):
 
     def __init__(self, size: int, seed: int = 1, loss: float = 0.0,
                  damping: bool = False, sparse_cap: int = 0,
-                 probe: str = "uniform"):
+                 probe: str = "uniform", layout: str = "dense",
+                 capacity: int = 256):
         import jax
 
         # The environment may pre-register a TPU plugin and pin
@@ -396,6 +397,8 @@ class TpuSimCluster(ClusterDriver):
             sim.SwimParams(loss=loss, sparse_cap=sparse_cap, probe=probe),
             seed=seed,
             damping=damping,
+            backend=layout,
+            capacity=capacity,
         )
         self._suspended: list[int] = []
         self._killed: list[int] = []
@@ -529,6 +532,14 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                         default="uniform",
                         help="tpu-sim: probe-target policy (sweep = "
                              "round-robin per-round coverage guarantee)")
+    parser.add_argument("--layout", choices=["dense", "delta"],
+                        default="dense",
+                        help="tpu-sim state layout: dense N x N views, or "
+                             "the O(N*C) delta-from-base tables "
+                             "(models/swim_delta.py) for 65k+ nodes")
+    parser.add_argument("--capacity", type=int, default=256,
+                        help="tpu-sim --layout delta: divergence slots "
+                             "per viewer (C)")
     parser.add_argument("--damping", action="store_true",
                         help="tpu-sim: enable the flap-damping extension")
     parser.add_argument("--script", default=None,
@@ -551,7 +562,8 @@ def main(argv: list[str] | None = None) -> None:
     elif backend == "tpu-sim":
         driver = TpuSimCluster(args.size, seed=args.seed, loss=args.loss,
                                sparse_cap=args.sparse_cap, probe=args.probe,
-                               damping=args.damping)
+                               damping=args.damping, layout=args.layout,
+                               capacity=args.capacity)
     else:
         cluster = ProcCluster(args.size, args.base_port,
                               log_level=args.log_level)

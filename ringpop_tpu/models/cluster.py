@@ -22,6 +22,7 @@ import numpy as np
 
 from ringpop_tpu.hashring import HashRing
 from ringpop_tpu.models import checksum as cksum
+from ringpop_tpu.models import swim_delta as sdelta
 from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.ops import checksum_device as ckdev
 from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
@@ -52,8 +53,36 @@ class SimCluster:
         init: str = "converged",
         device: Any | None = None,
         damping: bool = False,
+        backend: str = "dense",
+        capacity: int = 256,
+        wire_cap: int = 16,
+        claim_grid: int = 64,
     ):
+        """``backend='dense'``: the N x N state (swim_sim.py) — every
+        scenario incl. partitions and mode='self' bootstrap.
+        ``backend='delta'``: the O(N * C) delta-from-base state
+        (swim_delta.py) — converged-start scenarios with bounded
+        divergence (loss/kill/suspend/join/leave churn) at 65k+ nodes
+        per chip; ``capacity``/``wire_cap``/``claim_grid`` are its
+        resource caps."""
+        if backend not in ("dense", "delta"):
+            raise ValueError(f"unknown backend: {backend!r}")
+        if backend == "delta" and (damping or init != "converged"):
+            raise ValueError(
+                "the delta backend starts from a converged base (its "
+                "divergence tables cannot bound a dense bootstrap) and "
+                "does not support damping tensors"
+            )
+        if backend == "delta" and params.sparse_cap:
+            raise ValueError(
+                "sparse_cap is a dense-backend knob; the delta backend "
+                "bounds messages with wire_cap"
+            )
+        self.backend = backend
         self.params = params
+        self.dparams = sdelta.DeltaParams(
+            swim=params, wire_cap=wire_cap, claim_grid=claim_grid
+        )
         self.book = cksum.AddressBook(addresses or cksum.default_addresses(n))
         if len(self.book) != n:
             raise ValueError("addresses must have length n")
@@ -61,9 +90,14 @@ class SimCluster:
         rel = np.zeros(n, dtype=np.int32) if inc is None else (
             np.asarray(inc, dtype=np.int64) - base_inc
         ).astype(np.int32)
-        self.state: ClusterState = sim.init_state(
-            n, jnp.asarray(rel), mode=init, damping=damping
-        )
+        if backend == "delta":
+            self.state: Any = sdelta.init_delta(
+                n, jnp.asarray(rel), capacity=capacity
+            )
+        else:
+            self.state = sim.init_state(
+                n, jnp.asarray(rel), mode=init, damping=damping
+            )
         self.net: NetState = sim.make_net(n)
         self.key = jax.random.PRNGKey(seed)
         self.metrics_log: list[dict[str, int]] = []
@@ -84,7 +118,16 @@ class SimCluster:
 
     def tick(self, ticks: int = 1) -> dict[str, int]:
         """Advance every node ``ticks`` protocol periods."""
-        if ticks == 1:
+        if self.backend == "delta":
+            if ticks == 1:
+                self.state, metrics = sdelta.delta_step(
+                    self.state, self.net, self._split(), self.dparams
+                )
+            else:
+                self.state, metrics = sdelta.delta_run(
+                    self.state, self.net, self._split(), self.dparams, ticks
+                )
+        elif ticks == 1:
             self.state, metrics = sim.swim_step(
                 self.state, self.net, self._split(), self.params
             )
@@ -109,11 +152,26 @@ class SimCluster:
 
     # -- convergence (tick-cluster.js:88-115) --------------------------------
 
+    def _own_keys(self) -> np.ndarray:
+        """int32[N]: each node's view of itself (the gossip gate)."""
+        if self.backend == "delta":
+            ids = jnp.arange(self.n, dtype=jnp.int32)
+            return np.asarray(sdelta.view_lookup(self.state, ids))
+        return np.asarray(jnp.diagonal(self.state.view_key))
+
+    def _view_rows(self, idx: np.ndarray) -> np.ndarray:
+        """int32[len(idx), N] materialized view rows (host copies)."""
+        if self.backend == "delta":
+            return np.asarray(
+                sdelta.materialize_rows(self.state, jnp.asarray(idx))
+            )
+        return np.asarray(self.state.view_key[jnp.asarray(idx)])
+
     def live_indices(self) -> np.ndarray:
         up = np.asarray(self.net.up) & np.asarray(self.net.responsive)
         # Diagonal first, then unpack: the view_status property would
         # materialize the full N x N unpacked tensor.
-        own = np.asarray(jnp.diagonal(self.state.view_key)) & 7
+        own = self._own_keys() & 7
         gossiping = up & ((own == sim.ALIVE) | (own == sim.SUSPECT))
         return np.flatnonzero(gossiping)
 
@@ -122,6 +180,12 @@ class SimCluster:
         equality — no hash involved).  Fixed-shape masked compare on
         device: a gather by the (variable-length) live set would force an
         XLA recompile every time the live count changes."""
+        if self.backend == "delta":
+            return bool(
+                sdelta._converged_impl(
+                    self.state, self.net.up, self.net.responsive
+                )
+            )
         return bool(_converged_impl(self.state, self.net))
 
     def checksums(
@@ -142,12 +206,15 @@ class SimCluster:
                 self._device_book = ckdev.DeviceBook(
                     self.book.addresses, self.base_inc
                 )
-            rows = self.state.view_key[jnp.asarray(idx)]
+            if self.backend == "delta":
+                rows = sdelta.materialize_rows(self.state, jnp.asarray(idx))
+            else:
+                rows = self.state.view_key[jnp.asarray(idx)]
             sums = np.asarray(ckdev.view_checksums_device(self._device_book, rows))
             return {self.book.addresses[i]: int(c) for i, c in zip(idx, sums)}
         # Pull only the requested rows, unpacking on host (row-sized work;
         # the view_status/view_inc properties would unpack all N x N).
-        keys = np.asarray(self.state.view_key[jnp.asarray(idx)])
+        keys = self._view_rows(idx)
         sums = cksum.view_checksums_packed(self.book, keys, self.base_inc)
         return {self.book.addresses[i]: int(c) for i, c in zip(idx, sums)}
 
@@ -159,7 +226,7 @@ class SimCluster:
 
     def members(self, viewer: int) -> list[dict]:
         """The viewer's member list, reference getStats shape."""
-        row = np.asarray(self.state.view_key[viewer])
+        row = self._view_rows(np.asarray([viewer]))[0]
         return cksum.row_members(self.book, row & 7, row >> 3, self.base_inc)
 
     # -- lookup (ring derived from a node's view, lib/ring.js) ---------------
@@ -171,7 +238,7 @@ class SimCluster:
         # members are quarantined from the ring (damping extension)
         damped_row = (
             np.asarray(self.state.damped[viewer])
-            if self.state.damped is not None
+            if getattr(self.state, "damped", None) is not None
             else None
         )
         servers = [
@@ -185,7 +252,7 @@ class SimCluster:
 
     def damped_pairs(self) -> int:
         """Total (viewer, subject) damped entries (damping extension)."""
-        if self.state.damped is None:
+        if getattr(self.state, "damped", None) is None:
             return 0
         return int(jnp.sum(self.state.damped))
 
@@ -209,10 +276,19 @@ class SimCluster:
         if inc is None:
             # max(view_key) >> 3 == max(view_inc): the key is monotone in
             # inc (status occupies only the low 3 bits).
-            inc = int(jnp.max(self.state.view_key) >> 3) + 1000
+            if self.backend == "delta":
+                inc = int(
+                    max(jnp.max(self.state.base_key), jnp.max(self.state.d_key))
+                    >> 3
+                ) + 1000
+            else:
+                inc = int(jnp.max(self.state.view_key) >> 3) + 1000
         else:
             inc = inc - self.base_inc
-        self.state = sim.revive(self.state, i, inc)
+        if self.backend == "delta":
+            self.state = sdelta.revive(self.state, i, inc)
+        else:
+            self.state = sim.revive(self.state, i, inc)
         self.net = self.net._replace(
             up=self.net.up.at[i].set(True),
             responsive=self.net.responsive.at[i].set(True),
@@ -225,13 +301,24 @@ class SimCluster:
         self.join(i, seed)
 
     def join(self, joiner: int, seed: int) -> None:
-        self.state = sim.admin_join(self.state, joiner, seed)
+        if self.backend == "delta":
+            self.state = sdelta.admin_join(self.state, joiner, seed)
+        else:
+            self.state = sim.admin_join(self.state, joiner, seed)
 
     def leave(self, i: int) -> None:
-        self.state = sim.admin_leave(self.state, i)
+        if self.backend == "delta":
+            self.state = sdelta.admin_leave(self.state, i)
+        else:
+            self.state = sim.admin_leave(self.state, i)
 
     def partition(self, groups: Sequence[Sequence[int]]) -> None:
         """Disconnect the given groups from each other (block adjacency)."""
+        if self.backend == "delta":
+            raise NotImplementedError(
+                "partitions need the dense backend: a netsplit diverges "
+                "densely by construction (swim_delta.py scope note)"
+            )
         gid = np.full(self.n, -1, dtype=np.int32)
         for g, members in enumerate(groups):
             gid[np.asarray(members, dtype=np.int32)] = g
@@ -250,11 +337,24 @@ class SimCluster:
 
     def set_loss(self, p: float) -> None:
         self.params = self.params._replace(loss=float(p))
+        self.dparams = self.dparams._replace(swim=self.params)
+
+    # -- delta maintenance (no-ops on the dense backend) ---------------------
+
+    def compact(self) -> None:
+        """Drop delta slots healed back to the base (swim_delta.compact)."""
+        if self.backend == "delta":
+            self.state = sdelta.compact(self.state)
+
+    def rebase(self) -> None:
+        """Fold majority divergence into the base (swim_delta.rebase)."""
+        if self.backend == "delta":
+            self.state = sdelta.rebase(self.state)
 
     # -- stats ---------------------------------------------------------------
 
     def status_counts(self, viewer: int) -> dict[str, int]:
-        vs = np.asarray(self.state.view_key[viewer]) & 7
+        vs = self._view_rows(np.asarray([viewer]))[0] & 7
         return {
             name: int((vs == code).sum()) for code, name in sim.STATUS_NAMES.items()
         }

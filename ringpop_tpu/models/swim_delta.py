@@ -551,7 +551,7 @@ def _merge_claims(
     d_sl = jnp.where(
         s_applies & (new_status == SUSPECT), jnp.int8(sl_start), state.d_sl
     )
-    d_sl = jnp.where(s_applies & (new_status == ALIVE), jnp.int8(-1), d_sl)
+    d_sl = jnp.where(s_applies & (new_status != SUSPECT), jnp.int8(-1), d_sl)
 
     # --- refutation: self slot (matched or inserted) ------------------
     self_cur_inc = jnp.where(
@@ -701,6 +701,13 @@ def _route_claims(
     g_subj = jnp.where(g_valid, g_subj, SENTINEL)
     g_key = jnp.where(g_valid, g_key, 0)
     dropped = jnp.sum(jnp.maximum(counts - grid, 0), dtype=jnp.int32)
+    # Re-pack: masking duplicates leaves SENTINEL holes mid-row, and
+    # _merge_claims binary-searches these rows — a hole breaks the
+    # sortedness contract and silently loses the claims after it.
+    order = jnp.argsort(g_subj, axis=1)
+    g_subj = jnp.take_along_axis(g_subj, order, axis=1)
+    g_key = jnp.take_along_axis(g_key, order, axis=1)
+    g_valid = g_subj < SENTINEL
     return g_subj, g_key, g_valid, dropped
 
 
@@ -885,7 +892,7 @@ def delta_step_impl(
             d_sl = jnp.where(
                 applies_b & (nst == SUSPECT), jnp.int8(sl_start), st3.d_sl
             )
-            d_sl = jnp.where(applies_b & (nst == ALIVE), jnp.int8(-1), d_sl)
+            d_sl = jnp.where(applies_b & (nst != SUSPECT), jnp.int8(-1), d_sl)
             return (
                 st3._replace(d_key=d_key, d_pb=d_pb, d_sl=d_sl),
                 out.applied_points + jnp.sum(applies_b, dtype=jnp.int32),
@@ -986,7 +993,14 @@ def _sort_claim_rows(
         shift *= 2
     first = jnp.pad(subj, ((0, 0), (1, 0)), constant_values=-1)[:, :kk] != subj
     valid = first & (subj < SENTINEL)
-    return jnp.where(valid, subj, SENTINEL), jnp.where(valid, key, 0), valid
+    subj = jnp.where(valid, subj, SENTINEL)
+    key = jnp.where(valid, key, 0)
+    # Re-pack (see _route_claims): dedup holes break the sortedness that
+    # _merge_claims' binary search relies on.
+    order = jnp.argsort(subj, axis=1)
+    subj = jnp.take_along_axis(subj, order, axis=1)
+    key = jnp.take_along_axis(key, order, axis=1)
+    return subj, key, subj < SENTINEL
 
 
 delta_step = jax.jit(
@@ -1014,6 +1028,63 @@ def delta_run_impl(
 delta_run = jax.jit(
     delta_run_impl, static_argnames=("params", "ticks"), donate_argnums=(0,)
 )
+
+
+# ---------------------------------------------------------------------------
+# row materialization + exact convergence (device-side, no densify)
+# ---------------------------------------------------------------------------
+
+
+def materialize_rows(state: DeltaState, idx: jax.Array) -> jax.Array:
+    """int32[len(idx), N] view rows for the requested viewers: the base
+    with each viewer's delta slots scattered in (subjects are unique per
+    row, so the scatter is conflict-free).  O(len(idx) * N) — the
+    whole-cluster densify stays O(N^2) and is for tests only."""
+    idx = jnp.asarray(idx, dtype=jnp.int32)
+    n = state.n
+    subj = state.d_subj[idx]  # [K, C]
+    keyv = state.d_key[idx]
+    live = subj < SENTINEL
+    rows = jnp.broadcast_to(state.base_key[None, :], (idx.shape[0], n))
+    k_ids = jnp.arange(idx.shape[0], dtype=jnp.int32)[:, None]
+    return rows.at[k_ids, jnp.where(live, subj, n)].set(
+        jnp.where(live, keyv, 0), mode="drop"
+    )
+
+
+@jax.jit
+def _converged_impl(
+    state: DeltaState, up: jax.Array, responsive: jax.Array
+) -> jax.Array:
+    """Exact view agreement among live (gossiping) viewers — the delta
+    twin of cluster._converged_impl, O(N * C) with no densify:
+    viewer i's row equals the reference row iff (a) every live slot of i
+    carries the reference's value at that subject and (b) i holds a slot
+    at every subject where the reference row diverges from the base."""
+    n, c = state.n, state.capacity
+    ids = jnp.arange(n, dtype=jnp.int32)
+    own = view_lookup(state, ids) & 7
+    live = up & responsive & ((own == ALIVE) | (own == SUSPECT))
+    ref = jnp.argmax(live)
+
+    ref_subj = state.d_subj[ref]  # [C]
+    ref_key = state.d_key[ref]
+    ref_live = ref_subj < SENTINEL
+    ref_row = state.base_key.at[jnp.where(ref_live, ref_subj, n)].set(
+        jnp.where(ref_live, ref_key, 0), mode="drop"
+    )
+
+    slots_live = state.d_subj < SENTINEL
+    subj_safe = jnp.where(slots_live, state.d_subj, 0)
+    ok_slots = jnp.all(
+        jnp.where(slots_live, state.d_key == ref_row[subj_safe], True), axis=1
+    )
+    div = ref_live & (ref_key != state.base_key[jnp.clip(ref_subj, 0, n - 1)])
+    q = jnp.broadcast_to(jnp.where(div, ref_subj, 0)[None, :], (n, c))
+    _, found = _lookup_pos(state.d_subj, q)
+    ok_cover = jnp.all(jnp.where(div[None, :], found, True), axis=1)
+    row_same = ok_slots & ok_cover
+    return jnp.all(jnp.where(live, row_same, True)) | (jnp.sum(live) <= 1)
 
 
 # ---------------------------------------------------------------------------
@@ -1047,44 +1118,100 @@ def compact(state: DeltaState) -> DeltaState:
 
 
 def rebase(state: DeltaState) -> DeltaState:
-    """Fold unanimous divergence into the base (host-side, rare).
+    """Fold majority divergence into the base (host-side, rare).
 
-    A subject moves to a new base value when EVERY viewer's view of it
-    is that value and no viewer holds an active pb/suspicion record for
-    it.  Returns a state whose materialized views are identical but
-    whose tables only carry true disagreement."""
+    For each subject, if most viewers have converged on one new value
+    (e.g. the whole cluster declared a killed node faulty), that value
+    becomes the base and the convergent slots are dropped; the minority
+    — typically dead/stale rows that will never update — get small
+    compensating slots carrying the old base value.  A subject folds
+    only when it nets slots back (drops > inserts) and no affected row
+    would overflow.  Returns a state whose materialized views are
+    identical but whose tables only carry true disagreement — the
+    long-running fast path regardless of accumulated churn.
+    """
     state = compact(state)
     n, cap = state.n, state.capacity
-    d_subj = np.asarray(state.d_subj)
-    d_key = np.asarray(state.d_key)
-    d_pb = np.asarray(state.d_pb)
-    d_sl = np.asarray(state.d_sl)
+    d_subj = np.asarray(state.d_subj).copy()
+    d_key = np.asarray(state.d_key).copy()
+    d_pb = np.asarray(state.d_pb).copy()
+    d_sl = np.asarray(state.d_sl).copy()
     base = np.asarray(state.base_key).copy()
 
     live = d_subj < int(SENTINEL)
     rows, cols = np.nonzero(live)
+    if rows.size == 0:
+        return state
     subs = d_subj[rows, cols]
-    # per subject: how many viewers diverge, min/max of their keys, any
-    # active pb/sl
-    cnt = np.zeros(n, dtype=np.int64)
-    np.add.at(cnt, subs, 1)
-    kmin = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
-    kmax = np.full(n, np.iinfo(np.int64).min, dtype=np.int64)
-    np.minimum.at(kmin, subs, d_key[rows, cols])
-    np.maximum.at(kmax, subs, d_key[rows, cols])
-    busy = np.zeros(n, dtype=bool)
-    np.logical_or.at(busy, subs, (d_pb[rows, cols] >= 0) | (d_sl[rows, cols] >= 0))
+    keys = d_key[rows, cols]
+    busy = (d_pb[rows, cols] >= 0) | (d_sl[rows, cols] >= 0)
+    cnt = np.bincount(subs, minlength=n)  # slot-holders per subject
 
-    foldable = (cnt == n) & (kmin == kmax) & ~busy
-    if foldable.any():
-        base[foldable] = kmax[foldable].astype(np.int32)
-        drop = foldable[subs]
-        d_subj[rows[drop], cols[drop]] = int(SENTINEL)
-        order = np.argsort(d_subj, axis=1)
-        d_subj = np.take_along_axis(d_subj, order, axis=1)
-        d_key = np.where(d_subj < int(SENTINEL), np.take_along_axis(d_key, order, axis=1), 0)
-        d_pb = np.where(d_subj < int(SENTINEL), np.take_along_axis(d_pb, order, axis=1), -1)
-        d_sl = np.where(d_subj < int(SENTINEL), np.take_along_axis(d_sl, order, axis=1), -1)
+    # Candidate fold value per subject: the most common value among
+    # droppable (non-busy) slots.  Post-compact these all differ from
+    # the current base.  Busy slots keep their slot either way (their
+    # pb/sl records need a home even when the value matches the base).
+    dr = ~busy
+    if not dr.any():
+        return state
+    s_d, k_d, r_d = subs[dr], keys[dr], rows[dr]
+    order = np.lexsort((k_d, s_d))
+    s_s, k_s = s_d[order], k_d[order]
+    new_run = np.ones(len(s_s), dtype=bool)
+    new_run[1:] = (s_s[1:] != s_s[:-1]) | (k_s[1:] != k_s[:-1])
+    run_ids = np.cumsum(new_run) - 1
+    run_counts = np.bincount(run_ids)
+    run_subj = s_s[new_run]
+    run_key = k_s[new_run]
+    # inserts needed = viewers with no slot at the subject (they hold
+    # the old base view and must keep holding it after the fold)
+    gains = run_counts - (n - cnt[run_subj])
+    # best candidate per subject (max gain)
+    best = np.lexsort((gains, run_subj))
+    last_of_subj = np.ones(len(best), dtype=bool)
+    last_of_subj[:-1] = run_subj[best][1:] != run_subj[best][:-1]
+    pick = best[last_of_subj]
+    pick = pick[gains[pick] > 0]
+    if pick.size == 0:
+        return state
+
+    occ = live.sum(axis=1)
+    for p in pick[np.argsort(-gains[pick])]:
+        j = int(run_subj[p])
+        v = int(run_key[p])
+        has_slot = np.zeros((n,), dtype=bool)
+        has_slot[rows[subs == j]] = True
+        need_insert_idx = np.flatnonzero(~has_slot)
+        if np.any(occ[need_insert_idx] >= cap):
+            continue  # a compensating insert would overflow; skip
+        # drop convergent droppable slots of value v
+        drop_mask = live & (d_subj == j) & (d_key == v) & (d_pb < 0) & (d_sl < 0)
+        d_subj[drop_mask] = int(SENTINEL)
+        # insert compensating (j, old base) slots
+        for i in need_insert_idx:
+            free = np.flatnonzero(d_subj[i] == int(SENTINEL))
+            c = free[0]
+            d_subj[i, c] = j
+            d_key[i, c] = base[j]
+            d_pb[i, c] = -1
+            d_sl[i, c] = -1
+        base[j] = v
+        live = d_subj < int(SENTINEL)
+        occ = live.sum(axis=1)
+        rows, cols = np.nonzero(live)
+        subs = d_subj[rows, cols]
+
+    order2 = np.argsort(d_subj, axis=1)
+    d_subj = np.take_along_axis(d_subj, order2, axis=1)
+    d_key = np.where(
+        d_subj < int(SENTINEL), np.take_along_axis(d_key, order2, axis=1), 0
+    )
+    d_pb = np.where(
+        d_subj < int(SENTINEL), np.take_along_axis(d_pb, order2, axis=1), -1
+    )
+    d_sl = np.where(
+        d_subj < int(SENTINEL), np.take_along_axis(d_sl, order2, axis=1), -1
+    )
 
     bp_mask, bp_rank = _base_rank_structs(jnp.asarray(base))
     return state._replace(
@@ -1142,32 +1269,107 @@ def view_of(state: DeltaState, viewer: int, subject: int) -> int:
     return int(np.asarray(state.base_key)[subject])
 
 
+def _materialize_row(state: DeltaState, i: int):
+    """Dense (vk, pb, sl) of viewer ``i`` (host-side numpy)."""
+    n = state.n
+    vk = np.asarray(state.base_key).copy()
+    pb = np.full(n, -1, np.int8)
+    sl = np.full(n, -1, np.int8)
+    subj = np.asarray(state.d_subj[i])
+    live = subj < int(SENTINEL)
+    vk[subj[live]] = np.asarray(state.d_key[i])[live]
+    pb[subj[live]] = np.asarray(state.d_pb[i])[live]
+    sl[subj[live]] = np.asarray(state.d_sl[i])[live]
+    return vk, pb, sl
+
+
+def _write_row(
+    state: DeltaState,
+    i: int,
+    vk: np.ndarray,
+    pb: np.ndarray,
+    sl: np.ndarray,
+    *,
+    elide_redundant: bool = False,
+) -> DeltaState:
+    """Re-sparsify a dense row against the base and store it as viewer
+    ``i``'s table.  When the divergence exceeds capacity, base-valued
+    entries (slots needed only for their pb/sl records, not their view)
+    are dropped first — dropping a divergent entry would corrupt the
+    view itself.  ``elide_redundant=True`` (the join path) drops those
+    base-valued pb-records *silently*: a joiner re-announcing members
+    everyone already agrees on is redundant traffic, not capacity
+    pressure, so it must not pollute ``overflow_drops`` (at 65k nodes a
+    single join would otherwise add ~n to the metric)."""
+    n, cap = state.n, state.capacity
+    base = np.asarray(state.base_key)
+    need = (vk != base) | (pb >= 0) | (sl >= 0)
+    subs = np.flatnonzero(need)
+    dropped = 0
+    if len(subs) > cap:
+        divergent = vk[subs] != base[subs]
+        if divergent.sum() > cap:
+            raise ValueError(
+                f"viewer {i}: view divergence {int(divergent.sum())} exceeds "
+                f"table capacity {cap}"
+            )
+        order = np.argsort(~divergent, kind="stable")  # divergent first
+        kept = subs[order][:cap]
+        cut = subs[order][cap:]
+        if elide_redundant:
+            # only cuts that lose real state (diverging view, or an
+            # active suspicion record) count as overflow
+            dropped = int(((vk[cut] != base[cut]) | (sl[cut] >= 0)).sum())
+        else:
+            dropped = len(cut)
+        subs = np.sort(kept)
+    row_subj = np.full(cap, int(SENTINEL), np.int32)
+    row_key = np.zeros(cap, np.int32)
+    row_pb = np.full(cap, -1, np.int8)
+    row_sl = np.full(cap, -1, np.int8)
+    row_subj[: len(subs)] = subs
+    row_key[: len(subs)] = vk[subs]
+    row_pb[: len(subs)] = pb[subs]
+    row_sl[: len(subs)] = sl[subs]
+    return state._replace(
+        d_subj=state.d_subj.at[i].set(jnp.asarray(row_subj)),
+        d_key=state.d_key.at[i].set(jnp.asarray(row_key)),
+        d_pb=state.d_pb.at[i].set(jnp.asarray(row_pb)),
+        d_sl=state.d_sl.at[i].set(jnp.asarray(row_sl)),
+        overflow_drops=state.overflow_drops + jnp.int32(dropped),
+    )
+
+
 def admin_join(state: DeltaState, joiner: int, seed: int) -> DeltaState:
     """join-sender.js + join-handler.js over deltas: the seed marks the
-    joiner alive (recording the change); the joiner adopts the seed's
-    full view — base + the seed's deltas — wholesale."""
-    j_key = view_of(state, joiner, joiner)
-    j_inc = j_key >> 3
-    in_key = j_inc * 8 + ALIVE
-    cur = view_of(state, seed, joiner)
-    if bool(_apply_mask(jnp.int32(cur), jnp.int32(in_key))):
-        state = _set_entry(state, seed, joiner, in_key, 0, -1)
+    joiner alive (recording the change, preserving any running suspicion
+    countdown), and the joiner adopts the seed's **entire** view with
+    every adopted member recorded as a change (pb=0) — the reference
+    records all bootstrap entries into dissemination
+    (membership-set-listener.js:33-47).  Bit-exact to the dense
+    ``swim_sim.admin_join`` when ``capacity >= n - 1``; at production
+    caps the joiner's redundant re-announcements of base-valued members
+    are elided instead (see ``_write_row``) — the documented
+    bounded-resource deviation.  Host-side dense row ops: admin joins
+    are rare, O(N) is fine."""
+    n = state.n
+    svk, spb, ssl = _materialize_row(state, seed)
+    jvk, jpb, jsl = _materialize_row(state, joiner)
 
-    # joiner adopts seed's divergence (full sync), keeps its own self
-    # entry, records everything adopted
-    seed_subj = np.asarray(state.d_subj[seed])
-    seed_key = np.asarray(state.d_key[seed])
-    self_key = view_of(state, joiner, joiner) or ALIVE
-    # wipe joiner row
-    state = _wipe_row(state, joiner)
-    for c in np.nonzero(seed_subj < int(SENTINEL))[0]:
-        sj, skv = int(seed_subj[c]), int(seed_key[c])
-        if sj == joiner:
-            continue
-        state = _set_entry(state, joiner, sj, skv, 0, -1)
-    if self_key != int(np.asarray(state.base_key)[joiner]):
-        state = _set_entry(state, joiner, joiner, self_key, 0, -1)
-    return state
+    # seed: makeAlive(joiner) (join-handler.js:90)
+    j_key = int(jvk[joiner])
+    in_key = (j_key >> 3) * 8 + ALIVE
+    if bool(_apply_mask(jnp.int32(int(svk[joiner])), jnp.int32(in_key))):
+        svk[joiner] = in_key
+        spb[joiner] = 0
+        state = _write_row(state, seed, svk, spb, ssl)
+
+    # joiner: full-sync adoption of the seed's row; self entry kept
+    learned = (svk > 0) & (np.arange(n) != joiner)
+    jvk = np.where(learned, svk, jvk)
+    jpb = np.where(learned, np.int8(0), jpb)
+    jvk[joiner] = ALIVE if j_key == 0 else j_key
+    return _write_row(state, joiner, jvk, jpb, jsl, elide_redundant=True)
 
 
 def admin_leave(state: DeltaState, node: int) -> DeltaState:
@@ -1186,6 +1388,16 @@ def _wipe_row(state: DeltaState, node: int) -> DeltaState:
     )
 
 
+def revive(state: DeltaState, node: int, inc: int) -> DeltaState:
+    """A killed process restarts fresh (the dense ``swim_sim.revive``):
+    wipe its row to self-only with a new (higher) incarnation; re-entry
+    is an ``admin_join``.  pb=-1: the restarted node does not record its
+    own aliveness — the seed records it during the join."""
+    _check_inc(inc)
+    state = _wipe_row(state, node)
+    return _set_entry(state, node, node, int(inc) * 8 + ALIVE, -1, -1)
+
+
 def revive_and_join(state: DeltaState, node: int, inc: int, seed: int) -> DeltaState:
     """tick-cluster 'K': restart a killed process with a fresh higher
     incarnation and immediately bootstrap it against ``seed``.
@@ -1194,7 +1406,4 @@ def revive_and_join(state: DeltaState, node: int, inc: int, seed: int) -> DeltaS
     of divergence, which the delta representation cannot bound; the
     reference's tick-cluster revives and rejoins in one operation
     anyway, tick-cluster.js:418-430.)"""
-    _check_inc(inc)
-    state = _wipe_row(state, node)
-    state = _set_entry(state, node, node, int(inc) * 8 + ALIVE, 0, -1)
-    return admin_join(state, node, seed)
+    return admin_join(revive(state, node, inc), node, seed)

@@ -48,7 +48,9 @@ Semantics parity map (reference file:line -> here):
 * swim/ping-req-sender.js:153-296   -> phase 5: k random witnesses, two-hop
   reachability, all-definite-failures => suspect.
 * swim/suspicion.js                 -> ``suspect_left`` countdown; expiry
-  declares faulty; alive stops the timer; re-suspect restarts it.  The
+  declares faulty; any applied non-suspect status stops the timer (the
+  reference stops only on alive and lets a post-faulty fire no-op —
+  same behavior); re-suspect restarts it.  The
   countdown keeps running for suspended processes but only *fires* while
   the viewer gossips (held at 0) — a SIGSTOPped node's timers fire on
   resume, like real setTimeouts (tick-cluster.js:432-446).
@@ -464,7 +466,11 @@ def _merge_incoming(
     applied = apply | (eye & refuted[:, None])
 
     # Suspicion timers (suspicion.js:45-69 via update-listener:34-45):
-    # applied suspect (re)starts the countdown; applied alive stops it.
+    # applied suspect (re)starts the countdown; any other applied status
+    # stops it.  (The reference stops only on alive and lets the timer
+    # fire as a no-op after a faulty/leave update — same behavior, but
+    # clearing it keeps the record inactive so the delta backend's
+    # compact/rebase can drop the slot.)
     new_status = view_key & 7
     suspect_left = jnp.where(
         applied & (new_status == SUSPECT),
@@ -472,7 +478,7 @@ def _merge_incoming(
         state.suspect_left,
     )
     suspect_left = jnp.where(
-        applied & (new_status == ALIVE), jnp.int8(-1), suspect_left
+        applied & (new_status != SUSPECT), jnp.int8(-1), suspect_left
     )
 
     return _Merge(
@@ -899,7 +905,7 @@ def _point_merge(
     sl = jnp.where(
         applied & (new_status == SUSPECT), jnp.int8(sl_start), state.suspect_left
     )
-    sl = jnp.where(applied & (new_status == ALIVE), jnp.int8(-1), sl)
+    sl = jnp.where(applied & (new_status != SUSPECT), jnp.int8(-1), sl)
     return state._replace(view_key=vk, pb=pb, suspect_left=sl), applied, refuted
 
 

@@ -1,0 +1,407 @@
+"""Delta-from-base backend (models/swim_delta.py) vs the dense step.
+
+Contract (swim_delta.py docstring): with ample caps (wire_cap /
+claim_grid / capacity larger than any burst) the delta trajectory is
+**bit-identical** to ``swim_step`` from the same PRNG key — through
+loss, kills, suspends, joins, leaves and revives.  At production caps it
+degrades to bounded-resource semantics (claims_dropped /
+overflow_drops surfaced in metrics) but must still converge.
+
+Regression anchored here: the claim-routing dedup left SENTINEL holes
+mid-row, breaking the sortedness that ``_merge_claims``' binary search
+relies on — claims after a duplicate subject were silently lost under
+loss (first seen as a tick-14..33 divergence at loss=0.05).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+# jit without donation: tests keep references across steps
+_dense_step = jax.jit(sim.swim_step_impl, static_argnames=("params",))
+_delta_step = jax.jit(sd.delta_step_impl, static_argnames=("params",))
+
+
+def assert_matches_dense(delta: sd.DeltaState, dense: sim.ClusterState, tick):
+    dd = sd.densify(delta)
+    np.testing.assert_array_equal(
+        np.asarray(dd.view_key),
+        np.asarray(dense.view_key),
+        err_msg=f"view_key tick {tick}",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dd.pb), np.asarray(dense.pb), err_msg=f"pb tick {tick}"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dd.suspect_left),
+        np.asarray(dense.suspect_left),
+        err_msg=f"suspect_left tick {tick}",
+    )
+
+
+def run_both(n, ticks, params, *, capacity=None, events=(), seed=0):
+    """Drive dense + delta from the same keys; yield each tick."""
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dense = sim.init_state(n)
+    delta = sd.init_delta(n, capacity=capacity or n)
+    net = sim.make_net(n)
+    keys = jax.random.split(jax.random.PRNGKey(seed), ticks)
+    for t in range(ticks):
+        for when, op, arg in events:
+            if when != t:
+                continue
+            if op == "kill":
+                net = net._replace(up=net.up.at[arg].set(False))
+            elif op == "suspend":
+                net = net._replace(responsive=net.responsive.at[arg].set(False))
+            elif op == "resume":
+                net = net._replace(responsive=net.responsive.at[arg].set(True))
+            elif op == "leave":
+                dense = sim.admin_leave(dense, arg)
+                delta = sd.admin_leave(delta, arg)
+        dense, md = _dense_step(dense, net, keys[t], params)
+        delta, me = _delta_step(delta, net, keys[t], dparams)
+        yield t, dense, delta, md, me
+
+
+METRIC_KEYS = (
+    "pings_sent",
+    "acks",
+    "ping_changes_applied",
+    "ack_changes_applied",
+    "full_syncs",
+    "ping_reqs",
+    "suspects_declared",
+    "faulty_declared",
+)
+
+
+def test_bit_identical_steady_state_with_loss():
+    """5% loss on a converged cluster: suspects, refutations, duplicate
+    concurrent claims, full syncs — every tick bit-for-bit (this is the
+    routing-dedup regression scenario)."""
+    n = 24
+    params = sim.SwimParams(loss=0.05)
+    for t, dense, delta, md, me in run_both(n, 50, params):
+        assert_matches_dense(delta, dense, t)
+        for k in METRIC_KEYS:
+            assert int(md[k]) == int(me[k]), f"metric {k} tick {t}"
+        assert int(me["claims_dropped"]) == 0
+        assert int(me["overflow_drops"]) == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_bit_identical_kill_under_loss(seed):
+    """Kill + 5% loss: the full suspect -> refute-race -> faulty chain
+    with lossy rumor fronts must match bit-for-bit."""
+    n = 32
+    params = sim.SwimParams(loss=0.05, suspicion_ticks=10)
+    last = None
+    for t, dense, delta, _, _ in run_both(
+        n, 45, params, events=[(0, "kill", 3), (12, "kill", 17)], seed=seed
+    ):
+        assert_matches_dense(delta, dense, t)
+        last = dense
+    vs = np.asarray(last.view_key) & 7
+    live = [i for i in range(n) if i not in (3, 17)]
+    assert all(vs[i, 3] == sim.FAULTY for i in live)
+
+
+def test_bit_identical_suspend_resume():
+    """SIGSTOP analog: a suspended node neither probes nor answers; its
+    timers fire on resume (tick-cluster.js:432-446 semantics)."""
+    n = 16
+    params = sim.SwimParams(loss=0.02, suspicion_ticks=6)
+    for t, dense, delta, _, _ in run_both(
+        n, 40, params, events=[(2, "suspend", 7), (25, "resume", 7)]
+    ):
+        assert_matches_dense(delta, dense, t)
+
+
+def test_bit_identical_leave():
+    n = 16
+    params = sim.SwimParams(loss=0.02)
+    for t, dense, delta, _, _ in run_both(n, 30, params, events=[(3, "leave", 5)]):
+        assert_matches_dense(delta, dense, t)
+
+
+def test_admin_join_and_revive_match_dense():
+    """revive_and_join == dense revive + admin_join, then parity holds
+    through the re-dissemination of the fresh incarnation."""
+    n = 16
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=5)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dense = sim.init_state(n)
+    delta = sd.init_delta(n, capacity=n)
+    net = sim.make_net(n)
+    net = net._replace(up=net.up.at[4].set(False))
+    keys = jax.random.split(jax.random.PRNGKey(7), 40)
+    for t in range(20):  # node 4 goes suspect -> faulty everywhere
+        dense, _ = _dense_step(dense, net, keys[t], params)
+        delta, _ = _delta_step(delta, net, keys[t], dparams)
+    assert_matches_dense(delta, dense, "pre-revive")
+
+    inc = int(jnp.max(dense.view_key) >> 3) + 1000
+    dense = sim.revive(dense, 4, inc)
+    dense = sim.admin_join(dense, 4, 0)
+    delta = sd.revive_and_join(delta, 4, inc, 0)
+    net = net._replace(up=net.up.at[4].set(True))
+    assert_matches_dense(delta, dense, "post-revive")
+
+    for t in range(20, 40):
+        dense, _ = _dense_step(dense, net, keys[t], params)
+        delta, _ = _delta_step(delta, net, keys[t], dparams)
+        assert_matches_dense(delta, dense, t)
+    vs = np.asarray(dense.view_key) & 7
+    assert all(vs[i, 4] == sim.ALIVE for i in range(n))
+
+
+def test_compact_and_rebase_preserve_views():
+    """compact/rebase change the representation, never the views — and
+    the post-maintenance trajectory stays on the dense trajectory."""
+    n = 24
+    params = sim.SwimParams(loss=0.05, suspicion_ticks=8)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dense = sim.init_state(n)
+    delta = sd.init_delta(n, capacity=n)
+    net = sim.make_net(n)
+    net = net._replace(up=net.up.at[5].set(False))
+    keys = jax.random.split(jax.random.PRNGKey(3), 60)
+    for t in range(60):
+        dense, _ = _dense_step(dense, net, keys[t], params)
+        delta, _ = _delta_step(delta, net, keys[t], dparams)
+        if t % 15 == 14:
+            before = sd.densify(delta)
+            delta = sd.rebase(delta)  # rebase() compacts first
+            after = sd.densify(delta)
+            np.testing.assert_array_equal(
+                np.asarray(before.view_key), np.asarray(after.view_key)
+            )
+            np.testing.assert_array_equal(np.asarray(before.pb), np.asarray(after.pb))
+            np.testing.assert_array_equal(
+                np.asarray(before.suspect_left), np.asarray(after.suspect_left)
+            )
+        assert_matches_dense(delta, dense, t)
+
+
+def test_rebase_folds_converged_fault():
+    """After the cluster converges on a kill, rebase folds the majority
+    faulty entry into base_key: the 15 live viewers drop their slots and
+    only the dead node keeps one compensating slot (its frozen stale
+    view), so long-running simulations return to the near-all-base fast
+    path.  Views must be unchanged by the fold."""
+    n = 16
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=4)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    delta = sd.init_delta(n, capacity=n)
+    net = sim.make_net(n)
+    net = net._replace(up=net.up.at[2].set(False))
+    key = jax.random.PRNGKey(0)
+    # converge on the kill, then let piggyback counters evict
+    for _ in range(280):
+        key, sub = jax.random.split(key)
+        delta, me = _delta_step(delta, net, sub, dparams)
+        if int(jnp.sum(delta.d_pb >= 0)) == 0:
+            break
+    before = sd.densify(delta)
+    delta = sd.rebase(delta)
+    after = sd.densify(delta)
+    np.testing.assert_array_equal(
+        np.asarray(before.view_key), np.asarray(after.view_key)
+    )
+    occ = int(jnp.sum(delta.d_subj < sd.SENTINEL))
+    assert occ == 1, f"rebase left {occ} slots (want 1: the dead node's)"
+    assert int(delta.base_key[2]) & 7 == sim.FAULTY
+    # the one remaining slot is the dead node's frozen self-view
+    assert int(delta.d_subj[2, 0]) == 2
+
+
+def test_capacity_overflow_drops_counted_and_converges():
+    """capacity far below the divergence burst: insertions drop (counted
+    in overflow_drops), but gossip + full sync still converge the views
+    on the dense trajectory's *fixed point* (not its path)."""
+    n = 32
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=4)
+    dparams = sd.DeltaParams(swim=params, wire_cap=8, claim_grid=16)
+    delta = sd.init_delta(n, capacity=4)
+    net = sim.make_net(n)
+    net = net._replace(up=net.up.at[9].set(False))
+    key = jax.random.PRNGKey(1)
+    for _ in range(200):
+        key, sub = jax.random.split(key)
+        delta, me = _delta_step(delta, net, sub, dparams)
+        dd = sd.densify(delta)
+        vk = np.asarray(dd.view_key)
+        live = [i for i in range(n) if i != 9]
+        if all((vk[i, 9] & 7) == sim.FAULTY for i in live) and (
+            vk[live][:, live] == vk[live[0]][live]
+        ).all():
+            break
+    else:
+        pytest.fail("delta backend with tiny capacity failed to converge on the kill")
+
+
+def test_wire_cap_window_ships_later():
+    """Changes past the wire window neither bump nor evict — they ship on
+    later pings; nothing is lost, convergence completes."""
+    n = 24
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=4)
+    dparams = sd.DeltaParams(swim=params, wire_cap=1, claim_grid=8)
+    delta = sd.init_delta(n, capacity=n)
+    net = sim.make_net(n)
+    for victim in (3, 11):
+        net = net._replace(up=net.up.at[victim].set(False))
+    key = jax.random.PRNGKey(2)
+    for _ in range(250):
+        key, sub = jax.random.split(key)
+        delta, _ = _delta_step(delta, net, sub, dparams)
+        dd = sd.densify(delta)
+        vk = np.asarray(dd.view_key)
+        live = [i for i in range(n) if i not in (3, 11)]
+        if all(
+            (vk[i, v] & 7) == sim.FAULTY for i in live for v in (3, 11)
+        ):
+            return
+    pytest.fail("wire_cap=1 failed to disseminate both faults")
+
+
+def test_delta_run_scan_matches_steps():
+    """delta_run (lax.scan) == the same ticks stepped individually."""
+    n = 16
+    params = sim.SwimParams(loss=0.03)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(5)
+    stepped = sd.init_delta(n, capacity=n)
+    keys = jax.random.split(key, 10)
+    for t in range(10):
+        stepped, _ = _delta_step(stepped, net, keys[t], dparams)
+    scanned, _ = sd.delta_run_impl(
+        sd.init_delta(n, capacity=n), net, key, dparams, 10
+    )
+    # delta_run splits the key the same way: jax.random.split(key, ticks)
+    np.testing.assert_array_equal(
+        np.asarray(sd.densify(stepped).view_key),
+        np.asarray(sd.densify(scanned).view_key),
+    )
+
+
+def test_sweep_probe_parity_with_dense():
+    """probe='sweep' routes through the delta selection's own sweep path;
+    it must stay on the dense sweep trajectory."""
+    n = 16
+    params = sim.SwimParams(loss=0.02, probe="sweep", suspicion_ticks=6)
+    for t, dense, delta, _, _ in run_both(n, 30, params, events=[(0, "kill", 2)]):
+        assert_matches_dense(delta, dense, t)
+
+
+def test_delta_rejects_partition_masks():
+    n = 8
+    params = sim.SwimParams()
+    dparams = sd.DeltaParams(swim=params)
+    delta = sd.init_delta(n)
+    net = sim.make_net(n, partitioned=True)
+    with pytest.raises(NotImplementedError):
+        sd.delta_step_impl(delta, net, jax.random.PRNGKey(0), dparams)
+
+
+def test_delta_rejects_sparse_cap():
+    n = 8
+    dparams = sd.DeltaParams(swim=sim.SwimParams(sparse_cap=4))
+    delta = sd.init_delta(n)
+    net = sim.make_net(n)
+    with pytest.raises(ValueError):
+        sd.delta_step_impl(delta, net, jax.random.PRNGKey(0), dparams)
+
+
+# ---------------------------------------------------------------------------
+# SimCluster wiring (models/cluster.py backend="delta")
+# ---------------------------------------------------------------------------
+
+
+def test_simcluster_delta_matches_dense_checksums():
+    """Same seed, same scenario: the two SimCluster backends must report
+    identical reference-format checksums every step of the way."""
+    from ringpop_tpu.models.cluster import SimCluster
+
+    n = 16
+    params = sim.SwimParams(loss=0.02, suspicion_ticks=6)
+    dense = SimCluster(n, params, seed=11)
+    delta = SimCluster(
+        n, params, seed=11, backend="delta", capacity=n, wire_cap=n,
+        claim_grid=4 * n,
+    )
+    dense.kill(3)
+    delta.kill(3)
+    for _ in range(30):
+        dense.tick()
+        delta.tick()
+        assert dense.checksums() == delta.checksums()
+        assert dense.converged() == delta.converged()
+
+
+def test_simcluster_delta_kill_revive_cycle():
+    from ringpop_tpu.models.cluster import SimCluster
+
+    n = 24
+    c = SimCluster(
+        n,
+        sim.SwimParams(loss=0.0, suspicion_ticks=4),
+        backend="delta",
+        capacity=n,
+    )
+    c.kill(5)
+    assert c.run_until_converged(max_ticks=200) > 0
+    assert c.status_counts(0)["faulty"] == 1
+    c.rebase()  # fold the converged fault; views must be unchanged
+    assert c.status_counts(0)["faulty"] == 1
+    c.revive(5)
+    assert c.run_until_converged(max_ticks=200) > 0
+    assert c.status_counts(0)["faulty"] == 0
+    assert len(set(c.checksums().values())) == 1
+
+
+def test_simcluster_delta_rejects_partition_and_damping():
+    from ringpop_tpu.models.cluster import SimCluster
+
+    c = SimCluster(8, backend="delta")
+    with pytest.raises(NotImplementedError):
+        c.partition([[0, 1, 2, 3], [4, 5, 6, 7]])
+    with pytest.raises(ValueError):
+        SimCluster(8, backend="delta", damping=True)
+    with pytest.raises(ValueError):
+        SimCluster(8, backend="delta", init="self")
+
+
+def test_simcluster_delta_device_checksums_match_host():
+    from ringpop_tpu.models.cluster import SimCluster
+
+    c = SimCluster(12, sim.SwimParams(loss=0.05), backend="delta", capacity=12)
+    c.tick(10)
+    assert c.checksums(backend="device") == c.checksums(backend="host")
+
+
+def test_sparsify_densify_roundtrip():
+    n = 12
+    params = sim.SwimParams(loss=0.1)
+    dense = sim.init_state(n)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(9)
+    for _ in range(15):
+        key, sub = jax.random.split(key)
+        dense, _ = _dense_step(dense, net, sub, params)
+    base = jnp.zeros((n,), jnp.int32) * 8 + sim.ALIVE
+    base = jnp.full((n,), sim.ALIVE, jnp.int32)
+    delta = sd.sparsify(dense, base, capacity=n)
+    dd = sd.densify(delta)
+    np.testing.assert_array_equal(np.asarray(dd.view_key), np.asarray(dense.view_key))
+    np.testing.assert_array_equal(np.asarray(dd.pb), np.asarray(dense.pb))
+    np.testing.assert_array_equal(
+        np.asarray(dd.suspect_left), np.asarray(dense.suspect_left)
+    )
