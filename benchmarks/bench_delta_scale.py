@@ -1,0 +1,137 @@
+"""Delta-backend scale bench: 256k-1M virtual nodes on one chip.
+
+Substantiates swim_delta.py's "a 1,048,576-node cluster still fits one
+chip" claim (BASELINE configs 3/5 family) with a measured churn
+scenario, exercising the maintenance path in the loop:
+
+  converged cluster at n -> steady 0.5% loss -> kill a node, let the
+  cluster converge on it (suspect -> faulty), revive+rejoin it -> rebase
+  folds the healed divergence back into the base -> repeat.
+
+Prints one JSON line per size:
+  {"metric": "delta_scale_node_rounds_per_sec_n<N>", "value": ...,
+   "unit": "node-rounds/s", "vs_baseline": ..., "occupancy": ...,
+   "overflow_drops": ..., "converged_on_kill": ...}
+
+``vs_baseline``: speedup over the real-time protocol rate at equal N
+(5 * N node-rounds/s, gossip.js:127-129) — same definition as bench.py.
+
+Run: python benchmarks/bench_delta_scale.py [sizes_csv] [ticks_per_batch]
+Defaults: sizes 262144,1048576; 20 ticks per timed batch.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+REFERENCE_ROUNDS_PER_NODE_SEC = 5.0
+CAPACITY = 256
+LOSS = 0.005
+
+
+def run_size(n: int, ticks: int) -> dict:
+    import jax
+
+    from ringpop_tpu.models import swim_delta as sd
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=LOSS, suspicion_ticks=25),
+        wire_cap=16,
+        claim_grid=64,
+    )
+    state = sd.init_delta(n, capacity=CAPACITY)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+
+    victim = n // 3
+    net = net._replace(up=net.up.at[victim].set(False))
+
+    print(f"# compiling delta n={n}", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    key, sub = jax.random.split(key)
+    state, m = sd.delta_run(state, net, sub, params, ticks)
+    _ = int(m["pings_sent"])  # host sync
+    print(
+        f"# n={n}: first batch (compile + {ticks} ticks) "
+        f"{time.perf_counter() - t0:.0f}s",
+        file=sys.stderr,
+        flush=True,
+    )
+
+    # drive until the kill has fully converged (suspect->faulty everywhere)
+    converged_on_kill = False
+    for _ in range(12):  # <= 240 ticks; suspicion is 25
+        key, sub = jax.random.split(key)
+        state, m = sd.delta_run(state, net, sub, params, ticks)
+        if int(m["faulty_declared"]) == 0 and int(m["suspects_declared"]) == 0:
+            ids = jax.numpy.asarray([0, 1, n - 1])
+            rows = sd.materialize_rows(state, ids)
+            if all(int(r) & 7 == sim.FAULTY for r in rows[:, victim]):
+                converged_on_kill = True
+                break
+
+    # revive + rejoin, then rebase folds the healed divergence
+    inc = int(
+        max(
+            jax.numpy.max(state.base_key), jax.numpy.max(state.d_key)
+        )
+        >> 3
+    ) + 1000
+    state = sd.revive_and_join(state, victim, inc, seed=0)
+    net = net._replace(up=net.up.at[victim].set(True))
+    for _ in range(6):
+        key, sub = jax.random.split(key)
+        state, m = sd.delta_run(state, net, sub, params, ticks)
+    occ_before = int(m["max_occupancy"])
+    state = sd.rebase(state)
+    occ_after = int(
+        jax.numpy.max(
+            jax.numpy.sum((state.d_subj < sd.SENTINEL).astype(jax.numpy.int32), axis=1)
+        )
+    )
+    print(
+        f"# n={n}: rebase occupancy {occ_before} -> {occ_after}",
+        file=sys.stderr,
+        flush=True,
+    )
+
+    # steady-state timing (best of 3 batches)
+    best = 0.0
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        t0 = time.perf_counter()
+        state, m = sd.delta_run(state, net, sub, params, ticks)
+        _ = int(m["pings_sent"])
+        dt = time.perf_counter() - t0
+        best = max(best, ticks * n / dt)
+        print(f"# n={n}: {best:.0f} node-rounds/s", file=sys.stderr, flush=True)
+
+    return {
+        "metric": f"delta_scale_node_rounds_per_sec_n{n}",
+        "value": round(best, 1),
+        "unit": "node-rounds/s",
+        "vs_baseline": round(best / (REFERENCE_ROUNDS_PER_NODE_SEC * n), 2),
+        "occupancy_after_rebase": occ_after,
+        "overflow_drops": int(m["overflow_drops"]),
+        "converged_on_kill": converged_on_kill,
+    }
+
+
+def main() -> None:
+    sizes = (
+        [int(s) for s in sys.argv[1].split(",")]
+        if len(sys.argv) > 1
+        else [262144, 1048576]
+    )
+    ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    for n in sizes:
+        print(json.dumps(run_size(n, ticks)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

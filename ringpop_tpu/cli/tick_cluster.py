@@ -529,9 +529,10 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                         help="tpu-sim: cap changes per message (sparse "
                              "dissemination fast path; 0 = dense)")
     parser.add_argument("--probe", choices=["uniform", "sweep"],
-                        default="uniform",
+                        default="sweep",
                         help="tpu-sim: probe-target policy (sweep = "
-                             "round-robin per-round coverage guarantee)")
+                             "round-robin per-round coverage guarantee, "
+                             "the SwimParams default)")
     parser.add_argument("--layout", choices=["dense", "delta"],
                         default="dense",
                         help="tpu-sim state layout: dense N x N views, or "

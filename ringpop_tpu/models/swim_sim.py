@@ -55,8 +55,11 @@ Semantics parity map (reference file:line -> here):
   the viewer gossips (held at 0) — a SIGSTOPped node's timers fire on
   resume, like real setTimeouts (tick-cluster.js:432-446).
 * membership-iterator.js            -> probe-target selection; the reference
-  uses a reshuffled round-robin, the simulation samples uniformly among
-  pingable members (distributionally equivalent; documented deviation).
+  uses a reshuffled round-robin; the simulation's default ``probe="sweep"``
+  is a deterministic staggered rotation preserving the iterator's
+  probe-every-member-per-round guarantee (``probe="uniform"`` samples
+  uniformly instead — marginally equivalent, but with a
+  coupon-collector detection tail).
 
 Time model: one call to ``swim_step`` == one protocol period
 (gossip.js:127-129, 200 ms) for every node at once.  Wall-clock timeouts
@@ -79,10 +82,11 @@ defined order):
   change mid-period; one-tick lag, convergence-neutral).
 * The ping-req path probes reachability only; its piggyback exchange is
   omitted.  Measured deviation bound (benchmarks/bench_pingreq_deviation.py,
-  8-node kill-detection latency vs the host library, which implements the
-  full exchange): sim/host mean 0.96 at 1% loss, 0.91 at 5% loss — the
-  tick model compresses ping+ping-req into one period, more than
-  offsetting the omitted piggyback.
+  kill-detection latency vs the host library, which implements the full
+  exchange): sim/host mean 0.99 at 1% loss / 0.95 at 5% loss at n=256
+  (0.96 / 0.91 at n=8) — dissemination is dominated by the regular ping
+  piggyback, and the tick model compresses ping+ping-req into one
+  period, offsetting the omitted witness-side exchange.
 
 Incarnation numbers are stored as non-negative int32 offsets from a
 host-side base (``SimCluster`` keeps the absolute int ms base) so all
@@ -138,15 +142,16 @@ class SwimParams(NamedTuple):
     # ship on later pings.  Full syncs always take the exact dense reply
     # path via lax.cond.
     sparse_cap: int = 0
-    # Probe-target policy.  "uniform": sample among pingable members
-    # (default; distributionally matches the reference's reshuffled
-    # round-robin marginally).  "sweep": deterministic rotation
+    # Probe-target policy.  "sweep" (default): deterministic rotation
     # ``(start_i + tick) mod n`` with a uniform fallback when the swept
     # slot is not pingable — restores the reference iterator's guarantee
     # that every stable member is probed once per n-tick round
     # (membership-iterator.js:33-40), bounding worst-case detection
-    # latency without the coupon-collector tail.
-    probe: str = "uniform"
+    # latency without the coupon-collector tail.  "uniform": sample
+    # among pingable members (marginally matches the reference's
+    # reshuffled round-robin, but a member can go unprobed for many
+    # rounds — the coupon-collector tail the reference iterator avoids).
+    probe: str = "sweep"
 
 
 class ClusterState(NamedTuple):

@@ -396,7 +396,6 @@ def test_sparsify_densify_roundtrip():
     for _ in range(15):
         key, sub = jax.random.split(key)
         dense, _ = _dense_step(dense, net, sub, params)
-    base = jnp.zeros((n,), jnp.int32) * 8 + sim.ALIVE
     base = jnp.full((n,), sim.ALIVE, jnp.int32)
     delta = sd.sparsify(dense, base, capacity=n)
     dd = sd.densify(delta)
