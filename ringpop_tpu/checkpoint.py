@@ -28,9 +28,13 @@ import numpy as np
 
 from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_delta import DeltaState
 from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 
-FORMAT_VERSION = 2  # v2: packed view_key/pb/suspect_left state layout
+# v2: packed view_key/pb/suspect_left state layout
+# v3: + delta backend (DeltaState leaves, resource caps in meta)
+FORMAT_VERSION = 3
+_READABLE_VERSIONS = (2, 3)
 
 
 def save(cluster: SimCluster, path: str) -> None:
@@ -40,6 +44,14 @@ def save(cluster: SimCluster, path: str) -> None:
         "params": cluster.params._asdict(),
         "base_inc": cluster.base_inc,
         "n": cluster.n,
+        "backend": cluster.backend,
+        "caps": {
+            "capacity": (
+                cluster.state.capacity if cluster.backend == "delta" else 0
+            ),
+            "wire_cap": cluster.dparams.wire_cap,
+            "claim_grid": cluster.dparams.claim_grid,
+        },
     }
     arrays: dict[str, np.ndarray] = {
         "meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
@@ -64,15 +76,33 @@ def load(path: str, device: Any | None = None) -> SimCluster:
     """Reconstruct a ``SimCluster`` that continues the run exactly."""
     with np.load(path, allow_pickle=False) as data:
         meta = json.loads(bytes(data["meta"]).decode())
-        if meta["version"] != FORMAT_VERSION:
+        if meta["version"] not in _READABLE_VERSIONS:
             raise ValueError(f"unsupported checkpoint version {meta['version']}")
-        params = SwimParams(**meta["params"])
+        param_dict = dict(meta["params"])
+        if meta["version"] == 2:
+            # fields added after v2 must resume with the defaults that
+            # were in force when the checkpoint ran, not today's (the
+            # probe default flipped uniform -> sweep in round 3; letting
+            # it float would silently change the resumed trajectory)
+            param_dict.setdefault("probe", "uniform")
+        params = SwimParams(**param_dict)
         addresses = [str(a) for a in data["addresses"]]
+        backend = meta.get("backend", "dense")  # v2 checkpoints are dense
+        caps = meta.get("caps", {})
+        kw = {}
+        if backend == "delta":
+            kw = {
+                "capacity": caps["capacity"],
+                "wire_cap": caps["wire_cap"],
+                "claim_grid": caps["claim_grid"],
+            }
         cluster = SimCluster(
             meta["n"],
             params,
             addresses=addresses,
             base_inc=meta["base_inc"],
+            backend=backend,
+            **kw,
         )
         # Optional (None-default) fields may be absent from the archive —
         # derived from the NamedTuple defaults so save/load stay in lockstep.
@@ -93,7 +123,8 @@ def load(path: str, device: Any | None = None) -> SimCluster:
                     raise KeyError(f"checkpoint missing required array {key_name}")
             return cls(**leaves)
 
-        cluster.state = load_tuple(ClusterState, "state")
+        state_cls = DeltaState if backend == "delta" else ClusterState
+        cluster.state = load_tuple(state_cls, "state")
         cluster.net = load_tuple(NetState, "net")
         cluster.key = jax.numpy.asarray(data["key"])
     if device is not None:

@@ -327,10 +327,15 @@ class SimCluster:
 
     def heal_partition(self) -> None:
         # Keep the pytree structure stable: a net that has carried an
-        # adjacency mask heals to an all-ones mask (a compiled
-        # sharded_step's in_shardings would otherwise mismatch on
-        # adj array -> None); a never-partitioned net stays adj=None.
-        if self.net.adj is not None:
+        # adjacency mask heals to an all-ones mask, a group-id vector to
+        # all-one-group (a compiled sharded_step's in_shardings would
+        # otherwise mismatch on adj array -> None); a never-partitioned
+        # net stays adj=None.
+        if self.net.adj is None:
+            return
+        if self.net.adj.ndim == 1:
+            self.net = self.net._replace(adj=jnp.zeros((self.n,), jnp.int32))
+        else:
             self.net = self.net._replace(
                 adj=jnp.ones((self.n, self.n), dtype=bool)
             )

@@ -128,6 +128,7 @@ class DeltaState(NamedTuple):
     base_key: jax.Array  # int32[N]
     bp_mask: jax.Array  # bool[N]  base-pingable (alive|suspect)
     bp_rank: jax.Array  # int32[N] exclusive prefix count of bp_mask
+    bp_list: jax.Array  # int32[N] base-pingable subjects ascending, n-padded
     d_subj: jax.Array  # int32[N, C]
     d_key: jax.Array  # int32[N, C]
     d_pb: jax.Array  # int8[N, C]
@@ -144,11 +145,16 @@ class DeltaState(NamedTuple):
         return self.d_subj.shape[1]
 
 
-def _base_rank_structs(base_key: jax.Array) -> tuple[jax.Array, jax.Array]:
+def _base_rank_structs(
+    base_key: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    n = base_key.shape[0]
     status = base_key & 7
     bp_mask = (status == ALIVE) | (status == SUSPECT)
     bp_rank = jnp.cumsum(bp_mask.astype(jnp.int32)) - bp_mask.astype(jnp.int32)
-    return bp_mask, bp_rank
+    ids = jnp.arange(n, dtype=jnp.int32)
+    bp_list = jnp.sort(jnp.where(bp_mask, ids, n))
+    return bp_mask, bp_rank, bp_list
 
 
 def init_delta(
@@ -167,12 +173,13 @@ def init_delta(
     inc = jnp.asarray(inc, dtype=jnp.int32)
     _check_inc(inc)
     base_key = inc * 8 + ALIVE
-    bp_mask, bp_rank = _base_rank_structs(base_key)
+    bp_mask, bp_rank, bp_list = _base_rank_structs(base_key)
     c = capacity
     return DeltaState(
         base_key=base_key,
         bp_mask=bp_mask,
         bp_rank=bp_rank,
+        bp_list=bp_list,
         d_subj=jnp.full((n, c), SENTINEL, dtype=jnp.int32),
         d_key=jnp.zeros((n, c), dtype=jnp.int32),
         d_pb=jnp.full((n, c), -1, dtype=jnp.int8),
@@ -270,11 +277,12 @@ def sparsify(
         d_key[i, : len(js)] = vk[i, js]
         d_pb[i, : len(js)] = pb[i, js]
         d_sl[i, : len(js)] = sl[i, js]
-    bp_mask, bp_rank = _base_rank_structs(jnp.asarray(base))
+    bp_mask, bp_rank, bp_list = _base_rank_structs(jnp.asarray(base))
     return DeltaState(
         base_key=jnp.asarray(base),
         bp_mask=bp_mask,
         bp_rank=bp_rank,
+        bp_list=bp_list,
         d_subj=jnp.asarray(d_subj),
         d_key=jnp.asarray(d_key),
         d_pb=jnp.asarray(d_pb),
@@ -445,9 +453,6 @@ def _selection(
         su_ok, state.bp_rank[jnp.clip(su, 0, n - 1)] + (cpd - dd), big
     )
 
-    # global base-pingable subject list, ascending, n-padded
-    bp_list = jnp.sort(jnp.where(state.bp_mask, ids, n))
-
     ranks, valid = _distinct_ranks(stats.ping_count, k + 1, k_sel)
     r_clip = jnp.clip(
         ranks, 0, jnp.maximum(stats.ping_count - 1, 0)[:, None]
@@ -463,7 +468,7 @@ def _selection(
     )
     added_answer = in_corr & (d_at == 1) & (F_at == r_clip)
     rprime = jnp.clip(r_clip - cpd_at, 0, n - 1)
-    picks = jnp.where(added_answer, su_at, bp_list[rprime])  # [N, k+1]
+    picks = jnp.where(added_answer, su_at, state.bp_list[rprime])  # [N, k+1]
 
     target = jnp.where(valid[:, 0], picks[:, 0], -1)
     has_target = valid[:, 0]
@@ -1218,11 +1223,12 @@ def rebase(state: DeltaState) -> DeltaState:
         d_subj < int(SENTINEL), np.take_along_axis(d_sl, order2, axis=1), -1
     )
 
-    bp_mask, bp_rank = _base_rank_structs(jnp.asarray(base))
+    bp_mask, bp_rank, bp_list = _base_rank_structs(jnp.asarray(base))
     return state._replace(
         base_key=jnp.asarray(base),
         bp_mask=bp_mask,
         bp_rank=bp_rank,
+        bp_list=bp_list,
         d_subj=jnp.asarray(d_subj),
         d_key=jnp.asarray(d_key),
         d_pb=jnp.asarray(d_pb),

@@ -195,14 +195,16 @@ class NetState(NamedTuple):
     ``up``: process exists (kill -> False).  ``responsive``: process
     scheduled (SIGSTOP analog -> False; state is retained, the node just
     neither probes nor answers — tick-cluster.js:432-446).  ``adj``:
-    directed connectivity; partitions are block masks.  ``adj=None``
-    means fully connected — the healthy-network case never ships an
-    all-ones N x N mask through HBM (1 GB at 32k nodes).
+    directed connectivity — a full bool[N, N] mask (arbitrary
+    topologies) or an int32[N] group-id vector (connected iff same
+    group: the memory-free form for block netsplits, see ``_adj``).
+    ``adj=None`` means fully connected — the healthy-network case never
+    ships an all-ones N x N mask through HBM (1 GB at 32k nodes).
     """
 
     up: jax.Array  # bool[N]
     responsive: jax.Array  # bool[N]
-    adj: jax.Array | None = None  # bool[N, N] or None (fully connected)
+    adj: jax.Array | None = None  # bool[N, N] | int32[N] gid | None
 
 
 def make_net(n: int, *, partitioned: bool = False) -> NetState:
@@ -216,9 +218,18 @@ def make_net(n: int, *, partitioned: bool = False) -> NetState:
 
 
 def _adj(net: NetState, rows, cols) -> jax.Array | bool:
-    """Connectivity lookup that treats ``adj=None`` as all-connected."""
+    """Connectivity lookup that treats ``adj=None`` as all-connected.
+
+    ``adj`` may be the full bool[N, N] mask (arbitrary topologies) or a
+    1-D int32[N] *group id* vector — connected iff same group.  The
+    kernels only ever evaluate connectivity at [N]- or [N, k]-shaped
+    gathered index pairs, so a block partition (the netsplit case,
+    BASELINE config 4) never needs the N x N mask materialized: 4 GB
+    saved at n=32k, 17 GB at 65k."""
     if net.adj is None:
         return True
+    if net.adj.ndim == 1:
+        return net.adj[rows] == net.adj[cols]
     return net.adj[rows, cols]
 
 

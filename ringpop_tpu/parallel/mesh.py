@@ -78,11 +78,13 @@ def net_sharding(mesh: Mesh, like: NetState | None = None) -> NetState:
     net carries a materialized adjacency mask."""
     rep = NamedSharding(mesh, P())
     has_adj = like is not None and like.adj is not None
-    return NetState(
-        up=rep,
-        responsive=rep,
-        adj=NamedSharding(mesh, P(AXIS, None)) if has_adj else None,
-    )
+    if not has_adj:
+        adj = None
+    elif like.adj.ndim == 1:  # group-id vector: O(N), replicate
+        adj = rep
+    else:
+        adj = NamedSharding(mesh, P(AXIS, None))
+    return NetState(up=rep, responsive=rep, adj=adj)
 
 
 def shard_cluster(

@@ -56,3 +56,32 @@ def test_checkpoint_then_fault_injection(tmp_path):
     live = resumed.live_indices()
     status = np.asarray(resumed.state.view_status[:, 1])
     assert (status[live] == sim.FAULTY).all()
+
+
+def test_delta_backend_roundtrip_and_resume(tmp_path):
+    """v3 checkpoints carry the delta backend: DeltaState leaves plus
+    the resource caps, and resume stays bit-deterministic."""
+    n = 16
+    cluster = SimCluster(
+        n, sim.SwimParams(loss=0.05), seed=7, backend="delta",
+        capacity=n, wire_cap=n, claim_grid=2 * n,
+    )
+    cluster.kill(3)
+    cluster.tick(5)
+    path = str(tmp_path / "delta.npz")
+    checkpoint.save(cluster, path)
+
+    cluster.tick(6)
+    resumed = checkpoint.load(path)
+    assert resumed.backend == "delta"
+    assert resumed.state.capacity == n
+    assert resumed.dparams.wire_cap == n
+    resumed.tick(6)  # the kill is part of the checkpointed net
+
+    for name in ("base_key", "d_subj", "d_key", "d_pb", "d_sl"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(cluster.state, name)),
+            np.asarray(getattr(resumed.state, name)),
+            err_msg=name,
+        )
+    assert cluster.checksums() == resumed.checksums()

@@ -238,3 +238,36 @@ def test_sim_damping_checkpoint_roundtrip(tmp_path):
     assert np.array_equal(np.asarray(c.state.damp), np.asarray(r.state.damp))
     r.tick(2); c.tick(2)
     assert np.array_equal(np.asarray(c.state.damped), np.asarray(r.state.damped))
+
+
+def test_gid_partition_matches_mask_partition():
+    """The int32[N] group-id adjacency form must produce the exact mask
+    trajectory for block partitions (swim_sim._adj) — it exists so a
+    65k netsplit never materializes the 17 GB N x N mask."""
+    import jax
+
+    n = 12
+    half = n // 2
+    params = sim.SwimParams(loss=0.02, suspicion_ticks=4)
+    ids = np.arange(n)
+    mask = jnp.asarray((ids[:, None] < half) == (ids[None, :] < half))
+    gid = (jnp.arange(n, dtype=jnp.int32) >= half).astype(jnp.int32)
+    ones = jnp.ones((n,), bool)
+    net_m = sim.NetState(up=ones, responsive=ones, adj=mask)
+    net_g = sim.NetState(up=ones, responsive=ones, adj=gid)
+    st_m = sim.init_state(n)
+    st_g = sim.init_state(n)
+    key = jax.random.PRNGKey(3)
+    keys = jax.random.split(key, 40)
+    for t in range(40):
+        if t == 20:  # heal, keeping each net's pytree structure
+            net_m = net_m._replace(adj=jnp.ones((n, n), bool))
+            net_g = net_g._replace(adj=jnp.zeros((n,), jnp.int32))
+        st_m, _ = sim.swim_step(st_m, net_m, keys[t], params)
+        st_g, _ = sim.swim_step(st_g, net_g, keys[t], params)
+        np.testing.assert_array_equal(
+            np.asarray(st_m.view_key), np.asarray(st_g.view_key), err_msg=f"tick {t}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st_m.suspect_left), np.asarray(st_g.suspect_left)
+        )
