@@ -260,6 +260,14 @@ def _probe_tpu() -> str | None:
     return f"accelerator probe failed (rc={rc}): {tail[0][:300]}"
 
 
+def _echo_child_stderr(err: str | None) -> None:
+    """Surface the measuring child's diagnostics (occupancy, on-chip
+    kernel checks, per-rep rates) in the parent's stderr, uniformly
+    "# "-prefixed like every other bench.py diagnostic."""
+    for line in (err or "").strip().splitlines():
+        print(line if line.startswith("#") else f"# {line}", file=sys.stderr, flush=True)
+
+
 def _extract_json(stdout: str) -> dict | None:
     for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
@@ -286,6 +294,7 @@ def main() -> None:
             )
             result = _extract_json(out)
             if rc == 0 and result is not None:
+                _echo_child_stderr(err)
                 print(json.dumps(result), flush=True)
                 return
             reason = (
@@ -316,6 +325,7 @@ def main() -> None:
     )
     result = _extract_json(out)
     if rc == 0 and result is not None:
+        _echo_child_stderr(err)
         result["platform"] = "cpu-fallback"
         result["error"] = "; ".join(errors)
         print(json.dumps(result), flush=True)

@@ -8,10 +8,17 @@ not an exact decomposition.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # env var alone still lets the ambient TPU plugin contact a possibly
+    # hung tunnel on backend init; pin at the config level (see bench.py)
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from ringpop_tpu.models import swim_delta as sd
@@ -128,6 +135,35 @@ def main():
         jax.jit(sd.view_lookup),
         state, jnp.arange(n, dtype=jnp.int32),
     )
+
+    # phase bisect: each prefix of the step compiles as ONE executable
+    # (delta_step_impl's static ``upto``), so consecutive differences
+    # attribute genuine device time per phase with no dispatch noise —
+    # the sub-function timings above can't separate launch overhead
+    # from compute on the tunneled platform.
+    print("-- phase bisect (upto=k: step truncated after phase k) --")
+    key2 = jax.random.PRNGKey(7)
+    prev = 0.0
+    names = {
+        0: "stats+digest", 1: "selection", 2: "send window",
+        3: "ping merge", 4: "ack merge (+full sync)", 5: "ping-req",
+        7: "suspicion+metrics (full)",
+    }
+    for u in (0, 1, 2, 3, 4, 5, 7):
+        fn = jax.jit(
+            lambda st, nt, kk, u=u: sd.delta_step_impl(st, nt, kk, params, upto=u)
+        )
+        out = fn(state, net, key2)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = fn(state, net, key2)
+        leaves = jax.tree_util.tree_leaves(out)
+        _ = jax.device_get(leaves[0].ravel()[0])
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / 5 * 1e3
+        print(f"upto={u} ({names[u]:<24}) {dt:9.2f} ms  (+{dt - prev:8.2f})")
+        prev = dt
 
 
 if __name__ == "__main__":

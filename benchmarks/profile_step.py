@@ -10,10 +10,17 @@ cost is reported (sync overhead amortized to noise).
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # env var alone still lets the ambient TPU plugin contact a possibly
+    # hung tunnel on backend init; pin at the config level (see bench.py)
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 sys.path.insert(0, ".")

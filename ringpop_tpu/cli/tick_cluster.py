@@ -360,7 +360,7 @@ class TpuSimCluster(ClusterDriver):
 
     def __init__(self, size: int, seed: int = 1, loss: float = 0.0,
                  damping: bool = False, sparse_cap: int = 0,
-                 probe: str = "uniform", layout: str = "dense",
+                 probe: str = "sweep", layout: str = "dense",
                  capacity: int = 256):
         import jax
 

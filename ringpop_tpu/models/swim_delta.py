@@ -197,10 +197,22 @@ def init_delta(
 # fori loop of gathers — measured 12x slower on TPU (106 ms vs 8.8 ms
 # for [65536,256] tables x 16 queries/row); the branch-free compare+sum
 # streams at full vector width and XLA fuses the [N, K, C] compare into
-# the reduction (no materialized bool cube).
-_row_searchsorted = jax.vmap(
-    lambda a, v: jnp.searchsorted(a, v, side="left", method="compare_all")
-)
+# the reduction — but ONLY for narrow query sets.  Inside the full step
+# program the wide-query instances (K = 64-grid consumption, K = C
+# full-sync row lookups) materialize the [N, K, C] cube to HBM instead
+# of fusing it (StableHLO shows 65536x256x256 / 65536x256x272 /
+# 65536x64x256 intermediates; the compiled tick ran 20-100x slower
+# than its own primitives).  Past ``_WIDE_QUERY`` queries per row the
+# merge lowering (method="sort": one [R, C+K] row sort of the concat)
+# is strictly cheaper and cube-free.
+_WIDE_QUERY = 16
+
+
+def _row_searchsorted(a: jax.Array, v: jax.Array, side: str = "left") -> jax.Array:
+    method = "compare_all" if v.shape[-1] <= _WIDE_QUERY else "sort"
+    return jax.vmap(
+        lambda ar, vr: jnp.searchsorted(ar, vr, side=side, method=method)
+    )(a, v)
 
 
 def _lookup_pos(d_subj: jax.Array, q: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -385,9 +397,8 @@ def _compact_true(mask: jax.Array, width: int) -> jax.Array:
     return jnp.sort(jnp.where(mask, cols, SENTINEL), axis=1)[:, :width]
 
 
-_row_searchsorted_right = jax.vmap(
-    lambda a, v: jnp.searchsorted(a, v, side="right", method="compare_all")
-)
+def _row_searchsorted_right(a: jax.Array, v: jax.Array) -> jax.Array:
+    return _row_searchsorted(a, v, side="right")
 
 
 def _selection(
@@ -682,8 +693,14 @@ def _route_claims(
         (flat_recv, flat_subj, flat_key), num_keys=2
     )
 
-    starts = jnp.searchsorted(flat_recv, jnp.arange(n, dtype=jnp.int32), side="left")
-    ends = jnp.searchsorted(flat_recv, jnp.arange(n, dtype=jnp.int32), side="right")
+    # method="sort": the default "scan" lowers to a ~20-iteration serial
+    # while loop of gathers; the merge lowering is one flat sort.  For
+    # integer receivers, run i's end == run i+1's start, so one
+    # searchsorted over arange(n+1) yields both boundaries in one sort.
+    bounds = jnp.searchsorted(
+        flat_recv, jnp.arange(n + 1, dtype=jnp.int32), side="left", method="sort"
+    )
+    starts, ends = bounds[:-1], bounds[1:]
     counts = ends - starts
     total = flat_recv.shape[0]
     idx = jnp.minimum(starts[:, None] + jnp.arange(grid, dtype=jnp.int32)[None, :],
@@ -727,11 +744,24 @@ def _route_claims(
 
 
 def delta_step_impl(
-    state: DeltaState, net: NetState, key: jax.Array, params: DeltaParams
+    state: DeltaState, net: NetState, key: jax.Array, params: DeltaParams,
+    upto: int = 7,
 ) -> tuple[DeltaState, dict[str, jax.Array]]:
     """One synchronized protocol period — the dense ``swim_step_impl``
     phase for phase (see its docstring for the reference parity map),
-    over the delta representation."""
+    over the delta representation.
+
+    ``upto`` (static) truncates the step after the given phase — an
+    on-device profiling aid (benchmarks/profile_delta.py): each prefix
+    compiles as one executable, so consecutive differences attribute
+    genuine device time per phase with no dispatch noise.  7 = the full
+    step (production value; anything else returns partial metrics)."""
+
+    def cut(st, **extra):
+        m = {"pings_sent": jnp.zeros((), jnp.int32)}
+        m.update(extra)
+        return st, m
+
     if net.adj is not None:
         raise NotImplementedError(
             "delta backend models loss/kill/suspend; partition masks need "
@@ -750,9 +780,13 @@ def delta_step_impl(
     stats = _phase0_stats(state)
     maxpb = _max_piggyback_1d(stats.server_count, sw.piggyback_factor).astype(jnp.int8)
     h_pre = stats.digest
+    if upto <= 0:
+        return cut(state, _t=stats.digest.astype(jnp.int32) + maxpb.astype(jnp.int32))
     gossiping, sends, t_safe, wit, wit_valid = _selection(
         state, stats, net, k_sel, params
     )
+    if upto <= 1:
+        return cut(state, _t=t_safe + wit[:, 0] + stats.digest.astype(jnp.int32))
 
     # -- phase 2: sender issues up to W changes -----------------------------
     has_change = state.d_pb >= 0
@@ -774,6 +808,14 @@ def delta_step_impl(
         SENTINEL,
     )
     send_key = jnp.take_along_axis(state.d_key, sc_safe, axis=1)
+    if upto <= 2:
+        # anchor phase-1 outputs too: without t_safe/wit in the live set
+        # XLA DCEs the whole selection and the 2-vs-1 delta goes negative
+        return cut(
+            state,
+            _t=jnp.sum(send_key) + jnp.sum(send_subj)
+            + jnp.sum(t_safe) + jnp.sum(wit),
+        )
 
     # -- phase 3: delivery + receiver merge ---------------------------------
     resp = net.up & net.responsive
@@ -782,8 +824,10 @@ def delta_step_impl(
 
     # inbound ping count per receiver, scatter-free (sorted senders)
     tgt_sorted = jnp.sort(jnp.where(fwd_ok, t_safe, n))
-    starts = jnp.searchsorted(tgt_sorted, ids, side="left")
-    ends = jnp.searchsorted(tgt_sorted, ids, side="right")
+    bounds = jnp.searchsorted(
+        tgt_sorted, jnp.arange(n + 1, dtype=jnp.int32), side="left", method="sort"
+    )
+    starts, ends = bounds[:-1], bounds[1:]
     inbound = (ends - starts).astype(jnp.int32)
     got_ping = inbound > 0
 
@@ -802,6 +846,8 @@ def delta_step_impl(
     state, ping_applied, claims_dropped = jax.lax.cond(
         any_claims, ping_merge, ping_skip, state
     )
+    if upto <= 3:
+        return cut(state, _t=ping_applied)
 
     # -- phase 4: receiver replies; sender merges the ack -------------------
     # (post phase-3 state: reply content includes changes just applied)
@@ -914,6 +960,8 @@ def delta_step_impl(
         return st, jnp.int32(0)
 
     state, ack_applied = jax.lax.cond(any_ack_claims, ack_merge, ack_skip, state)
+    if upto <= 4:
+        return cut(state, _t=ack_applied)
 
     # -- phase 5: ping-req two-hop reachability -> suspect ------------------
     failed = sends & ~ack
@@ -949,6 +997,8 @@ def delta_step_impl(
         return out.state
 
     state = jax.lax.cond(any_dec, dec_merge, lambda st: st, state)
+    if upto <= 5:
+        return cut(state, _t=jnp.sum(dec_valid.astype(jnp.int32)))
 
     # -- phase 6: suspicion countdowns fire -> faulty -----------------------
     sl = state.d_sl
@@ -1014,7 +1064,7 @@ def _sort_claim_rows(
 
 
 delta_step = jax.jit(
-    delta_step_impl, static_argnames=("params",), donate_argnums=(0,)
+    delta_step_impl, static_argnames=("params", "upto"), donate_argnums=(0,)
 )
 
 
