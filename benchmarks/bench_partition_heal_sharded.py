@@ -33,8 +33,27 @@ def main(argv: list[str]) -> None:
     ticks_only = 0
     if "--ticks-only" in argv:
         ticks_only = int(argv[argv.index("--ticks-only") + 1])
+    # sparse dissemination (SwimParams.sparse_cap): the dense phase-3/4
+    # claim matrices are N x N int32 (17 GB each at 65k) and the step's
+    # transient footprint is ~14x the state (measured via peak RSS on the
+    # 8-device CPU mesh) — past ~32k the dense tick cannot fit a 125 GB
+    # host.  The capped claim lists keep the step's temporaries at
+    # O(N * cap), which is what makes the 65,536 existence run possible.
+    sparse_cap = 0
+    if "--sparse-cap" in argv:
+        sparse_cap = int(argv[argv.index("--sparse-cap") + 1])
 
     import os
+
+    # On the virtual CPU mesh the 8 device threads time-share the host
+    # cores; heavy ticks make some of them miss XLA's default 40 s
+    # collective rendezvous deadline, which *aborts the process* (fatal
+    # rendezvous.cc check).  Raise it before jax initializes.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "collective_call_terminate_timeout" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+        ).strip()
 
     import jax
 
@@ -53,7 +72,7 @@ def main(argv: list[str]) -> None:
     from ringpop_tpu import parallel
     from ringpop_tpu.models import swim_sim as sim
 
-    params = sim.SwimParams()
+    params = sim.SwimParams(sparse_cap=sparse_cap)
     mesh = parallel.make_mesh()
     d = len(mesh.devices.ravel())
 
@@ -121,6 +140,7 @@ def main(argv: list[str]) -> None:
                     "value": ticks_only,
                     "unit": "ticks_executed",
                     "faulty_pairs": faulty,
+                    "sparse_cap": sparse_cap,
                     "compiled_and_ran": True,
                 }
             )
@@ -155,6 +175,7 @@ def main(argv: list[str]) -> None:
                 "value": heal_ticks,
                 "unit": "ticks_to_remerge",
                 "split_ticks": split_ticks,
+                "sparse_cap": sparse_cap,
                 "converged": bool(same) and alive == n * n,
             }
         )

@@ -333,6 +333,36 @@ def _max_piggyback(status_ok: jax.Array, factor: int) -> jax.Array:
     return jnp.minimum(factor * digits, 126)
 
 
+# Row-length threshold for the memory-lean large-N lowerings: an int32
+# row prefix is an extra 6-byte-per-pair-class tensor (17 GB at
+# n=65536), which is what pushed the 65k sharded run past a 125 GB
+# host.  Tests lower this to exercise the block paths at small n.
+_SPARSE_SMALL_N = 32767
+_PREFIX_BLOCK = 64  # int8-safe inner prefix width (inner <= 64 < 127)
+
+
+def _block_prefix(mask: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Two-level row-prefix decomposition of a bool [N, M] mask.
+
+    Returns ``(mb, inner, offs)``: the mask False-padded to a multiple
+    of ``_PREFIX_BLOCK`` and reshaped to [N, nb, B]; the *inclusive*
+    int8 within-block prefix counts (<= B, int8-safe); and the int32
+    *exclusive* per-block offsets [N, nb].  The global inclusive prefix
+    of element (i, j) is ``offs[i, j // B] + inner[i, j // B, j % B]`` —
+    one int8 [N, M] tensor plus an [N, M/B] int32 instead of an int32
+    [N, M] cumsum.  Shared by every large-N lowering below; the int8
+    bound, False padding, and exclusive-offset convention are the
+    invariants their bit-parity contracts rest on."""
+    b = _PREFIX_BLOCK
+    pad = (-mask.shape[1]) % b
+    m = jnp.pad(mask, ((0, 0), (0, pad))) if pad else mask
+    mb = m.reshape(mask.shape[0], -1, b)
+    inner = jnp.cumsum(mb.astype(jnp.int8), axis=2)
+    block_tot = inner[:, :, -1].astype(jnp.int32)
+    offs = jnp.cumsum(block_tot, axis=1) - block_tot
+    return mb, inner, offs
+
+
 def _distinct_ranks(
     count: jax.Array, m: int, key: jax.Array
 ) -> tuple[jax.Array, jax.Array]:
@@ -392,17 +422,45 @@ def _choose_targets_and_witnesses(
     ties/argmax-bias questions — ranks are exact)."""
     n = pingable.shape[0]
     count = jnp.sum(pingable, axis=1, dtype=jnp.int32)
-    cdtype = jnp.int16 if n - 1 <= 32767 else jnp.int32
-    csum = jnp.cumsum(pingable.astype(cdtype), axis=1)
     ranks, valid = _distinct_ranks(count, k + 1, key)
-    picks = []
-    for t in range(k + 1):
-        want = (ranks[:, t] + 1).astype(cdtype)
-        hit = pingable & (csum == want[:, None])
-        picks.append(jnp.argmax(hit, axis=1).astype(jnp.int32))
-    target = jnp.where(valid[:, 0], picks[0], -1)
-    wit = jnp.stack(picks[1:], axis=1)
-    return target, valid[:, 0], wit, valid[:, 1:]
+    if n - 1 <= _SPARSE_SMALL_N:
+        csum = jnp.cumsum(pingable.astype(jnp.int16), axis=1)
+        picks = []
+        for t in range(k + 1):
+            want = (ranks[:, t] + 1).astype(jnp.int16)
+            hit = pingable & (csum == want[:, None])
+            picks.append(jnp.argmax(hit, axis=1).astype(jnp.int32))
+        target = jnp.where(valid[:, 0], picks[0], -1)
+        wit = jnp.stack(picks[1:], axis=1)
+        return target, valid[:, 0], wit, valid[:, 1:]
+    # Large rows: an int32 [N, N] cumsum is 17 GB at 65k.  Two-level
+    # rank lookup over the block-prefix decomposition instead (same
+    # picks bit for bit): block by offset binary search, column by
+    # within-block prefix binary search.
+    b = _PREFIX_BLOCK
+    _, inner, offs = _block_prefix(pingable)
+    want = ranks + 1  # int32 [N, k+1], 1-based inclusive target
+    blk = (
+        jax.vmap(lambda o, w: jnp.searchsorted(o, w, side="left"))(offs, want)
+        - 1
+    )
+    blk = jnp.clip(blk, 0, offs.shape[1] - 1)
+    residual = want - jnp.take_along_axis(offs, blk, axis=1)  # 1..64
+    # gather the int8 blocks FIRST, widen the [N, k+1, b] slice after —
+    # widening ``inner`` itself is an int32 [N, nb, 64] copy (17 GB)
+    inner_blk = jnp.take_along_axis(
+        inner, blk[:, :, None], axis=1
+    ).astype(jnp.int32)  # [N, k+1, b]
+    within = jax.vmap(
+        lambda rows_i, res_i: jax.vmap(
+            lambda r, q: jnp.searchsorted(r, q, side="left", method="compare_all")
+        )(rows_i, res_i)
+    )(inner_blk, residual)
+    # invalid ranks (masked by ``valid``) would index past the row; the
+    # small-n argmax yields 0 there — clamp for in-bounds gathers only
+    picks_all = jnp.minimum((blk * b + within).astype(jnp.int32), n - 1)
+    target = jnp.where(valid[:, 0], picks_all[:, 0], -1)
+    return target, valid[:, 0], picks_all[:, 1:], valid[:, 1:]
 
 
 def _drop(key: jax.Array, shape: tuple, loss: float) -> jax.Array:
@@ -846,21 +904,67 @@ def swim_step_impl(
 # ---------------------------------------------------------------------------
 
 
+def _capped_within(mask: jax.Array, cap: jax.Array | int) -> jax.Array:
+    """``mask & (row-prefix-count(mask) <= cap)`` — the first ``cap``
+    True entries per row — without materializing an int32 [N, N] prefix.
+
+    Small rows: plain int16 cumsum.  Large rows: the block-prefix
+    decomposition (``_block_prefix``); the compare stays int8 via a
+    per-block threshold instead of widening ``inner`` (an int32
+    [N, nb, 64] copy is 17 GB at n=65536 even as a temporary).
+    """
+    n = mask.shape[1]
+    if n <= _SPARSE_SMALL_N:
+        return mask & (jnp.cumsum(mask.astype(jnp.int16), axis=1) <= cap)
+    mb, inner, offs = _block_prefix(mask)
+    # inner >= 1 at every True position, so a clip floor of -1 makes
+    # exhausted blocks compare False; ceiling 127 = "all fit".
+    thr = jnp.clip(cap - offs, -1, 127).astype(jnp.int8)
+    within = mb & (inner <= thr[:, :, None])
+    within = within.reshape(mask.shape[0], -1)
+    return within[:, :n]
+
+
 def _compact_rows(mask: jax.Array, cap: int) -> jax.Array:
     """Column indices of the first ``cap`` True entries per row, -1 padded.
 
-    int32[N, cap]; the cumsum stays int16 when the row length allows.
-    """
+    int32[N, cap].  Small rows: int16 prefix + one scatter.  Large rows:
+    ``lax.scan`` over the ``_block_prefix`` blocks scattering into the
+    output — per-iteration temporaries are [N, 64], so no [N, N] int32
+    position tensor ever materializes (the scan is sequential, but the
+    sparse large-N path is memory-bound, not compute-bound)."""
     n = mask.shape[1]
-    cdtype = jnp.int16 if n <= 32767 else jnp.int32
-    cidx = jnp.cumsum(mask.astype(cdtype), axis=1)
-    pos = jnp.where(mask & (cidx <= cap), (cidx - 1).astype(jnp.int32), cap)
-    cols = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], mask.shape)
-    rows = jnp.broadcast_to(
-        jnp.arange(mask.shape[0], dtype=jnp.int32)[:, None], mask.shape
+    rows = jnp.arange(mask.shape[0], dtype=jnp.int32)[:, None]
+    if n <= _SPARSE_SMALL_N:
+        cidx = jnp.cumsum(mask.astype(jnp.int16), axis=1)
+        pos = jnp.where(mask & (cidx <= cap), (cidx - 1).astype(jnp.int32), cap)
+        cols = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], mask.shape)
+        out = jnp.full((mask.shape[0], cap), -1, dtype=jnp.int32)
+        return out.at[jnp.broadcast_to(rows, mask.shape), pos].set(cols, mode="drop")
+    b = _PREFIX_BLOCK
+    mb, inner, offs = _block_prefix(mask)
+    xs = (
+        jnp.moveaxis(mb, 1, 0),  # bool[nb, N, b]
+        jnp.moveaxis(inner, 1, 0),  # int8[nb, N, b]
+        offs.T,  # int32[nb, N]
+        jnp.arange(mb.shape[1], dtype=jnp.int32) * b,  # block base column
     )
-    out = jnp.full((mask.shape[0], cap), -1, dtype=jnp.int32)
-    return out.at[rows, pos].set(cols, mode="drop")
+
+    def body(out, xs_i):
+        blk, inner_b, offs_b, c0 = xs_i
+        pos = jnp.where(blk, offs_b[:, None] + inner_b.astype(jnp.int32) - 1, cap)
+        pos = jnp.minimum(pos, cap)  # mode="drop" guard stays exact
+        cols = c0 + jnp.broadcast_to(
+            jnp.arange(b, dtype=jnp.int32)[None, :], blk.shape
+        )
+        out = out.at[jnp.broadcast_to(rows, blk.shape), pos].set(
+            cols, mode="drop"
+        )
+        return out, None
+
+    out0 = jnp.full((mask.shape[0], cap), -1, dtype=jnp.int32)
+    out, _ = jax.lax.scan(body, out0, xs)
+    return out
 
 
 def _point_merge(
@@ -954,8 +1058,7 @@ def _swim_step_sparse(
     bump = has_change & sends[:, None]
     pb1 = jnp.where(bump, state.pb + jnp.int8(1), state.pb)
     issue_ok = bump & (pb1 <= maxpb8)
-    cdtype = jnp.int16 if n <= 32767 else jnp.int32
-    within = issue_ok & (jnp.cumsum(issue_ok.astype(cdtype), axis=1) <= cap)
+    within = _capped_within(issue_ok, cap)
     overflow_send = issue_ok & ~within
     bump_eff = bump & ~overflow_send
     pb_next = jnp.where(bump_eff, state.pb + jnp.int8(1), state.pb)
@@ -1001,9 +1104,7 @@ def _swim_step_sparse(
     rep_issuable = (
         has_change2 & got_ping[:, None] & (state.pb + jnp.int8(1) <= maxpb8)
     )
-    within_rep = rep_issuable & (
-        jnp.cumsum(rep_issuable.astype(cdtype), axis=1) <= cap
-    )
+    within_rep = _capped_within(rep_issuable, cap)
     overflow_rep = rep_issuable & ~within_rep
     inb8 = jnp.minimum(inbound, 127).astype(jnp.int8)[:, None]
     served = got_ping[:, None] & has_change2 & ~overflow_rep

@@ -168,3 +168,76 @@ def test_sweep_probe_rejects_unknown_policy():
             jax.random.PRNGKey(0),
             sim.SwimParams(probe="banana"),
         )
+
+
+# -- large-N memory-lean lowerings (forced small via _SPARSE_SMALL_N) -------
+
+
+def _mask_fixture(key, rows=13, cols=200, p=0.3):
+    return jax.random.uniform(jax.random.PRNGKey(key), (rows, cols)) < p
+
+
+@pytest.mark.parametrize("cap", [1, 4, 64])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_capped_within_large_path_matches_small(monkeypatch, cap, seed):
+    mask = _mask_fixture(seed)
+    want = np.asarray(sim._capped_within(mask, cap))
+    monkeypatch.setattr(sim, "_SPARSE_SMALL_N", 1)
+    got = np.asarray(sim._capped_within(mask, cap))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("cap", [1, 4, 64])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_compact_rows_large_path_matches_small(monkeypatch, cap, seed):
+    mask = _mask_fixture(seed)
+    want = np.asarray(sim._compact_rows(mask, cap))
+    monkeypatch.setattr(sim, "_SPARSE_SMALL_N", 1)
+    got = np.asarray(sim._compact_rows(mask, cap))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_choose_targets_large_path_matches_small(monkeypatch):
+    """The two-level rank lookup must pick the same targets/witnesses
+    bit for bit as the int16-cumsum path (valid picks only; invalid
+    picks are masked by the valid flags)."""
+    pingable = np.asarray(_mask_fixture(3, rows=50, cols=50, p=0.4)).copy()
+    np.fill_diagonal(pingable, False)
+    key = jax.random.PRNGKey(9)
+    t0, v0, w0, wv0 = (
+        np.asarray(x)
+        for x in sim._choose_targets_and_witnesses(jnp.asarray(pingable), 3, key)
+    )
+    monkeypatch.setattr(sim, "_SPARSE_SMALL_N", 1)
+    t1, v1, w1, wv1 = (
+        np.asarray(x)
+        for x in sim._choose_targets_and_witnesses(jnp.asarray(pingable), 3, key)
+    )
+    np.testing.assert_array_equal(v0, v1)
+    np.testing.assert_array_equal(wv0, wv1)
+    np.testing.assert_array_equal(t0[v0], t1[v0])
+    np.testing.assert_array_equal(w0[wv0], w1[wv0])
+
+
+def test_sparse_step_bitparity_on_large_path(monkeypatch):
+    """A short sparse trajectory through a kill, with the large-N
+    lowerings forced on: bit-identical to the small-N lowerings."""
+    n = 24
+    params = sim.SwimParams(loss=0.0, sparse_cap=8, suspicion_ticks=3)
+    net = sim.make_net(n)
+    net = net._replace(up=net.up.at[5].set(False))
+    keys = jax.random.split(jax.random.PRNGKey(2), 10)
+
+    def run():
+        state = sim.init_state(n)
+        out = []
+        for k in keys:
+            state, _ = sim.swim_step_impl(state, net, k, params)
+            out.append(state)
+        return out
+
+    ref = run()
+    monkeypatch.setattr(sim, "_SPARSE_SMALL_N", 1)
+    got = run()
+    for t, (a, b) in enumerate(zip(ref, got)):
+        assert_states_equal(a, b, t)
