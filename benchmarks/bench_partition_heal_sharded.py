@@ -14,9 +14,14 @@ collectives must compile, execute, and *converge* at large N — perf
 stays a single-chip metric (bench.py).
 
     python benchmarks/bench_partition_heal_sharded.py [n] [--ticks-only T]
+                                                      [--sparse-cap C]
 
 ``--ticks-only`` runs T ticks of the split phase and exits (existence
 proof for sizes whose full heal exceeds the host's RAM/time budget).
+``--sparse-cap`` switches to the sparse dissemination path — required
+past ~32k on a 125 GB host (the recorded 65,536 run used
+``--ticks-only 2 --sparse-cap 64``; dense [N, N] int32 claim matrices
+alone would need ~370 GB there, see BASELINE.md).
 """
 
 from __future__ import annotations

@@ -202,10 +202,12 @@ def init_delta(
 # full-sync row lookups) materialize the [N, K, C] cube to HBM instead
 # of fusing it (StableHLO shows 65536x256x256 / 65536x256x272 /
 # 65536x64x256 intermediates; the compiled tick ran 20-100x slower
-# than its own primitives).  Past ``_WIDE_QUERY`` queries per row the
-# merge lowering (method="sort": one [R, C+K] row sort of the concat)
-# is strictly cheaper and cube-free.
-_WIDE_QUERY = 16
+# than its own primitives — the [N,16]x[N,256] instance measured 723 ms
+# in-program vs 8.8 ms standalone).  Past ``_WIDE_QUERY`` queries per
+# row the merge lowering (method="sort": one [R, C+K] row sort of the
+# concat) is strictly cheaper and cube-free; only the k+1 selection
+# probes stay on the fused compare.
+_WIDE_QUERY = 4
 
 
 def _row_searchsorted(a: jax.Array, v: jax.Array, side: str = "left") -> jax.Array:
