@@ -24,11 +24,15 @@ TPU-first design rules (learned from measuring the alternatives):
   than the dense N^2 sweep it was meant to avoid).  Every update here
   is an elementwise pass over the [N, C] tables; every data movement is
   a sort, a (vmapped) ``searchsorted``, or a row gather — all fast.
-* **searchsorted must be ``method="compare_all"``.**  The default
-  "scan" method lowers to a serial fori loop of gathers: measured 12x
-  slower on a v5e at [65536, 256] tables (106 ms vs 8.8 ms for 16
-  queries/row).  Same for ``jnp.sort`` over rows (~8 ms at [65536,
-  256]) — cheap enough to be the universal compaction primitive.
+* **searchsorted must never use the default ``method="scan"``** — it
+  lowers to a serial fori loop of gathers (measured 12x slower on a
+  v5e at [65536, 256] tables).  Narrow query sets (<= ``_WIDE_QUERY``
+  per row) use ``compare_all`` (fused compare+sum); anything wider
+  uses the merge lowering ``method="sort"``, because inside the full
+  step program XLA materializes the wide [N, K, C] compare cubes to
+  HBM instead of fusing them (see ``_row_searchsorted``).  ``jnp.sort``
+  over rows is ~8 ms at [65536, 256] — cheap enough to be the
+  universal compaction primitive.
 * **Claim routing by sort, alignment by searchsorted+gather.**  Pings
   carry compact ``(subject, key)`` change lists; the per-tick claim
   traffic is a flat [N * W] record array sorted by (receiver, subject)

@@ -7,18 +7,26 @@ cross-chip exchanges on ICI — see ``ringpop_tpu.parallel.mesh``.
 """
 
 from ringpop_tpu.parallel.mesh import (
+    delta_state_sharding,
     make_mesh,
     net_sharding,
     shard_cluster,
+    shard_delta,
+    sharded_delta_run,
+    sharded_delta_step,
     sharded_step,
     sharded_run,
     state_sharding,
 )
 
 __all__ = [
+    "delta_state_sharding",
     "make_mesh",
     "net_sharding",
     "shard_cluster",
+    "shard_delta",
+    "sharded_delta_run",
+    "sharded_delta_step",
     "sharded_step",
     "sharded_run",
     "state_sharding",

@@ -33,6 +33,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ringpop_tpu.models.swim_delta import (
+    DeltaState,
+    delta_run_impl,
+    delta_step_impl,
+)
 from ringpop_tpu.models.swim_sim import (
     ClusterState,
     NetState,
@@ -150,5 +155,88 @@ def sharded_run(
             rep,
         ),
         out_shardings=(state_sharding(mesh, damping), rep),
+        donate_argnums=(0,),
+    )
+
+
+# ---------------------------------------------------------------------------
+# delta backend (models/swim_delta.py): O(N * C) tables, same row ownership
+# ---------------------------------------------------------------------------
+
+
+def delta_state_sharding(mesh: Mesh) -> DeltaState:
+    """Shardings for ``DeltaState``: the [N, C] divergence tables are
+    viewer-row sharded like the dense views; the shared base and its
+    O(N) rank structures are replicated — every viewer's selection and
+    merge reads them at arbitrary subject indices, and they change only
+    via init/compact/rebase, not inside the step."""
+    row = NamedSharding(mesh, P(AXIS, None))
+    rep = NamedSharding(mesh, P())
+    return DeltaState(
+        base_key=rep,
+        bp_mask=rep,
+        bp_rank=rep,
+        bp_list=rep,
+        d_subj=row,
+        d_key=row,
+        d_pb=row,
+        d_sl=row,
+        tick=rep,
+        overflow_drops=rep,
+    )
+
+
+def shard_delta(state: DeltaState, mesh: Mesh) -> DeltaState:
+    """Place an (unsharded) delta state onto the mesh."""
+    n, d = state.n, mesh.devices.size
+    if n % d != 0:
+        raise ValueError(f"n={n} must be divisible by mesh size {d}")
+    return jax.device_put(state, delta_state_sharding(mesh))
+
+
+def _delta_net_sharding(mesh: Mesh, net_like: NetState | None) -> NetState:
+    """Net shardings for the delta kernels.  The delta backend models
+    loss/kill/suspend only — surface its clear NotImplementedError for
+    adjacency-carrying nets here, instead of the opaque jit
+    pytree/sharding mismatch the caller would otherwise hit."""
+    if net_like is not None and net_like.adj is not None:
+        raise NotImplementedError(
+            "delta backend models loss/kill/suspend; partition masks need "
+            "the dense backend (a netsplit diverges densely by construction)"
+        )
+    return net_sharding(mesh)
+
+
+def sharded_delta_step(mesh: Mesh, net_like: NetState | None = None) -> Callable:
+    """``delta_step`` compiled for the mesh.  The cross-chip traffic is
+    the claim routing: the flat (receiver, subject) sort and the
+    per-receiver gathers lower to collectives over the row shards —
+    the delta analog of the dense scatter-into-foreign-rows."""
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        delta_step_impl,
+        static_argnames=("params", "upto"),
+        in_shardings=(
+            delta_state_sharding(mesh),
+            _delta_net_sharding(mesh, net_like),
+            rep,
+        ),
+        out_shardings=(delta_state_sharding(mesh), rep),
+        donate_argnums=(0,),
+    )
+
+
+def sharded_delta_run(mesh: Mesh, net_like: NetState | None = None) -> Callable:
+    """``delta_run`` (lax.scan over ticks) compiled for the mesh."""
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        delta_run_impl,
+        static_argnames=("params", "ticks"),
+        in_shardings=(
+            delta_state_sharding(mesh),
+            _delta_net_sharding(mesh, net_like),
+            rep,
+        ),
+        out_shardings=(delta_state_sharding(mesh), rep),
         donate_argnums=(0,),
     )

@@ -74,3 +74,63 @@ def test_uneven_shard_rejected():
     mesh = parallel.make_mesh(8)
     with pytest.raises(ValueError):
         parallel.shard_cluster(sim.init_state(12), sim.make_net(12), mesh)
+
+
+# -- delta backend on the mesh ----------------------------------------------
+
+
+def test_sharded_delta_step_bit_parity():
+    """Row-sharding the delta tables is a layout decision: a lossy
+    trajectory through a kill must match the single-device delta step
+    bit for bit."""
+    from ringpop_tpu.models import swim_delta as sd
+
+    n = 64
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.05, suspicion_ticks=6),
+        wire_cap=8,
+        claim_grid=16,
+    )
+    net = sim.make_net(n)
+    net = net._replace(up=net.up.at[9].set(False))
+    keys = jax.random.split(jax.random.PRNGKey(4), 12)
+
+    ref = sd.init_delta(n, capacity=32)
+    step_ref = jax.jit(sd.delta_step_impl, static_argnames=("params", "upto"))
+    mesh = parallel.make_mesh(8)
+    sh = parallel.shard_delta(sd.init_delta(n, capacity=32), mesh)
+    step_sh = parallel.sharded_delta_step(mesh)
+
+    for t, k in enumerate(keys):
+        ref, m_ref = step_ref(ref, net, k, params)
+        sh, m_sh = step_sh(sh, net, k, params)
+        for name in ("d_subj", "d_key", "d_pb", "d_sl", "base_key"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, name)),
+                np.asarray(getattr(sh, name)),
+                err_msg=f"{name} tick {t}",
+            )
+        assert int(m_ref["pings_sent"]) == int(m_sh["pings_sent"])
+    # shards really are distributed
+    shard_shapes = {s.data.shape for s in sh.d_subj.addressable_shards}
+    assert shard_shapes == {(8, 32)}
+
+
+def test_sharded_delta_run_scan():
+    from ringpop_tpu.models import swim_delta as sd
+
+    n = 64
+    params = sd.DeltaParams(swim=sim.SwimParams(loss=0.01))
+    mesh = parallel.make_mesh(8)
+    sh = parallel.shard_delta(sd.init_delta(n, capacity=32), mesh)
+    run = parallel.sharded_delta_run(mesh)
+    sh, m = run(sh, sim.make_net(n), jax.random.PRNGKey(1), params, 10)
+    assert int(sh.tick) == 10
+    assert int(m["pings_sent"]) > 0
+
+
+def test_sharded_delta_rejects_adjacency():
+    mesh = parallel.make_mesh(8)
+    net = sim.make_net(64, partitioned=True)
+    with pytest.raises(NotImplementedError):
+        parallel.sharded_delta_step(mesh, net_like=net)
