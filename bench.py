@@ -80,30 +80,41 @@ def bench_once(n: int, layout: str = "dense") -> float:
             swim=sim.SwimParams(loss=0.01), wire_cap=16, claim_grid=64
         )
         state = sd.init_delta(n, capacity=256)
-        step = sd.delta_step
+
+        # The delta state is ~10 bytes/(node*slot) (~170 MB at 65k), so
+        # a lax.scan batch fits even double-buffered: one dispatch +
+        # one host sync per TICKS_PER_CALL ticks, vs per-tick dispatch
+        # whose ~70 ms tunnel sync would be 35% of a 200 ms/tick budget.
+        def step(st, nt, k, p):
+            return sd.delta_run(st, nt, k, p, TICKS_PER_CALL)
+
+        ticks_per_step = TICKS_PER_CALL
     else:
         params = sim.SwimParams(loss=0.01)
         state = sim.init_state(n)
+        # Python-level tick loop over the donated step: async dispatch
+        # amortizes the tunnel latency across TICKS_PER_CALL enqueued
+        # steps (one host sync per batch), and — unlike lax.scan —
+        # donation keeps the state strictly in-place: the scan carry
+        # double-buffered the 4 GB view tensor, the difference between
+        # fitting 32k nodes and OOM.
         step = sim.swim_step
+        ticks_per_step = 1
     key = jax.random.PRNGKey(0)
     net = sim.make_net(n)
-    # Python-level tick loop over the donated step: async dispatch
-    # amortizes the tunnel latency across TICKS_PER_CALL enqueued steps
-    # (one host sync per batch), and — unlike lax.scan — donation keeps
-    # the state strictly in-place: the scan carry double-buffered the 4 GB
-    # view tensor, the difference between fitting 32k nodes and OOM.
-    keys = jax.random.split(key, (REPEATS + 1) * TICKS_PER_CALL)
+    calls_per_batch = TICKS_PER_CALL // ticks_per_step
+    keys = jax.random.split(key, (REPEATS + 1) * calls_per_batch)
     print(f"# compiling {layout} n={n}", file=sys.stderr, flush=True)
     state, metrics = step(state, net, keys[0], params)
     _sync(metrics)
     it = iter(keys[1:])
-    for _ in range(TICKS_PER_CALL - 1):  # warm the steady-state timing
+    for _ in range(calls_per_batch - 1):  # warm the steady-state timing
         state, metrics = step(state, net, next(it), params)
     _sync(metrics)
     best = 0.0
     for _ in range(REPEATS):
         t0 = time.perf_counter()
-        for _ in range(TICKS_PER_CALL):
+        for _ in range(calls_per_batch):
             state, metrics = step(state, net, next(it), params)
         _sync(metrics)
         dt = time.perf_counter() - t0

@@ -92,14 +92,17 @@ def net_sharding(mesh: Mesh, like: NetState | None = None) -> NetState:
     return NetState(up=rep, responsive=rep, adj=adj)
 
 
+def _check_divisible(n: int, mesh: Mesh) -> None:
+    d = mesh.devices.size
+    if n % d != 0:
+        raise ValueError(f"n={n} must be divisible by mesh size {d}")
+
+
 def shard_cluster(
     state: ClusterState, net: NetState, mesh: Mesh
 ) -> tuple[ClusterState, NetState]:
     """Place an (unsharded) simulation onto the mesh."""
-    n = state.n
-    d = mesh.devices.size
-    if n % d != 0:
-        raise ValueError(f"n={n} must be divisible by mesh size {d}")
+    _check_divisible(state.n, mesh)
     damping = state.damp is not None
     return (
         jax.device_put(state, state_sharding(mesh, damping)),
@@ -188,55 +191,56 @@ def delta_state_sharding(mesh: Mesh) -> DeltaState:
 
 def shard_delta(state: DeltaState, mesh: Mesh) -> DeltaState:
     """Place an (unsharded) delta state onto the mesh."""
-    n, d = state.n, mesh.devices.size
-    if n % d != 0:
-        raise ValueError(f"n={n} must be divisible by mesh size {d}")
+    _check_divisible(state.n, mesh)
     return jax.device_put(state, delta_state_sharding(mesh))
 
 
-def _delta_net_sharding(mesh: Mesh, net_like: NetState | None) -> NetState:
-    """Net shardings for the delta kernels.  The delta backend models
-    loss/kill/suspend only — surface its clear NotImplementedError for
-    adjacency-carrying nets here, instead of the opaque jit
-    pytree/sharding mismatch the caller would otherwise hit."""
-    if net_like is not None and net_like.adj is not None:
+def _reject_adjacency(net: NetState) -> None:
+    """The delta backend models loss/kill/suspend only — surface its
+    clear NotImplementedError for adjacency-carrying nets at call time,
+    instead of the opaque jit pytree/sharding-structure mismatch the
+    adj=None in_shardings would otherwise produce."""
+    if net.adj is not None:
         raise NotImplementedError(
             "delta backend models loss/kill/suspend; partition masks need "
             "the dense backend (a netsplit diverges densely by construction)"
         )
-    return net_sharding(mesh)
 
 
-def sharded_delta_step(mesh: Mesh, net_like: NetState | None = None) -> Callable:
+def sharded_delta_step(mesh: Mesh) -> Callable:
     """``delta_step`` compiled for the mesh.  The cross-chip traffic is
     the claim routing: the flat (receiver, subject) sort and the
     per-receiver gathers lower to collectives over the row shards —
     the delta analog of the dense scatter-into-foreign-rows."""
     rep = NamedSharding(mesh, P())
-    return jax.jit(
+    jitted = jax.jit(
         delta_step_impl,
         static_argnames=("params", "upto"),
-        in_shardings=(
-            delta_state_sharding(mesh),
-            _delta_net_sharding(mesh, net_like),
-            rep,
-        ),
+        in_shardings=(delta_state_sharding(mesh), net_sharding(mesh), rep),
         out_shardings=(delta_state_sharding(mesh), rep),
         donate_argnums=(0,),
     )
 
+    def step(state, net, key, params, upto=7):
+        _reject_adjacency(net)
+        return jitted(state, net, key, params, upto)
 
-def sharded_delta_run(mesh: Mesh, net_like: NetState | None = None) -> Callable:
+    return step
+
+
+def sharded_delta_run(mesh: Mesh) -> Callable:
     """``delta_run`` (lax.scan over ticks) compiled for the mesh."""
     rep = NamedSharding(mesh, P())
-    return jax.jit(
+    jitted = jax.jit(
         delta_run_impl,
         static_argnames=("params", "ticks"),
-        in_shardings=(
-            delta_state_sharding(mesh),
-            _delta_net_sharding(mesh, net_like),
-            rep,
-        ),
+        in_shardings=(delta_state_sharding(mesh), net_sharding(mesh), rep),
         out_shardings=(delta_state_sharding(mesh), rep),
         donate_argnums=(0,),
     )
+
+    def run(state, net, key, params, ticks):
+        _reject_adjacency(net)
+        return jitted(state, net, key, params, ticks)
+
+    return run

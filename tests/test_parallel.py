@@ -130,7 +130,11 @@ def test_sharded_delta_run_scan():
 
 
 def test_sharded_delta_rejects_adjacency():
+    from ringpop_tpu.models import swim_delta as sd
+
     mesh = parallel.make_mesh(8)
     net = sim.make_net(64, partitioned=True)
+    step = parallel.sharded_delta_step(mesh)
+    state = parallel.shard_delta(sd.init_delta(64, capacity=16), mesh)
     with pytest.raises(NotImplementedError):
-        parallel.sharded_delta_step(mesh, net_like=net)
+        step(state, net, jax.random.PRNGKey(0), sd.DeltaParams())
