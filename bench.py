@@ -194,13 +194,9 @@ def child_main(attempts: list[tuple[str, int]]) -> None:
     subsequent allocation fails RESOURCE_EXHAUSTED), so the parent
     retries smaller sizes in fresh processes.
     """
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # The env var alone is not enough: the ambient TPU plugin still
-        # contacts the (possibly hung) tunnel on backend init.  The
-        # config-level pin keeps the CPU fallback truly tunnel-free.
-        import jax
+    from ringpop_tpu.utils import pin_cpu_if_requested
 
-        jax.config.update("jax_platforms", "cpu")
+    pin_cpu_if_requested()
     last_err = None
     for layout, n in attempts:
         try:

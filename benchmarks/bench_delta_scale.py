@@ -23,7 +23,6 @@ Defaults: sizes 262144,1048576; 20 ticks per timed batch.
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 
@@ -37,10 +36,9 @@ LOSS = 0.005
 def run_size(n: int, ticks: int) -> dict:
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # env var alone still lets the ambient TPU plugin contact a
-        # possibly hung tunnel on backend init; pin at the config level
-        jax.config.update("jax_platforms", "cpu")
+    from ringpop_tpu.utils import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
 
     from ringpop_tpu.models import swim_delta as sd
     from ringpop_tpu.models import swim_sim as sim

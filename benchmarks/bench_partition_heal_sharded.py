@@ -62,10 +62,9 @@ def main(argv: list[str]) -> None:
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # The env var alone still lets the ambient TPU plugin contact a
-        # (possibly hung) tunnel on backend init; pin at the config level.
-        jax.config.update("jax_platforms", "cpu")
+    from ringpop_tpu.utils import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
 
     if jax.default_backend() == "cpu" and len(jax.devices()) < 8:
         raise SystemExit(

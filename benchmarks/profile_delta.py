@@ -8,16 +8,14 @@ not an exact decomposition.
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
 import jax
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # env var alone still lets the ambient TPU plugin contact a possibly
-    # hung tunnel on backend init; pin at the config level (see bench.py)
-    jax.config.update("jax_platforms", "cpu")
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
 
 import jax.numpy as jnp
 

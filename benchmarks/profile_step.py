@@ -10,20 +10,17 @@ cost is reported (sync overhead amortized to noise).
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
 import jax
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # env var alone still lets the ambient TPU plugin contact a possibly
-    # hung tunnel on backend init; pin at the config level (see bench.py)
-    jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 
 sys.path.insert(0, ".")
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
 
 from ringpop_tpu.models import swim_sim as sim
 

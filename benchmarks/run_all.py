@@ -30,6 +30,10 @@ SIM_BENCHES = [
 
 
 def main(argv=None) -> int:
+    from ringpop_tpu.utils import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--fast", action="store_true",
                         help="host benches only (skip XLA compiles)")

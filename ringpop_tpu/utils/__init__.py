@@ -5,6 +5,7 @@ from ringpop_tpu.utils.misc import (
     capture_host,
     num_or_default,
     parse_arg,
+    pin_cpu_if_requested,
     safe_parse,
 )
 from ringpop_tpu.utils.nulls import NullLogger, NullStatsd
@@ -14,6 +15,7 @@ __all__ = [
     "capture_host",
     "num_or_default",
     "parse_arg",
+    "pin_cpu_if_requested",
     "safe_parse",
     "NullLogger",
     "NullStatsd",

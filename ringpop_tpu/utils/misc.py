@@ -62,3 +62,22 @@ def map_uniq(values: list[Any]) -> list[Any]:
 def to_json(obj: Any) -> str:
     """Compact JSON like JS JSON.stringify."""
     return json.dumps(obj, separators=(",", ":"))
+
+
+def pin_cpu_if_requested() -> None:
+    """Honor ``JAX_PLATFORMS=cpu`` at the jax-config level.
+
+    The env var alone is not enough on this runtime: the ambient TPU
+    plugin still contacts its (possibly hung) tunnel during backend
+    init.  CPU-capable entry points (bench.py children, the benchmark
+    harnesses) call this before any jax computation so a dead
+    accelerator never blocks host-only work.  (tick-cluster keeps its
+    own richer variant: it honors arbitrary JAX_PLATFORMS values and
+    reverts the pin, cli/tick_cluster.py.)  No-op unless the operator
+    set ``JAX_PLATFORMS=cpu``."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
