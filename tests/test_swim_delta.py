@@ -404,3 +404,21 @@ def test_sparsify_densify_roundtrip():
     np.testing.assert_array_equal(
         np.asarray(dd.suspect_left), np.asarray(dense.suspect_left)
     )
+
+
+def test_upto_prefixes_compile_and_full_matches_default():
+    """The profiling ``upto`` knob: every prefix executes, and the
+    explicit full value (7) is the default step bit for bit."""
+    n = 64
+    params = sd.DeltaParams(swim=sim.SwimParams(loss=0.05), wire_cap=8, claim_grid=16)
+    state = sd.init_delta(n, capacity=32)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(11)
+    step = jax.jit(sd.delta_step_impl, static_argnames=("params", "upto"))
+    ref, _ = step(state, net, key, params)
+    full, _ = step(state, net, key, params, upto=7)
+    for a, b in zip(jax.tree_util.tree_leaves(ref), jax.tree_util.tree_leaves(full)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for u in range(7):
+        st, m = step(state, net, key, params, upto=u)
+        jax.block_until_ready(st.d_subj)
