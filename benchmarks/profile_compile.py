@@ -1,0 +1,50 @@
+"""AOT lower/compile timing of delta_step phase prefixes on the ambient backend.
+
+The delta backend's 65k program is compile-heavy on the tunneled TPU
+platform (remote compile); this tool attributes that cost per phase the
+same way benchmarks/profile_delta.py attributes run time — each static
+``upto`` prefix compiles as one executable, so consecutive differences
+localize the compile-time hog.
+
+Usage: python -m benchmarks.profile_compile [n] [upto,upto,...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    uptos = [int(x) for x in (sys.argv[2].split(",") if len(sys.argv) > 2 else ["7"])]
+
+    params = sd.DeltaParams(swim=sim.SwimParams(loss=0.01), wire_cap=16, claim_grid=64)
+    state = sd.init_delta(n, capacity=256)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+    print(f"platform={jax.default_backend()} n={n}", flush=True)
+
+    for u in uptos:
+        fn = jax.jit(
+            lambda st, nt, kk, u=u: sd.delta_step_impl(st, nt, kk, params, upto=u)
+        )
+        t0 = time.perf_counter()
+        lowered = fn.lower(state, net, key)
+        t1 = time.perf_counter()
+        lowered.compile()
+        t2 = time.perf_counter()
+        print(f"upto={u}: lower {t1 - t0:.1f}s compile {t2 - t1:.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
