@@ -1,0 +1,73 @@
+"""Census of expensive ops in the delta step's TPU StableHLO.
+
+Lowers delta_step_impl for the TPU platform (no hardware needed —
+``jax.export`` cross-platform lowering) and tallies every sort /
+scatter / gather / while by operand shape, with a rough element count.
+The per-tick fixed cost of the delta backend is sort-dominated; this
+shows exactly which call sites pay for what before a chip is available
+to time them (usage: python -m benchmarks.hlo_census [n] [capacity]).
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import sys
+
+import jax
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+import jax.numpy as jnp
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    cap = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    params = sd.DeltaParams(swim=sim.SwimParams(loss=0.01), wire_cap=16,
+                            claim_grid=64)
+    state = sd.init_delta(n, capacity=cap)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+
+    exported = jax.export.export(
+        jax.jit(sd.delta_step_impl, static_argnames=("params",)),
+        platforms=["tpu"],
+    )(state, net, key, params)
+    txt = exported.mlir_module()
+
+    tallies = collections.Counter()
+    elems = collections.Counter()
+    for m in re.finditer(r'"stablehlo\.sort"\((.*?)\)', txt):
+        shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", m.group(1))
+        if not shapes:
+            continue
+        dims = shapes[0]
+        nops = len(shapes)
+        key_ = f"sort [{dims}] x{nops}ops"
+        tallies[key_] += 1
+        total = 1
+        for d in dims.split("x"):
+            total *= int(d)
+        elems[key_] += total * nops
+    for opname in ("scatter", "while", "dynamic_gather"):
+        for m in re.finditer(rf'"stablehlo\.{opname}"\((.*?)\)', txt):
+            shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", m.group(1))
+            dims = shapes[0] if shapes else "?"
+            tallies[f"{opname} [{dims}]"] += 1
+
+    print(f"n={n} capacity={cap}  module: {len(txt) / 1e6:.1f} MB text")
+    print(f"{'op [shape]':45s} {'count':>5s} {'Melems':>9s}")
+    for key_, cnt in sorted(tallies.items(), key=lambda kv: -elems.get(kv[0], 0)):
+        print(f"{key_:45s} {cnt:5d} {elems.get(key_, 0) / 1e6:9.1f}")
+    total_sort = sum(v for k, v in elems.items() if k.startswith("sort"))
+    print(f"total sorted elements/tick: {total_sort / 1e6:.1f} M")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Phase bisect of delta_step_impl only — the lean on-chip attribution.
+
+benchmarks/profile_delta.py times standalone sub-functions too; on the
+tunneled TPU each jit compile costs minutes, so this script compiles
+ONLY the 7 step prefixes (delta_step_impl's static ``upto``), with the
+persistent compilation cache on so re-runs after a code edit only pay
+for the phases the edit touched.
+
+usage: python -m benchmarks.profile_delta_bisect [n] [capacity] [loss]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+from ringpop_tpu.utils import enable_compilation_cache, pin_cpu_if_requested
+
+pin_cpu_if_requested()
+enable_compilation_cache()
+
+import jax.numpy as jnp
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    cap = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    loss = float(sys.argv[3]) if len(sys.argv) > 3 else 0.01
+    params = sd.DeltaParams(swim=sim.SwimParams(loss=loss), wire_cap=16,
+                            claim_grid=64)
+    print(f"platform={jax.default_backend()} n={n} capacity={cap} loss={loss}",
+          flush=True)
+    state = sd.init_delta(n, capacity=cap)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+
+    step = jax.jit(sd.delta_step_impl, static_argnames=("params", "upto"))
+    t0 = time.perf_counter()
+    for i in range(3):  # realistic non-empty divergence
+        key, sub = jax.random.split(key)
+        state, m = step(state, net, sub, params)
+    jax.block_until_ready(state)
+    print(f"warmup (incl. full-step compile): {time.perf_counter()-t0:.1f}s "
+          f"occupancy={int(m['max_occupancy'])}", flush=True)
+
+    names = {0: "stats+digest", 1: "selection", 2: "send window",
+             3: "ping merge", 4: "ack merge (+full sync)", 5: "ping-req",
+             7: "suspicion+metrics (full)"}
+    key2 = jax.random.PRNGKey(7)
+    prev = 0.0
+    for u in (0, 1, 2, 3, 4, 5, 7):
+        t0 = time.perf_counter()
+        out = step(state, net, key2, params, upto=u)
+        jax.block_until_ready(out)
+        print(f"  upto={u} compile+1st: {time.perf_counter()-t0:.1f}s",
+              flush=True)
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            out = step(state, net, key2, params, upto=u)
+        leaves = jax.tree_util.tree_leaves(out)
+        _ = jax.device_get(leaves[0].ravel()[0])
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps * 1e3
+        print(f"upto={u} ({names[u]:<24}) {dt:9.2f} ms  (+{dt - prev:8.2f})",
+              flush=True)
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()

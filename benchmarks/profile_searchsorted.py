@@ -1,0 +1,81 @@
+"""Race the row-searchsorted lowerings on the ambient accelerator.
+
+The delta step's fixed cost is dominated by vmapped searchsorted over
+the [N, C] subject tables (see swim_delta._row_searchsorted and
+benchmarks/hlo_census.py).  This times each candidate lowering at the
+shapes the step actually uses, plus the batched row scatter that could
+replace the slot->claim inverse search, so the _WIDE_METHOD choice is
+a measurement, not a guess (usage:
+python -m benchmarks.profile_searchsorted [n]).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+from ringpop_tpu.utils import enable_compilation_cache, pin_cpu_if_requested
+
+pin_cpu_if_requested()
+enable_compilation_cache()
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(name, fn, *args, reps=10):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    leaves = jax.tree_util.tree_leaves(out)
+    _ = jax.device_get(leaves[0].ravel()[0])  # unfakeable barrier
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps * 1e3
+    print(f"{name:42s} {dt:8.2f} ms   (compile {compile_s:.1f}s)", flush=True)
+    return dt
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    print(f"platform={jax.default_backend()} n={n}", flush=True)
+    rng = np.random.default_rng(0)
+
+    for c, k in ((256, 64), (256, 16), (64, 64), (64, 16), (256, 256)):
+        a = jnp.asarray(np.sort(rng.integers(0, 1 << 20, (n, c)), axis=1))
+        v = jnp.asarray(np.sort(rng.integers(0, 1 << 20, (n, k)), axis=1))
+        print(f"-- tables [N,{c}] x queries [N,{k}]")
+        for method in ("sort", "scan_unrolled"):
+            f = jax.jit(jax.vmap(
+                lambda ar, vr, m=method: jnp.searchsorted(ar, vr, method=m)))
+            bench(f"searchsorted {method}", f, a, v)
+        if c * k * n * 4 <= 2 << 30:
+            f = jax.jit(jax.vmap(
+                lambda ar, vr: jnp.searchsorted(ar, vr, method="compare_all")))
+            bench("searchsorted compare_all", f, a, v)
+
+    # batched unique-index row scatter (candidate slot->claim inverse)
+    c, k = 256, 64
+    x = jnp.zeros((n, c), jnp.int32)
+    pos = jnp.asarray(
+        np.sort(rng.permuted(np.tile(np.arange(c), (n, 1)), axis=1)[:, :k],
+                axis=1))
+    val = jnp.asarray(rng.integers(0, 1 << 20, (n, k)), dtype=jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k))
+
+    def scat(x, rows, pos, val):
+        return x.at[rows, pos].set(val, mode="drop", unique_indices=True)
+
+    bench(f"row scatter [N,{k}] -> [N,{c}]", jax.jit(scat), x, rows, pos, val)
+
+    # the row sort itself, for scale
+    bench(f"row sort [N,{c}]", jax.jit(lambda t: jnp.sort(t, axis=1)), a)
+
+
+if __name__ == "__main__":
+    main()

@@ -211,14 +211,29 @@ def init_delta(
 # 65536x64x256 intermediates; the compiled tick ran 20-100x slower
 # than its own primitives — the [N,16]x[N,256] instance measured 723 ms
 # in-program vs 8.8 ms standalone).  Past ``_WIDE_QUERY`` queries per
-# row the merge lowering (method="sort": one [R, C+K] row sort of the
-# concat) is strictly cheaper and cube-free; only the k+1 selection
-# probes stay on the fused compare.
+# row two cube-free lowerings exist:
+#
+# * merge (method="sort"): one [R, C+K] row sort of the concat — PLUS,
+#   inside jnp.searchsorted, an argsort of the query block.  An HLO
+#   census of the full 65k step (benchmarks/hlo_census.py) showed 13
+#   such instances summing ~340M row-sorted int32 elements per tick;
+#   a TPU row sort is O(log^2 width) full passes, so the merge
+#   lowering dominated the compiled tick (~1.4 s/tick at 32k, 0.14x
+#   real time).
+# * unrolled bisection (method="scan_unrolled"): log2(C) data-dependent
+#   but fully batched [R, K]-from-[R, C] gathers — ~8 passes of K-wide
+#   reads instead of ~36 sort passes of (C+K)-wide read+writes, and no
+#   query argsort.
+#
+# ``_WIDE_METHOD`` selects the wide lowering; scan_unrolled is the
+# default.  Correctness of every choice is pinned by the densified
+# bit-parity suite (tests/test_swim_delta.py runs the grid).
 _WIDE_QUERY = 4
+_WIDE_METHOD = "scan_unrolled"
 
 
 def _row_searchsorted(a: jax.Array, v: jax.Array, side: str = "left") -> jax.Array:
-    method = "compare_all" if v.shape[-1] <= _WIDE_QUERY else "sort"
+    method = "compare_all" if v.shape[-1] <= _WIDE_QUERY else _WIDE_METHOD
     return jax.vmap(
         lambda ar, vr: jnp.searchsorted(ar, vr, side=side, method=method)
     )(a, v)
@@ -410,6 +425,37 @@ def _row_searchsorted_right(a: jax.Array, v: jax.Array) -> jax.Array:
     return _row_searchsorted(a, v, side="right")
 
 
+def _windowed_changes(
+    state: DeltaState, within: jax.Array, w: int
+) -> tuple[jax.Array, jax.Array]:
+    """(subject, key) lists of each row's windowed changes, [N, W].
+
+    The compaction is a [N, C] row sort (_compact_true) — one of the
+    two unconditionally-reached sorts of a tick — so a tick with no
+    issuable changes anywhere (converged cluster, budgets exhausted)
+    skips it entirely under the cond."""
+    n = within.shape[0]
+    w = min(w, within.shape[1])  # _compact_true caps the width at C
+
+    def compacted(_):
+        cols = _compact_true(within, w)
+        safe = jnp.minimum(cols, state.capacity - 1)
+        subj = jnp.where(
+            cols < SENTINEL,
+            jnp.take_along_axis(state.d_subj, safe, axis=1),
+            SENTINEL,
+        )
+        return subj, jnp.take_along_axis(state.d_key, safe, axis=1)
+
+    def quiet(_):
+        return (
+            jnp.full((n, w), SENTINEL, jnp.int32),
+            jnp.zeros((n, w), jnp.int32),
+        )
+
+    return jax.lax.cond(jnp.any(within), compacted, quiet, None)
+
+
 def _selection(
     state: DeltaState,
     stats: _Stats,
@@ -444,7 +490,7 @@ def _selection(
 
     # corrections vs the base pingable set, in slot (= subject) order.
     # Self is never pingable: a base-pingable self is a removal, via its
-    # slot when it has one, else via one extra correction entry.
+    # slot when it has one, else by shifting ranks at/past self (below).
     live, ping_now, ping_base = stats.live, stats.ping_now, stats.ping_base
     is_self = state.d_subj == ids[:, None]
     added = ping_now & ~ping_base & ~is_self
@@ -453,41 +499,66 @@ def _selection(
     self_in_delta = jnp.any(is_self & live, axis=1)
     self_extra = state.bp_mask & ~self_in_delta
 
-    su = jnp.concatenate(
-        [
-            jnp.where(d_slot != 0, state.d_subj, SENTINEL),
-            jnp.where(self_extra, ids, SENTINEL)[:, None],
-        ],
-        axis=1,
-    )
-    dd = jnp.concatenate(
-        [d_slot, jnp.where(self_extra, -1, 0)[:, None]], axis=1
-    )
-    order = jnp.argsort(su, axis=1)
-    su = jnp.take_along_axis(su, order, axis=1)
-    dd = jnp.take_along_axis(dd, order, axis=1)
-    cpd = jnp.cumsum(dd, axis=1)  # inclusive prefix of corrections
-    su_ok = su < SENTINEL
+    # ``d_subj`` is subject-sorted, so slot order IS subject order: the
+    # correction prefix/rank arrays need no argsort (a [N, C+1] row sort
+    # per tick before this rewrite).  Quiet slots (d == 0) take the next
+    # correction's F by a log-step suffix-min, which restores row
+    # monotonicity for the binary search and — because a filled slot
+    # duplicates the value of a LATER live slot — can never themselves
+    # be the last index <= rank.
+    corr_live = d_slot != 0
+    cpd = jnp.cumsum(d_slot, axis=1)  # inclusive prefix, subject order
     big = jnp.int32(1 << 30)
     F = jnp.where(
-        su_ok, state.bp_rank[jnp.clip(su, 0, n - 1)] + (cpd - dd), big
+        corr_live,
+        state.bp_rank[jnp.clip(state.d_subj, 0, n - 1)] + (cpd - d_slot),
+        big,
     )
+    shift = 1
+    cc = F.shape[1]
+    while shift < cc:
+        F = jnp.minimum(
+            F, jnp.pad(F, ((0, 0), (0, shift)), constant_values=big)[:, shift:]
+        )
+        shift *= 2
 
     ranks, valid = _distinct_ranks(stats.ping_count, k + 1, k_sel)
     r_clip = jnp.clip(
         ranks, 0, jnp.maximum(stats.ping_count - 1, 0)[:, None]
     )  # [N, k+1]
-    kstar = _row_searchsorted_right(F, r_clip) - 1
-    ks_safe = jnp.clip(kstar, 0, su.shape[1] - 1)
+
+    # Self removal when self has no slot: ranks landing at/after self in
+    # the self-included list shift up by one (G_with(s) = G_without(s)
+    # - [s > i], so rank r maps to the without-self answer at r + 1
+    # exactly when that answer would be >= self).  "Answer >= self" is
+    # decidable before the search: the without-self answer at rank r is
+    # >= i iff r >= G_without(i) = bp_rank[i] + #corrections below i,
+    # so ONE search with pre-shifted ranks replaces answer-then-redo.
+    own_pos, _ = _lookup_pos(state.d_subj, ids)
+    corr_below_self = jnp.where(
+        own_pos > 0,
+        jnp.take_along_axis(cpd, jnp.maximum(own_pos - 1, 0)[:, None], axis=1)[:, 0],
+        0,
+    )
+    # own_pos is clipped to C-1; a self landing past every slot must
+    # still take the full correction sum
+    corr_below_self = jnp.where(
+        state.d_subj[:, -1] < ids, cpd[:, -1], corr_below_self
+    )
+    g_self = state.bp_rank[ids] + corr_below_self
+    r_eff = r_clip + (
+        self_extra[:, None] & (r_clip >= g_self[:, None])
+    ).astype(jnp.int32)
+
+    kstar = _row_searchsorted_right(F, r_eff) - 1
+    ks_safe = jnp.clip(kstar, 0, cc - 1)
     in_corr = kstar >= 0
     F_at = jnp.take_along_axis(F, ks_safe, axis=1)
-    d_at = jnp.take_along_axis(dd, ks_safe, axis=1)
-    su_at = jnp.take_along_axis(su, ks_safe, axis=1)
-    cpd_at = jnp.where(
-        in_corr, jnp.take_along_axis(cpd, ks_safe, axis=1), 0
-    )
-    added_answer = in_corr & (d_at == 1) & (F_at == r_clip)
-    rprime = jnp.clip(r_clip - cpd_at, 0, n - 1)
+    d_at = jnp.take_along_axis(d_slot, ks_safe, axis=1)
+    su_at = jnp.take_along_axis(state.d_subj, ks_safe, axis=1)
+    cpd_at = jnp.where(in_corr, jnp.take_along_axis(cpd, ks_safe, axis=1), 0)
+    added_answer = in_corr & (d_at == 1) & (F_at == r_eff)
+    rprime = jnp.clip(r_eff - cpd_at, 0, n - 1)
     picks = jnp.where(added_answer, su_at, state.bp_list[rprime])  # [N, k+1]
 
     target = jnp.where(valid[:, 0], picks[:, 0], -1)
@@ -817,14 +888,7 @@ def delta_step_impl(
     pb_next = jnp.where(bump_eff & (pb_next > maxpb[:, None]), jnp.int8(-1), pb_next)
     state = state._replace(d_pb=pb_next)
 
-    send_cols = _compact_true(within, w)  # [N, W] slot indices
-    sc_safe = jnp.minimum(send_cols, state.capacity - 1)
-    send_subj = jnp.where(
-        send_cols < SENTINEL,
-        jnp.take_along_axis(state.d_subj, sc_safe, axis=1),
-        SENTINEL,
-    )
-    send_key = jnp.take_along_axis(state.d_key, sc_safe, axis=1)
+    send_subj, send_key = _windowed_changes(state, within, w)
     if upto <= 2:
         # anchor phase-1 outputs too: without t_safe/wit in the live set
         # XLA DCEs the whole selection and the 2-vs-1 delta goes negative
@@ -885,14 +949,7 @@ def delta_step_impl(
 
     h_post = _phase0_stats(state).digest  # receiver digests after merge
 
-    rep_cols = _compact_true(within_rep, w)
-    rc_safe = jnp.minimum(rep_cols, state.capacity - 1)
-    rep_subj = jnp.where(
-        rep_cols < SENTINEL,
-        jnp.take_along_axis(state.d_subj, rc_safe, axis=1),
-        SENTINEL,
-    )
-    rep_key = jnp.take_along_axis(state.d_key, rc_safe, axis=1)
+    rep_subj, rep_key = _windowed_changes(state, within_rep, w)
 
     # ack claims for sender s = reply list of its receiver (pure gather)
     ack = fwd_ok & ~_drop(k_loss2, (n,), sw.loss)

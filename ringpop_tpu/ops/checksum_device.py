@@ -163,16 +163,19 @@ def row_strings(
     )
     valid = present[:, :, None] & (b < entry_len[:, :, None])
     # scatter into the row buffer; the first entry's ';' lands at -1 and
-    # mode="drop" discards it (the join trick, see module doc)
+    # mode="drop" discards it (the join trick, see module doc).  The
+    # scatter runs in int32: the TPU runtime rejects scatters of
+    # unsigned element types ("Reductions over unsigned integers not
+    # implemented"), and byte values fit int32 exactly.
     pos = jnp.where(valid, offsets[:, :, None] + b - 1, -1)
     rows_idx = jnp.broadcast_to(
         jnp.arange(r, dtype=jnp.int32)[:, None, None], pos.shape
     )
-    out = jnp.zeros((r, book.row_width), dtype=jnp.uint8)
+    out = jnp.zeros((r, book.row_width), dtype=jnp.int32)
     out = out.at[rows_idx, pos].set(
-        jnp.where(valid, val, jnp.uint8(0)), mode="drop"
+        jnp.where(valid, val, jnp.uint8(0)).astype(jnp.int32), mode="drop"
     )
-    return out, lens
+    return out.astype(jnp.uint8), lens
 
 
 def view_checksums_device(

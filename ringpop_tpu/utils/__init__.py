@@ -5,6 +5,7 @@ from ringpop_tpu.utils.misc import (
     capture_host,
     num_or_default,
     parse_arg,
+    enable_compilation_cache,
     pin_cpu_if_requested,
     safe_parse,
 )
@@ -15,6 +16,7 @@ __all__ = [
     "capture_host",
     "num_or_default",
     "parse_arg",
+    "enable_compilation_cache",
     "pin_cpu_if_requested",
     "safe_parse",
     "NullLogger",

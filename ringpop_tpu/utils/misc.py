@@ -3,7 +3,9 @@
 from __future__ import annotations
 
 import json
+import os
 import re
+import sys
 from typing import Any
 
 _HOST_CAPTURE = re.compile(r"(\d+\.\d+\.\d+\.\d+):\d+")
@@ -75,9 +77,29 @@ def pin_cpu_if_requested() -> None:
     own richer variant: it honors arbitrary JAX_PLATFORMS values and
     reverts the pin, cli/tick_cluster.py.)  No-op unless the operator
     set ``JAX_PLATFORMS=cpu``."""
-    import os
-
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compilation_cache() -> None:
+    """Persist compiled executables across processes (<repo>/.jax_cache).
+
+    On the tunneled TPU platform a large program's first compile can
+    take minutes; the persistent cache means a warm-up run (or an
+    earlier round) pays it once and later processes — the driver's
+    bench, the profilers — reuse the executable.  Best-effort:
+    platforms whose executables don't serialize just compile live
+    (JAX logs a warning)."""
+    try:
+        import jax
+
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    except Exception as e:  # noqa: BLE001 — the cache is an optimization only
+        print(f"# compilation cache unavailable: {e!r}", file=sys.stderr)

@@ -422,3 +422,16 @@ def test_upto_prefixes_compile_and_full_matches_default():
     for u in range(7):
         st, m = step(state, net, key, params, upto=u)
         jax.block_until_ready(st.d_subj)
+
+
+@pytest.mark.parametrize("method", ["sort", "scan_unrolled"])
+def test_wide_lowerings_bit_identical(method, monkeypatch):
+    """Both wide-query searchsorted lowerings (_WIDE_METHOD) trace the
+    same trajectory: the merge lowering stays a tested fallback for
+    hardware where the unrolled bisection regresses."""
+    monkeypatch.setattr(sd, "_WIDE_METHOD", method)
+    params = sim.SwimParams(loss=0.05, suspicion_ticks=10)
+    for t, dense, delta, _, _ in run_both(
+        24, 25, params, events=[(0, "kill", 5)]
+    ):
+        assert_matches_dense(delta, dense, t)

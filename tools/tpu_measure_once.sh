@@ -1,0 +1,39 @@
+#!/bin/bash
+# Wait for the tunneled TPU to come back, then take the round's on-chip
+# measurements in one pass (lowering race, per-phase bisect, headline
+# bench attempt).  Each stage has its own hard timeout; everything logs
+# to $LOG.  Usage: tools/tpu_measure_once.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_measure.log}
+: > "$LOG"
+say() { echo "[$(date +%H:%M:%S)] $*" >> "$LOG"; }
+
+probe() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256,256))
+print('probe ok', float((x@x).sum()))" >> "$LOG" 2>&1
+}
+
+say "waiting for TPU tunnel"
+for i in $(seq 1 48); do    # up to 4 h of 5-min waits
+  if probe; then say "tunnel up after $i probes"; break; fi
+  say "probe $i failed; sleeping 300s"
+  sleep 300
+done
+if ! probe; then say "tunnel never came back; giving up"; exit 1; fi
+
+say "=== stage 1: searchsorted lowering race (n=65536)"
+timeout 2400 python -u -m benchmarks.profile_searchsorted 65536 >> "$LOG" 2>&1
+say "stage 1 rc=$?"
+
+say "=== stage 2: delta phase bisect (n=65536, C=64)"
+timeout 2400 python -u -m benchmarks.profile_delta_bisect 65536 64 >> "$LOG" 2>&1
+say "stage 2 rc=$?"
+
+say "=== stage 3: headline bench child delta@64:65536"
+timeout 1800 python -u bench.py --child delta@64:65536 >> "$LOG" 2>&1
+say "stage 3 rc=$?"
+
+say "done"
