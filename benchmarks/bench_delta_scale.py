@@ -16,8 +16,8 @@ Prints one JSON line per size:
 ``vs_baseline``: speedup over the real-time protocol rate at equal N
 (5 * N node-rounds/s, gossip.js:127-129) — same definition as bench.py.
 
-Run: python benchmarks/bench_delta_scale.py [sizes_csv] [ticks_per_batch]
-Defaults: sizes 262144,1048576; 20 ticks per timed batch.
+Run: python benchmarks/bench_delta_scale.py [sizes_csv] [ticks_per_batch] [capacity]
+Defaults: sizes 262144,1048576; 20 ticks per timed batch; capacity 256.
 """
 
 from __future__ import annotations
@@ -33,12 +33,13 @@ CAPACITY = 256
 LOSS = 0.005
 
 
-def run_size(n: int, ticks: int) -> dict:
+def run_size(n: int, ticks: int, capacity: int = CAPACITY) -> dict:
     import jax
 
-    from ringpop_tpu.utils import pin_cpu_if_requested
+    from ringpop_tpu.utils import enable_compilation_cache, pin_cpu_if_requested
 
     pin_cpu_if_requested()
+    enable_compilation_cache()
 
     from ringpop_tpu.models import swim_delta as sd
     from ringpop_tpu.models import swim_sim as sim
@@ -48,7 +49,7 @@ def run_size(n: int, ticks: int) -> dict:
         wire_cap=16,
         claim_grid=64,
     )
-    state = sd.init_delta(n, capacity=CAPACITY)
+    state = sd.init_delta(n, capacity=capacity)
     net = sim.make_net(n)
     key = jax.random.PRNGKey(0)
 
@@ -133,8 +134,9 @@ def main() -> None:
         else [262144, 1048576]
     )
     ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    capacity = int(sys.argv[3]) if len(sys.argv) > 3 else CAPACITY
     for n in sizes:
-        print(json.dumps(run_size(n, ticks)), flush=True)
+        print(json.dumps(run_size(n, ticks, capacity)), flush=True)
 
 
 if __name__ == "__main__":

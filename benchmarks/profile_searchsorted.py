@@ -58,6 +58,15 @@ def main():
             f = jax.jit(jax.vmap(
                 lambda ar, vr: jnp.searchsorted(ar, vr, method="compare_all")))
             bench("searchsorted compare_all", f, a, v)
+        from ringpop_tpu.ops.searchsorted_pallas import row_searchsorted_pallas
+
+        interp = jax.default_backend() == "cpu"
+        label = "searchsorted pallas" + (" (interpret!)" if interp else "")
+        bench(
+            label,
+            lambda ar, vr: row_searchsorted_pallas(ar, vr, interpret=interp),
+            a, v,
+        )
 
     # batched unique-index row scatter (candidate slot->claim inverse)
     c, k = 256, 64

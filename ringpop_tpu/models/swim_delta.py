@@ -226,13 +226,22 @@ def init_delta(
 #   query argsort.
 #
 # ``_WIDE_METHOD`` selects the wide lowering; scan_unrolled is the
-# default.  Correctness of every choice is pinned by the densified
-# bit-parity suite (tests/test_swim_delta.py runs the grid).
+# default.  "pallas" uses the hand-fused VPU compare-count kernel
+# (ops/searchsorted_pallas.py) — cube-free by construction, candidate
+# replacement pending the on-chip race.  Correctness of every choice is
+# pinned by the densified bit-parity suite (tests/test_swim_delta.py
+# runs the grid).
 _WIDE_QUERY = 4
 _WIDE_METHOD = "scan_unrolled"
 
 
 def _row_searchsorted(a: jax.Array, v: jax.Array, side: str = "left") -> jax.Array:
+    if v.shape[-1] > _WIDE_QUERY and _WIDE_METHOD == "pallas":
+        from ringpop_tpu.ops.searchsorted_pallas import row_searchsorted_pallas
+
+        return row_searchsorted_pallas(
+            a, v, side=side, interpret=jax.default_backend() == "cpu"
+        )
     method = "compare_all" if v.shape[-1] <= _WIDE_QUERY else _WIDE_METHOD
     return jax.vmap(
         lambda ar, vr: jnp.searchsorted(ar, vr, side=side, method=method)
@@ -1178,6 +1187,9 @@ def materialize_rows(state: DeltaState, idx: jax.Array) -> jax.Array:
     live = subj < SENTINEL
     rows = jnp.broadcast_to(state.base_key[None, :], (idx.shape[0], n))
     k_ids = jnp.arange(idx.shape[0], dtype=jnp.int32)[:, None]
+    # NOT unique_indices: every empty slot maps to the same dropped
+    # column n, so the index array repeats n whenever a row has two or
+    # more free slots.
     return rows.at[k_ids, jnp.where(live, subj, n)].set(
         jnp.where(live, keyv, 0), mode="drop"
     )

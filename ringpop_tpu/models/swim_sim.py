@@ -534,8 +534,10 @@ def _merge_incoming(
     diag_key = jnp.where(
         refuted, new_self_inc * 8 + ALIVE, jnp.diagonal(view_key)
     ).astype(jnp.int32)
-    view_key = view_key.at[ids, ids].set(diag_key)
-    pb = pb.at[ids, ids].set(jnp.where(refuted, jnp.int8(0), jnp.diagonal(pb)))
+    view_key = view_key.at[ids, ids].set(diag_key, unique_indices=True)
+    pb = pb.at[ids, ids].set(
+        jnp.where(refuted, jnp.int8(0), jnp.diagonal(pb)), unique_indices=True
+    )
 
     applied = apply | (eye & refuted[:, None])
 
@@ -584,14 +586,17 @@ def _declare(
     cur = state.view_key[ids, subj]
     in_key = jnp.where(cur > 0, (cur >> 3) * 8 + new_status, 0)
     ok = viewer_mask & (subj != ids) & _apply_mask(cur, in_key)
-    vk = state.view_key.at[ids, subj].set(jnp.where(ok, in_key, cur))
+    vk = state.view_key.at[ids, subj].set(
+        jnp.where(ok, in_key, cur), unique_indices=True
+    )
     pb = state.pb.at[ids, subj].set(
-        jnp.where(ok, jnp.int8(0), state.pb[ids, subj])
+        jnp.where(ok, jnp.int8(0), state.pb[ids, subj]), unique_indices=True
     )
     sus = state.suspect_left
     if new_status == SUSPECT:
         sus = sus.at[ids, subj].set(
-            jnp.where(ok, jnp.int8(sl_start), sus[ids, subj])
+            jnp.where(ok, jnp.int8(sl_start), sus[ids, subj]),
+            unique_indices=True,
         )
     return state._replace(view_key=vk, pb=pb, suspect_left=sus), ok
 
@@ -871,7 +876,7 @@ def swim_step_impl(
         # a viewer that itself declares alive->suspect flaps too (the host
         # library scores these via the membership 'updated' event)
         declare_flap = declared & was_alive_at_target
-        flaps = flaps.at[ids, t_safe].max(declare_flap)
+        flaps = flaps.at[ids, t_safe].max(declare_flap, unique_indices=True)
         damp = (
             state.damp.astype(jnp.float32) * params.damp_decay_per_tick
             + jnp.where(flaps, jnp.float32(params.damp_penalty), 0.0)

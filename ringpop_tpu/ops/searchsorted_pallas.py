@@ -1,0 +1,79 @@
+"""Pallas TPU kernel: batched row-wise searchsorted (rank by counting).
+
+The delta backend's hot lookups are "positions of K queries in each
+row's sorted C-wide table" (swim_delta._row_searchsorted).  The XLA
+lowerings each have a failure mode on TPU: ``method="sort"`` pays an
+O(log^2 (C+K)) row sort PLUS a query argsort per call;
+``compare_all`` can materialize the [N, K, C] compare cube to HBM when
+embedded in a large program; ``scan_unrolled`` leans on batched
+take_along_axis gathers of data-dependent positions.
+
+For a *sorted* row the insertion index is just a count:
+
+    pos[k] = #{c : table[c] < q[k]}     (side="left";  <= for "right")
+
+so this kernel tiles rows into VMEM and computes the count as a
+broadcast compare + sum entirely on the VPU — one pass over the table
+block per query block, no sorts, no gathers, and the compare cube only
+ever exists as a [ROWS, K, C] VMEM tile (bounded by the block shape,
+fused by Mosaic).  Traffic is the information-theoretic floor: read
+the tables and queries once, write the positions once.
+
+Bit-parity with jnp.searchsorted is pinned by
+tests/test_searchsorted_pallas.py (interpret mode on CPU), and
+benchmarks/profile_searchsorted.py races it against the XLA lowerings
+on the live backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROW_BLOCK = 256
+
+
+def _kernel(side_is_right: bool, t_ref, q_ref, o_ref):
+    t = t_ref[...]  # [B, C] int32, rows sorted ascending
+    q = q_ref[...]  # [B, K] int32
+    if side_is_right:
+        cmp = t[:, None, :] <= q[:, :, None]  # [B, K, C]
+    else:
+        cmp = t[:, None, :] < q[:, :, None]
+    o_ref[...] = jnp.sum(cmp.astype(jnp.int32), axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("side", "interpret"))
+def row_searchsorted_pallas(
+    table: jax.Array,
+    queries: jax.Array,
+    side: str = "left",
+    interpret: bool = False,
+) -> jax.Array:
+    """int32[N, K] insertion positions of ``queries`` in sorted ``table``
+    rows; exact match for jax.vmap(jnp.searchsorted)(table, queries)."""
+    if side not in ("left", "right"):
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    n, c = table.shape
+    k = queries.shape[1]
+    block = min(ROW_BLOCK, max(8, n))
+    padded = -(-n // block) * block
+    if padded != n:
+        # padding rows never influence real rows (row-independent math)
+        table = jnp.pad(table, ((0, padded - n), (0, 0)))
+        queries = jnp.pad(queries, ((0, padded - n), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_kernel, side == "right"),
+        grid=(padded // block,),
+        in_specs=[
+            pl.BlockSpec((block, c), lambda i: (i, 0)),
+            pl.BlockSpec((block, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded, k), jnp.int32),
+        interpret=interpret,
+    )(table.astype(jnp.int32), queries.astype(jnp.int32))
+    return out[:n]

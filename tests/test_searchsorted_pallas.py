@@ -1,0 +1,39 @@
+"""Bit-parity of the Pallas row-searchsorted against jnp.searchsorted.
+
+Runs the kernel in interpret mode so CPU CI covers it (the scheduled
+on-hardware execution is exercised by benchmarks/profile_searchsorted.py
+and the bench's device kernel checks).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.ops.searchsorted_pallas import row_searchsorted_pallas
+
+SENTINEL = np.iinfo(np.int32).max
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize(
+    "n,c,k",
+    [(7, 16, 5), (64, 64, 16), (130, 32, 64), (256, 8, 3)],
+)
+def test_matches_jnp(side, n, c, k):
+    rng = np.random.default_rng(n * 1000 + c + k)
+    # duplicate-heavy tables with SENTINEL padding, like the delta tables
+    table = np.sort(rng.integers(0, 50, (n, c)), axis=1).astype(np.int32)
+    pad = rng.random((n, c)) < 0.3
+    table = np.sort(np.where(pad, SENTINEL, table), axis=1).astype(np.int32)
+    q = rng.integers(-5, 60, (n, k)).astype(np.int32)
+    q[rng.random((n, k)) < 0.1] = SENTINEL  # query the pad value too
+    want = jax.vmap(
+        lambda ar, vr: jnp.searchsorted(ar, vr, side=side)
+    )(jnp.asarray(table), jnp.asarray(q))
+    got = row_searchsorted_pallas(
+        jnp.asarray(table), jnp.asarray(q), side=side, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

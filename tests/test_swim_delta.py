@@ -424,12 +424,15 @@ def test_upto_prefixes_compile_and_full_matches_default():
         jax.block_until_ready(st.d_subj)
 
 
-@pytest.mark.parametrize("method", ["sort", "scan_unrolled"])
+@pytest.mark.parametrize("method", ["sort", "scan_unrolled", "pallas"])
 def test_wide_lowerings_bit_identical(method, monkeypatch):
-    """Both wide-query searchsorted lowerings (_WIDE_METHOD) trace the
-    same trajectory: the merge lowering stays a tested fallback for
-    hardware where the unrolled bisection regresses."""
+    """Every wide-query searchsorted lowering (_WIDE_METHOD) traces the
+    same trajectory: the non-default choices stay tested fallbacks for
+    hardware where the default regresses.  _WIDE_METHOD is read at
+    trace time, so the module-level jitted steps must be retraced for
+    the monkeypatch to reach them at all."""
     monkeypatch.setattr(sd, "_WIDE_METHOD", method)
+    jax.clear_caches()
     params = sim.SwimParams(loss=0.05, suspicion_ticks=10)
     for t, dense, delta, _, _ in run_both(
         24, 25, params, events=[(0, "kill", 5)]

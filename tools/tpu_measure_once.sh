@@ -36,4 +36,14 @@ say "=== stage 3: headline bench child delta@64:65536"
 timeout 1800 python -u bench.py --child delta@64:65536 >> "$LOG" 2>&1
 say "stage 3 rc=$?"
 
+say "=== stage 4: sparse-vs-dense decision (16k then 32k)"
+timeout 1800 python -u benchmarks/profile_sparse.py 16384 >> "$LOG" 2>&1
+say "stage 4a rc=$?"
+timeout 1800 python -u benchmarks/profile_sparse.py 32768 >> "$LOG" 2>&1
+say "stage 4b rc=$?"
+
+say "=== stage 5: delta scale 262144 (20-tick batches, C=256)"
+timeout 3600 python -u benchmarks/bench_delta_scale.py 262144 20 >> "$LOG" 2>&1
+say "stage 5 rc=$?"
+
 say "done"
