@@ -12,9 +12,10 @@ import jax
 
 sys.path.insert(0, ".")
 
-from ringpop_tpu.utils import pin_cpu_if_requested
+from ringpop_tpu.utils import enable_compilation_cache, pin_cpu_if_requested
 
 pin_cpu_if_requested()
+enable_compilation_cache()
 
 from ringpop_tpu.models import swim_sim as sim
 

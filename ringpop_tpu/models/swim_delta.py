@@ -84,6 +84,7 @@ path regardless of accumulated churn.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -230,9 +231,13 @@ def init_delta(
 # (ops/searchsorted_pallas.py) — cube-free by construction, candidate
 # replacement pending the on-chip race.  Correctness of every choice is
 # pinned by the densified bit-parity suite (tests/test_swim_delta.py
-# runs the grid).
+# runs the grid).  RINGPOP_WIDE_METHOD overrides at import for on-chip
+# A/B of whole compiled steps without a code edit (it is read at trace
+# time, so set it before the process starts).
 _WIDE_QUERY = 4
-_WIDE_METHOD = "scan_unrolled"
+_WIDE_METHOD = os.environ.get("RINGPOP_WIDE_METHOD", "scan_unrolled")
+if _WIDE_METHOD not in ("sort", "scan", "scan_unrolled", "compare_all", "pallas"):
+    raise ValueError(f"RINGPOP_WIDE_METHOD={_WIDE_METHOD!r} is not a lowering")
 
 
 def _row_searchsorted(a: jax.Array, v: jax.Array, side: str = "left") -> jax.Array:

@@ -97,6 +97,7 @@ int32, so relative incarnations must stay below 2**27 (~37 hours of ms) —
 
 from __future__ import annotations
 
+import os
 from typing import Any, NamedTuple
 
 import jax
@@ -757,6 +758,65 @@ def _phase6_expiry(
 
 
 
+# Receiver-merge lowering for dense phase 3.  The scatter form
+# (.at[t_safe].max) is the direct expression, but the receiver indices
+# collide (several senders ping one receiver) so the TPU lowering
+# cannot vectorize it.  The sorted form is exact and scatter-free:
+# sort senders by receiver (a flat [N] argsort), permute the claim
+# rows once, then run a Hillis-Steele max-doubling within equal-
+# receiver runs — the number of [N, N] combine passes is
+# ceil(log2(max inbound pings)) (~4 at 32k), bounded dynamically by a
+# while_loop, and each receiver's merged row is a final row gather at
+# its run start.  RINGPOP_RECV_MERGE picks the form at import; the
+# trajectory-parity grid in tests/test_sim_core.py pins equality.
+_RECV_MERGE = os.environ.get("RINGPOP_RECV_MERGE", "sorted")
+if _RECV_MERGE not in ("sorted", "scatter"):
+    raise ValueError(f"RINGPOP_RECV_MERGE={_RECV_MERGE!r}: sorted|scatter")
+
+
+def _receiver_merge(
+    t_safe: jax.Array, fwd_ok: jax.Array, claim_rows: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(in_key int32[N, N], inbound int32[N]): per-receiver lattice max
+    of the delivered claim rows, and the delivered-ping count."""
+    n = t_safe.shape[0]
+    if _RECV_MERGE == "scatter":
+        in_key = jnp.zeros((n, n), dtype=jnp.int32).at[t_safe].max(claim_rows)
+        inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(
+            fwd_ok.astype(jnp.int32)
+        )
+        return in_key, inbound
+
+    recv = jnp.where(fwd_ok, t_safe, n)  # n sorts silent senders last
+    order = jnp.argsort(recv)
+    recv_s = recv[order]
+    rows_s = claim_rows[order]
+    starts = jnp.searchsorted(recv_s, jnp.arange(n + 1, dtype=jnp.int32))
+    inbound = starts[1:] - starts[:-1]
+    max_run = jnp.max(inbound, initial=1)
+
+    def cond(carry):
+        _, span = carry
+        return span < max_run
+
+    def body(carry):
+        rows_c, span = carry
+        # element i combines with i+span when both are in the same run
+        idx = jnp.minimum(jnp.arange(n, dtype=jnp.int32) + span, n - 1)
+        same = (recv_s[idx] == recv_s) & (
+            jnp.arange(n, dtype=jnp.int32) + span < n
+        )
+        rows_c = jnp.where(
+            same[:, None], jnp.maximum(rows_c, rows_c[idx]), rows_c
+        )
+        return rows_c, span * 2
+
+    rows_s, _ = jax.lax.while_loop(cond, body, (rows_s, jnp.int32(1)))
+    start_c = jnp.minimum(starts[:-1], n - 1)
+    in_key = jnp.where((inbound > 0)[:, None], rows_s[start_c], 0)
+    return in_key, inbound
+
+
 def swim_step_impl(
     state: ClusterState, net: NetState, key: jax.Array, params: SwimParams
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
@@ -806,14 +866,9 @@ def swim_step_impl(
     # delivered[s, j]: sender s issued-and-delivered a claim about j this
     # tick (the anti-echo reference — a pred, not a 4 GB key snapshot).
     delivered = issued_s & fwd_ok[:, None]
-    # scatter-max into receiver rows; concurrent claims merge at the
-    # lattice maximum (documented tick convention).
-    in_key = (
-        jnp.zeros((n, n), dtype=jnp.int32)
-        .at[t_safe]
-        .max(jnp.where(delivered, state.view_key, 0))
+    in_key, inbound = _receiver_merge(
+        t_safe, fwd_ok, jnp.where(delivered, state.view_key, 0)
     )
-    inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(fwd_ok.astype(jnp.int32))
     got_ping = inbound > 0
 
     merged = _merge_incoming(state, in_key, got_ping, sl_start)

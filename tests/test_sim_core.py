@@ -271,3 +271,29 @@ def test_gid_partition_matches_mask_partition():
         np.testing.assert_array_equal(
             np.asarray(st_m.suspect_left), np.asarray(st_g.suspect_left)
         )
+
+
+def test_receiver_merge_forms_trace_identical_trajectories(monkeypatch):
+    """The sorted (sort + run-max doubling) and scatter receiver-merge
+    lowerings produce bit-identical trajectories through kill + loss.
+    _RECV_MERGE is read at trace time, so each form is retraced from a
+    cleared jit cache."""
+    n = 48
+    params = sim.SwimParams(loss=0.05, suspicion_ticks=8)
+    finals = []
+    try:
+        for form in ("sorted", "scatter"):
+            monkeypatch.setattr(sim, "_RECV_MERGE", form)
+            jax.clear_caches()
+            state = sim.init_state(n)
+            net = sim.make_net(n)
+            net = net._replace(up=net.up.at[5].set(False))
+            keys = jax.random.split(jax.random.PRNGKey(9), 30)
+            for t in range(30):
+                state, _ = sim.swim_step(state, net, keys[t], params)
+            finals.append(np.asarray(state.view_key))
+    finally:
+        # the last form's executables must not outlive the restored
+        # module global (later tests would silently run it)
+        jax.clear_caches()
+    np.testing.assert_array_equal(finals[0], finals[1])
