@@ -438,3 +438,42 @@ def test_wide_lowerings_bit_identical(method, monkeypatch):
         24, 25, params, events=[(0, "kill", 5)]
     ):
         assert_matches_dense(delta, dense, t)
+
+
+def test_long_horizon_occupancy_stays_bounded():
+    """200 lossy ticks with a kill and a revive: divergence tables must
+    not leak — after dissemination budgets expire and compact() runs,
+    occupancy returns to the true-divergence floor and stays there."""
+    n = 48
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.02, suspicion_ticks=8),
+        wire_cap=8,
+        claim_grid=16,
+    )
+    state = sd.init_delta(n, capacity=24)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(21)
+    occ_checkpoints = []
+    for t in range(200):
+        if t == 30:
+            net = net._replace(up=net.up.at[7].set(False))
+        if t == 120:
+            inc = int(
+                max(int(jnp.max(state.base_key)), int(jnp.max(state.d_key))) >> 3
+            ) + 10
+            state = sd.revive_and_join(state, 7, inc, seed=1)
+            net = net._replace(up=net.up.at[7].set(True))
+        key, sub = jax.random.split(key)
+        state, m = _delta_step(state, net, sub, params)
+        if t % 50 == 49:
+            state = sd.compact(state)
+            occ_checkpoints.append(int(jnp.max(jnp.sum(
+                (state.d_subj < sd.SENTINEL).astype(jnp.int32), axis=1
+            ))))
+    assert int(m["overflow_drops"]) == 0
+    # post-compact occupancy must not trend upward: only true divergence
+    # from base survives a compact, so a leak shows as growth across
+    # checkpoints; the kill+revive leaves at most a handful of
+    # genuinely divergent subjects
+    assert occ_checkpoints[-1] <= 8, occ_checkpoints
+    assert occ_checkpoints[-1] <= occ_checkpoints[0] + 4, occ_checkpoints
