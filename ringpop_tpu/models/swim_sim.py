@@ -774,6 +774,15 @@ if _RECV_MERGE not in ("sorted", "scatter"):
     raise ValueError(f"RINGPOP_RECV_MERGE={_RECV_MERGE!r}: sorted|scatter")
 
 
+def _inbound_counts(t_safe: jax.Array, fwd_ok: jax.Array) -> jax.Array:
+    """int32[N] delivered-ping count per receiver, scatter-free (sorted
+    receivers + run bounds)."""
+    n = t_safe.shape[0]
+    recv_sorted = jnp.sort(jnp.where(fwd_ok, t_safe, n))
+    bounds = jnp.searchsorted(recv_sorted, jnp.arange(n + 1, dtype=jnp.int32))
+    return bounds[1:] - bounds[:-1]
+
+
 def _receiver_merge(
     t_safe: jax.Array, fwd_ok: jax.Array, claim_rows: jax.Array
 ) -> tuple[jax.Array, jax.Array]:
@@ -1056,29 +1065,82 @@ def _point_merge(
     self_claim = valid & (subj_safe == r_safe)
     normal = valid & (subj_safe != r_safe) & _apply_mask(cur, claim_key)
 
-    vk = state.view_key.at[r_safe, subj_safe].max(jnp.where(normal, claim_key, 0))
+    # The claim points collide (several senders claim one (receiver,
+    # subject) in a tick), and the TPU lowering serializes a scatter it
+    # cannot prove conflict-free.  Route like the delta backend instead:
+    # flat-sort the claims by (receiver, subject), fold each run to its
+    # lattice max with log-step suffix-max doubling, and scatter only
+    # run FIRSTS — masked entries get distinct out-of-bounds rows so
+    # every index is globally unique and mode="drop" discards them.
+    # The apply mask stays evaluated per claim against the pre-merge
+    # view (the documented sparse convention), so the fold preserves
+    # trajectories bit for bit.
+    m = r_safe.size
+    fi = jnp.arange(m, dtype=jnp.int32)
+    fr = jnp.where(valid, r_safe, n).reshape(-1)
+    fs = jnp.where(valid, subj_safe, 0).reshape(-1)
+    v_norm = jnp.where(normal, claim_key, 0).reshape(-1)
+    v_self = jnp.where(self_claim, claim_key, 0).reshape(-1)
+    v_app = normal.reshape(-1).astype(jnp.int32)
+    fr, fs, v_norm, v_self, v_app = jax.lax.sort(
+        (fr, fs, v_norm, v_self, v_app), num_keys=2
+    )
+    # a (receiver, subject) run is a sub-run of its receiver's fr-run,
+    # so the doubling pass count is bounded dynamically by the largest
+    # per-receiver claim count (a couple of passes in realistic ticks),
+    # exactly like _receiver_merge's fold — not by the flat length
+    fr_bounds = jnp.searchsorted(fr, jnp.arange(n + 1, dtype=jnp.int32))
+    max_run = jnp.max(fr_bounds[1:] - fr_bounds[:-1], initial=1)
+
+    def fold_cond(carry):
+        return carry[-1] < max_run
+
+    def fold_body(carry):
+        v_n, v_s, v_a, span = carry
+        idx = jnp.minimum(fi + span, m - 1)
+        same = (fr[idx] == fr) & (fs[idx] == fs) & (fi + span < m)
+        v_n = jnp.where(same, jnp.maximum(v_n, v_n[idx]), v_n)
+        v_s = jnp.where(same, jnp.maximum(v_s, v_s[idx]), v_s)
+        v_a = jnp.where(same, jnp.maximum(v_a, v_a[idx]), v_a)
+        return v_n, v_s, v_a, span * 2
+
+    v_norm, v_self, v_app, _ = jax.lax.while_loop(
+        fold_cond, fold_body, (v_norm, v_self, v_app, jnp.int32(1))
+    )
+    prev_same = (jnp.pad(fr, (1, 0), constant_values=-1)[:-1] == fr) & (
+        jnp.pad(fs, (1, 0), constant_values=-1)[:-1] == fs
+    )
+    first = ~prev_same & (fr < n)
+    # distinct OOB rows for every non-first/invalid entry keep the
+    # index set globally unique (n + fi never collides in int32 here)
+    u_r = jnp.where(first, fr, n + fi)
+    vk = state.view_key.at[u_r, fs].max(
+        v_norm, mode="drop", unique_indices=True
+    )
 
     # Refutation (membership.js:243-254), matching the dense convention:
     # the lattice-maximum self-claim decides; a rumor re-asserts alive.
+    self_first = first & (fs == fr)
     self_key = (
         jnp.zeros((n,), jnp.int32)
-        .at[jnp.where(self_claim, r_safe, n)]
-        .max(jnp.where(self_claim, claim_key, 0), mode="drop")
+        .at[jnp.where(self_first, fr, n + fi)]
+        .max(v_self, mode="drop", unique_indices=True)
     )
     rumor_status = self_key & 7
     refuted = (rumor_status == SUSPECT) | (rumor_status == FAULTY)
     self_inc = jnp.diagonal(state.view_key) >> 3
     new_self_inc = jnp.maximum(self_inc, self_key >> 3) + 1
     vk = vk.at[ids, ids].set(
-        jnp.where(refuted, new_self_inc * 8 + ALIVE, jnp.diagonal(vk))
+        jnp.where(refuted, new_self_inc * 8 + ALIVE, jnp.diagonal(vk)),
+        unique_indices=True,
     )
 
     applied = (
         jnp.zeros((n, n), dtype=bool)
-        .at[r_safe, subj_safe]
-        .max(normal)
+        .at[u_r, fs]
+        .max(v_app > 0, mode="drop", unique_indices=True)
         .at[ids, ids]
-        .max(refuted)
+        .max(refuted, unique_indices=True)
     )
     pb = jnp.where(applied, jnp.int8(0), state.pb)
     new_status = vk & 7
@@ -1140,12 +1202,20 @@ def _swim_step_sparse(
     valid_claim = (subj >= 0) & fwd_ok[:, None]
     # the sent set as a bitmap (anti-echo reference; capped, unlike the
     # dense `delivered`, because only these entries were actually sent)
+    # pad claims (subj < 0, clipped to 0) would collide at column 0;
+    # distinct out-of-bounds columns keep the index pairs unique so the
+    # TPU scatter vectorizes (mode="drop" discards them)
     delivered = (
         jnp.zeros((n, n), dtype=bool)
-        .at[ids[:, None], subj_safe]
-        .max(valid_claim)
+        .at[
+            ids[:, None],
+            jnp.where(
+                subj >= 0, subj_safe, n + jnp.arange(cap, dtype=jnp.int32)[None, :]
+            ),
+        ]
+        .max(valid_claim, mode="drop", unique_indices=True)
     )
-    inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(fwd_ok.astype(jnp.int32))
+    inbound = _inbound_counts(t_safe, fwd_ok)
     got_ping = inbound > 0
 
     r_idx = jnp.broadcast_to(t_safe[:, None], (n, cap))
