@@ -28,10 +28,12 @@ TPU-first design rules (learned from measuring the alternatives):
   lookups: the default "scan" lowers to a serial fori loop of per-row
   gathers (measured 12x slower on a v5e at [65536, 256] tables);
   narrow query sets (<= ``_WIDE_QUERY`` per row) use ``compare_all``
-  (fused compare+sum), wider ones the merge lowering ``method="sort"``
-  — inside the full step program XLA materializes the wide [N, K, C]
-  compare cubes to HBM instead of fusing them (see
-  ``_row_searchsorted``).  Flat 1-D lookups KEEP the default scan:
+  (fused compare+sum) — inside the full step program XLA materializes
+  wide [N, K, C] compare cubes to HBM instead of fusing them, so wider
+  query sets take the ``_WIDE_METHOD`` lowering (default
+  ``scan_unrolled``: log2(C) batched bisection gathers; override with
+  ``RINGPOP_WIDE_METHOD`` — see ``_row_searchsorted``).  Flat 1-D
+  lookups KEEP the default scan:
   ~20 dependent but fully vectorized gather steps, measured 1000x
   cheaper than sorting the concat at [1M] x [65k].  ``jnp.sort`` over
   rows is ~8 ms at [65536, 256] — cheap enough to be the universal
@@ -244,8 +246,11 @@ def _row_searchsorted(a: jax.Array, v: jax.Array, side: str = "left") -> jax.Arr
     if v.shape[-1] > _WIDE_QUERY and _WIDE_METHOD == "pallas":
         from ringpop_tpu.ops.searchsorted_pallas import row_searchsorted_pallas
 
+        # Mosaic kernels only compile for TPU; every other backend
+        # (cpu, gpu, ...) degrades to interpret mode so the env knob
+        # never hard-fails off-TPU.
         return row_searchsorted_pallas(
-            a, v, side=side, interpret=jax.default_backend() == "cpu"
+            a, v, side=side, interpret=jax.default_backend() != "tpu"
         )
     method = "compare_all" if v.shape[-1] <= _WIDE_QUERY else _WIDE_METHOD
     return jax.vmap(
@@ -792,12 +797,47 @@ def _route_claims(
     """Flat-sort claims by (receiver, subject) and realign as an
     [N, grid] per-receiver grid (subjects ascending, duplicate subjects
     merged at the key max).  Returns (subj, key, valid, dropped)."""
-    w = send_subj.shape[1]
-    flat_recv = jnp.where(
-        send_valid, jnp.broadcast_to(recv_of_sender[:, None], (n, w)), n
-    ).reshape(-1)
-    flat_subj = jnp.where(send_valid, send_subj, SENTINEL).reshape(-1)
-    flat_key = jnp.where(send_valid, send_key, 0).reshape(-1)
+    return _route_claims_multi(
+        n, [(send_subj, send_key, send_valid, recv_of_sender)], grid
+    )
+
+
+def _route_claims_multi(
+    n: int,
+    segments: list[tuple[jax.Array, jax.Array, jax.Array, jax.Array]],
+    grid: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """_route_claims over several sender segments in ONE flat sort.
+
+    Each segment is (subj [N, W], key [N, W], valid [N, W], recv [N]) —
+    the phase-5 exchange routes one segment per witness slot, so the
+    whole stage costs a single [len * N * W] sort instead of per-slot
+    sorts + sequential merges (which would also break the one-merge-
+    per-stage convention the dense step pins)."""
+    flat_recv = jnp.concatenate(
+        [
+            jnp.where(
+                valid, jnp.broadcast_to(recv[:, None], subj.shape), n
+            ).reshape(-1)
+            for subj, _, valid, recv in segments
+        ]
+    )
+    flat_subj = jnp.concatenate(
+        [jnp.where(valid, subj, SENTINEL).reshape(-1) for subj, _, valid, _ in segments]
+    )
+    flat_key = jnp.concatenate(
+        [jnp.where(valid, key, 0).reshape(-1) for _, key, valid, _ in segments]
+    )
+    return _route_flat(n, flat_recv, flat_subj, flat_key, grid)
+
+
+def _route_flat(
+    n: int,
+    flat_recv: jax.Array,
+    flat_subj: jax.Array,
+    flat_key: jax.Array,
+    grid: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     flat_recv, flat_subj, flat_key = jax.lax.sort(
         (flat_recv, flat_subj, flat_key), num_keys=2
     )
@@ -843,6 +883,27 @@ def _route_claims(
 # ---------------------------------------------------------------------------
 # the protocol period
 # ---------------------------------------------------------------------------
+
+
+def _stage_issue_delta(
+    st: DeltaState, nserve: jax.Array, maxpb: jax.Array, w: int
+) -> tuple[DeltaState, jax.Array]:
+    """One phase-5 exchange stage's issue bookkeeping (the delta twin of
+    dense _stage_issue, plus the wire window): a node serving ``nserve``
+    requests issues its first ``w`` active in-budget changes, advances
+    served counters by ``nserve``, evicts past the budget; past-window
+    entries keep their budget (the phase-2 rule).  Returns
+    (state, within bool[N, C])."""
+    has = st.d_pb >= 0
+    ns8 = jnp.minimum(nserve, 127).astype(jnp.int8)[:, None]
+    issuable = has & (ns8 > 0) & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
+    within = issuable & (jnp.cumsum(issuable.astype(jnp.int32), axis=1) <= w)
+    served = has & (ns8 > 0) & ~(issuable & ~within)
+    evict = served & (st.d_pb > maxpb[:, None] - ns8)
+    d_pb = jnp.where(
+        evict, jnp.int8(-1), jnp.where(served, st.d_pb + ns8, st.d_pb)
+    )
+    return st._replace(d_pb=d_pb), within
 
 
 def delta_step_impl(
@@ -1048,28 +1109,150 @@ def delta_step_impl(
     if upto <= 4:
         return cut(state, _t=ack_applied)
 
-    # -- phase 5: ping-req two-hop reachability -> suspect ------------------
+    # -- phase 5: ping-req relay with the piggyback exchange ----------------
+    # Hop deliveries and the four stage merges mirror the dense
+    # _phase5_pingreq exactly (same k_a..k_d draw shapes, same stage
+    # conventions — see its docstring); the routed-claim form adds only
+    # the wire window (past-window entries keep budget, phase-2 rule)
+    # and the claim-grid bound, both ample-cap-invisible.
     failed = sends & ~ack
     k_a, k_b, k_c, k_d = jax.random.split(k_loss3, 4)
     kshape = (n, sw.ping_req_size)
+    kk = sw.ping_req_size
     wit_safe = jnp.clip(wit, 0, n - 1)
-    req_ok = (
+    req_del = (
         failed[:, None]
         & wit_valid
         & ~_drop(k_a, kshape, sw.loss)
         & resp[wit_safe]
     )
-    wt_ok = (
-        req_ok
-        & ~_drop(k_b, kshape, sw.loss)
-        & resp[t_safe][:, None]
-        & ~_drop(k_c, kshape, sw.loss)
-    )
-    relay_ok = ~_drop(k_d, kshape, sw.loss)
-    any_success = jnp.any(wt_ok & relay_ok, axis=1)
-    definite_fail = jnp.any(req_ok & ~wt_ok & relay_ok, axis=1)
+    ping_del = req_del & ~_drop(k_b, kshape, sw.loss) & resp[t_safe][:, None]
+    ack_del = ping_del & ~_drop(k_c, kshape, sw.loss)
+    resp_del = req_del & ~_drop(k_d, kshape, sw.loss)
+    any_success = jnp.any(ack_del & resp_del, axis=1)
+    definite_fail = jnp.any(req_del & ~ack_del & resp_del, axis=1)
     declare_suspect = failed & ~any_success & definite_fail
 
+    def _role_counts(recv2d: jax.Array, mask2d: jax.Array) -> jax.Array:
+        """int32[N] delivered-request count per receiver over all slots
+        (sort + run bounds; the delta twin of dense _slot_counts)."""
+        flat = jnp.sort(jnp.where(mask2d, recv2d, n).reshape(-1))
+        s_, e_ = _run_bounds(flat, n)
+        return (e_ - s_).astype(jnp.int32)
+
+    def exchange(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
+        applied = jnp.int32(0)
+        late = jnp.int32(0)
+        nreq = jnp.sum(failed[:, None] & wit_valid, axis=1, dtype=jnp.int32)
+        nsrv = _role_counts(wit_safe, req_del)
+
+        # -- 5a: the ping-req body carries the source's changes ---------
+        st, win_a = _stage_issue_delta(st, nreq, maxpb, w)
+        sa_subj, sa_key = _windowed_changes(st, win_a, w)
+        segs = [
+            (
+                sa_subj,
+                sa_key,
+                (sa_subj < SENTINEL) & req_del[:, m][:, None],
+                wit_safe[:, m],
+            )
+            for m in range(kk)
+        ]
+        g = _route_claims_multi(n, segs, params.claim_grid)
+        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
+        st, applied, late = out.state, applied + out.applied_points, late + g[3]
+
+        # -- 5b: the witness relay-pings the target with its changes ----
+        st, win_b = _stage_issue_delta(st, nsrv, maxpb, w)
+        sb_subj, sb_key = _windowed_changes(st, win_b, w)
+        nping_del = _role_counts(wit_safe, ping_del)
+        ntgt = _role_counts(jnp.broadcast_to(t_safe[:, None], kshape), ping_del)
+        segs = [
+            (
+                sb_subj[wit_safe[:, m]],
+                sb_key[wit_safe[:, m]],
+                (sb_subj[wit_safe[:, m]] < SENTINEL) & ping_del[:, m][:, None],
+                t_safe,
+            )
+            for m in range(kk)
+        ]
+        g = _route_claims_multi(n, segs, params.claim_grid)
+        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
+        st, applied, late = out.state, applied + out.applied_points, late + g[3]
+        # the witness's delivered set (5c anti-echo): its windowed list,
+        # where it made at least one delivered relay ping
+        wit_sent_subj = jnp.where((nping_del > 0)[:, None], sb_subj, SENTINEL)
+
+        # -- 5c: the target's ack carries its changes back --------------
+        st, win_c = _stage_issue_delta(st, ntgt, maxpb, w)
+        sc_subj, sc_key = _windowed_changes(st, win_c, w)
+        segs = []
+        for m in range(kk):
+            w_m = wit_safe[:, m]
+            subj = sc_subj[t_safe]
+            key_c = sc_key[t_safe]
+            subj_q = jnp.where(subj < SENTINEL, subj, 0)
+            # anti-echo: the witness delivered this subject in 5b and its
+            # current belief equals the claim
+            _, in_sent = _lookup_pos(wit_sent_subj[w_m], subj_q)
+            pos_w, found_w = _lookup_pos(st.d_subj[w_m], subj_q)
+            cur_w = jnp.where(
+                found_w,
+                jnp.take_along_axis(st.d_key[w_m], pos_w, axis=1),
+                st.base_key[subj_q],
+            )
+            echo = in_sent & (key_c == cur_w)
+            segs.append(
+                (
+                    subj,
+                    key_c,
+                    (subj < SENTINEL) & ack_del[:, m][:, None] & ~echo,
+                    w_m,
+                )
+            )
+        g = _route_claims_multi(n, segs, params.claim_grid)
+        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
+        st, applied, late = out.state, applied + out.applied_points, late + g[3]
+
+        # -- 5d: the witness response carries its (fresh) changes -------
+        # issue set from the post-5c state: what the witness just learned
+        # from the target ships straight back — the implicit-alive path
+        st, win_d = _stage_issue_delta(st, nsrv, maxpb, w)
+        sd_subj, sd_key = _windowed_changes(st, win_d, w)
+        src_sent_subj = jnp.where(
+            jnp.any(req_del, axis=1)[:, None], sa_subj, SENTINEL
+        )
+        segs = []
+        for m in range(kk):
+            w_m = wit_safe[:, m]
+            subj = sd_subj[w_m]
+            key_d = sd_key[w_m]
+            subj_q = jnp.where(subj < SENTINEL, subj, 0)
+            _, in_sent = _lookup_pos(src_sent_subj, subj_q)
+            cur_s = view_lookup(st, subj_q)
+            echo = in_sent & (key_d == cur_s)
+            segs.append(
+                (
+                    subj,
+                    key_d,
+                    (subj < SENTINEL) & resp_del[:, m][:, None] & ~echo,
+                    ids,
+                )
+            )
+        g = _route_claims_multi(n, segs, params.claim_grid)
+        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
+        st, applied, late = out.state, applied + out.applied_points, late + g[3]
+        return st, applied, late
+
+    def no_exchange(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
+        return st, jnp.int32(0), jnp.int32(0)
+
+    state, pingreq_applied, pingreq_late = jax.lax.cond(
+        jnp.any(req_del), exchange, no_exchange, state
+    )
+    claims_dropped = claims_dropped + pingreq_late
+
+    # the declaration sees the post-exchange view (dense convention)
     cur_t = view_lookup(state, t_safe)
     dec_key = jnp.where(cur_t > 0, (cur_t >> 3) * 8 + SUSPECT, 0)
     dec_valid = declare_suspect & (t_safe != ids)
@@ -1106,6 +1289,7 @@ def delta_step_impl(
         "ack_changes_applied": ack_applied,
         "full_syncs": jnp.sum(full_sync, dtype=jnp.int32),
         "ping_reqs": jnp.sum(failed, dtype=jnp.int32),
+        "pingreq_changes_applied": pingreq_applied,
         "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
         "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
         "claims_dropped": claims_dropped,

@@ -80,13 +80,16 @@ defined order):
 * The piggyback budget and the probe-target/witness pool are computed
   from the period-start view (the reference recomputes the budget on ring
   change mid-period; one-tick lag, convergence-neutral).
-* The ping-req path probes reachability only; its piggyback exchange is
-  omitted.  Measured deviation bound (benchmarks/bench_pingreq_deviation.py,
-  kill-detection latency vs the host library, which implements the full
-  exchange): sim/host mean 0.99 at 1% loss / 0.95 at 5% loss at n=256
-  (0.96 / 0.91 at n=8) — dissemination is dominated by the regular ping
-  piggyback, and the tick model compresses ping+ping-req into one
-  period, offsetting the omitted witness-side exchange.
+* The ping-req path carries the full piggyback exchange at all four
+  hops (source->witness, witness->target, target->witness,
+  witness->source — ping-req-sender.js:80-86,138,
+  ping-req-handler.js:37-59), as four sequential stage merges inside
+  the probing tick; see ``_phase5_pingreq`` for the stage conventions
+  (one issue set per stage, counters advance by requests served,
+  anti-echo on the reply hops, no full-sync inside the relay).
+  ``benchmarks/bench_pingreq_deviation.py`` pins kill-detection-latency
+  agreement with the host library (which runs the same exchange over
+  real sockets) as a regression check.
 
 Incarnation numbers are stored as non-negative int32 offsets from a
 host-side base (``SimCluster`` keeps the absolute int ms base) so all
@@ -695,6 +698,35 @@ def _phase01_select(
     )
 
 
+class _PingReq(NamedTuple):
+    """Phase-5 results (dense/sparse shared)."""
+
+    state: ClusterState
+    failed: jax.Array  # bool[N]
+    declare_suspect: jax.Array  # bool[N]
+    declared: jax.Array  # bool[N]
+    was_alive_at_target: jax.Array  # bool[N]
+    changes_applied: jax.Array  # int32[] — exchange merges, all 4 stages
+    flapped: jax.Array  # bool[N, N] | bool[] — exchange flaps (damping)
+
+
+def _stage_issue(
+    st: ClusterState, nserve: jax.Array, maxpb8: jax.Array
+) -> tuple[ClusterState, jax.Array]:
+    """One exchange stage's issue bookkeeping (the phase-4 convention):
+    a node serving ``nserve`` requests issues its active in-budget
+    changes once (all peers of the stage see the same set), advances
+    each issued counter by ``nserve``, and evicts past the budget.
+    Returns (state, issued bool[N, N])."""
+    has = st.pb >= 0
+    ns8 = jnp.minimum(nserve, 127).astype(jnp.int8)[:, None]
+    issued = has & (ns8 > 0) & (st.pb + jnp.int8(1) <= maxpb8)
+    served = has & (ns8 > 0)
+    evict = served & (st.pb > maxpb8 - ns8)
+    pb = jnp.where(evict, jnp.int8(-1), jnp.where(served, st.pb + ns8, st.pb))
+    return st._replace(pb=pb), issued
+
+
 def _phase5_pingreq(
     state: ClusterState,
     net: NetState,
@@ -703,10 +735,35 @@ def _phase5_pingreq(
     ack: jax.Array,
     sl_start: int,
     params: SwimParams,
-) -> tuple[ClusterState, jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Phase 5: failed probes -> ping-req two-hop -> suspect
-    (ping-req-sender.js).  Returns (state, failed, declare_suspect,
-    declared, was_alive_at_target)."""
+) -> _PingReq:
+    """Phase 5: failed probes -> ping-req relay with the full piggyback
+    exchange -> suspect (ping-req-sender.js, ping-req-handler.js).
+
+    The reference's relay applies membership changes at all four hops:
+    the witness applies the source's changes (ping-req-handler.js:37),
+    the target applies the witness's ping changes and replies with its
+    own (ping-handler.js:34-39 via the handler's sendPing), the witness
+    applies the target's reply (ping-req-handler.js:49-50), and the
+    source applies every witness response (ping-req-sender.js:138) —
+    reachability is then proven *implicitly* by those piggybacked
+    updates (ping-req-sender.js:201-204).  Tick-model conventions
+    (mirroring phase 4's receiver convention):
+
+    * Each stage computes ONE issue set from its entry state; all peers
+      of the stage receive that same set; counters advance by the
+      number of requests attempted/served; eviction past the budget.
+    * Slot claims fold by lattice max into a single merge per stage
+      (the reference applies witness responses in arrival order; both
+      end at the lattice maximum).
+    * Reply stages apply the value-form anti-echo (drop claims equal to
+      what the peer provably already delivered this stage).
+    * The relay's inner ping omits the full-sync fallback — regular
+      pings (phase 4) repair checksum divergence; the relay only
+      carries changes.
+
+    The exchange runs under ``lax.cond``: a tick with every probe acked
+    pays nothing for it.
+    """
     n = state.n
     ids = jnp.arange(n, dtype=jnp.int32)
     resp = net.up & net.responsive
@@ -715,33 +772,166 @@ def _phase5_pingreq(
     k_a, k_b, k_c, k_d = jax.random.split(k_loss3, 4)
     kshape = (n, params.ping_req_size)
     wit_safe = jnp.clip(sel.wit, 0, n - 1)
-    req_ok = (
+    # hop deliveries: source->witness request, witness->target ping,
+    # target->witness ack, witness->source response
+    req_del = (
         failed[:, None]
         & sel.wit_valid
         & _adj(net, ids[:, None], wit_safe)
         & ~_drop(k_a, kshape, params.loss)
         & resp[wit_safe]
     )
-    wt_ok = (
-        req_ok
+    ping_del = (
+        req_del
         & _adj(net, wit_safe, t_safe[:, None])
         & ~_drop(k_b, kshape, params.loss)
         & resp[t_safe][:, None]
+    )
+    ack_del = (
+        ping_del
         & _adj(net, t_safe[:, None], wit_safe)
         & ~_drop(k_c, kshape, params.loss)
     )
-    relay_ok = jnp.broadcast_to(
-        _adj(net, wit_safe, ids[:, None]) & ~_drop(k_d, kshape, params.loss), kshape
+    resp_del = (
+        req_del
+        & _adj(net, wit_safe, ids[:, None])
+        & ~_drop(k_d, kshape, params.loss)
     )
-    any_success = jnp.any(wt_ok & relay_ok, axis=1)
+    any_success = jnp.any(ack_del & resp_del, axis=1)
     # all witnesses answered "target unreachable" and none succeeded ->
     # suspect (ping-req-sender.js:238-267); no witness response at all is
     # inconclusive (:268-282)
-    definite_fail = jnp.any(req_ok & ~wt_ok & relay_ok, axis=1)
+    definite_fail = jnp.any(req_del & ~ack_del & resp_del, axis=1)
     declare_suspect = failed & ~any_success & definite_fail
+
+    maxpb8 = sel.maxpb8
+    kk = params.ping_req_size
+    damp_on = state.damp is not None
+
+    def _slot_counts(recv_idx: jax.Array, masks: jax.Array) -> jax.Array:
+        """int32[N]: delivered-request count per receiver over all slots."""
+        total = jnp.zeros((n,), jnp.int32)
+        for m in range(kk):
+            total = total + _inbound_counts(recv_idx[:, m], masks[:, m])
+        return total
+
+    def exchange(st: ClusterState):
+        applied_total = jnp.int32(0)
+        flapped = (
+            jnp.zeros((n, n), dtype=bool) if damp_on else jnp.zeros((), dtype=bool)
+        )
+
+        # -- 5a: the ping-req body carries the source's changes ----------
+        nreq = jnp.sum(failed[:, None] & sel.wit_valid, axis=1, dtype=jnp.int32)
+        st, issue_src = _stage_issue(st, nreq, maxpb8)
+        claims_src = jnp.where(issue_src, st.view_key, 0)
+        deliv_src = issue_src & jnp.any(req_del, axis=1)[:, None]
+        nsrv = _slot_counts(wit_safe, req_del)
+        in_a = jnp.zeros((n, n), jnp.int32)
+        for m in range(kk):
+            slot_in, _ = _receiver_merge(
+                wit_safe[:, m],
+                req_del[:, m],
+                jnp.where(req_del[:, m][:, None], claims_src, 0),
+            )
+            in_a = jnp.maximum(in_a, slot_in)
+        mrg = _merge_incoming(st, in_a, nsrv > 0, sl_start)
+        st = mrg.state
+        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
+        flapped = flapped | mrg.flapped
+        st, applied_total = jax.lax.optimization_barrier((st, applied_total))
+
+        # -- 5b: the witness relay-pings the target with its changes -----
+        st, issue_wit = _stage_issue(st, nsrv, maxpb8)
+        claims_wit = jnp.where(issue_wit, st.view_key, 0)
+        nping_del = _slot_counts(wit_safe, ping_del)
+        deliv_wit = issue_wit & (nping_del > 0)[:, None]
+        ntgt = _slot_counts(
+            jnp.broadcast_to(t_safe[:, None], kshape), ping_del
+        )
+        in_b = jnp.zeros((n, n), jnp.int32)
+        for m in range(kk):
+            slot_in, _ = _receiver_merge(
+                t_safe,
+                ping_del[:, m],
+                jnp.where(
+                    ping_del[:, m][:, None], claims_wit[wit_safe[:, m]], 0
+                ),
+            )
+            in_b = jnp.maximum(in_b, slot_in)
+        mrg = _merge_incoming(st, in_b, ntgt > 0, sl_start)
+        st = mrg.state
+        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
+        flapped = flapped | mrg.flapped
+        st, applied_total = jax.lax.optimization_barrier((st, applied_total))
+
+        # -- 5c: the target's ack carries its changes back ----------------
+        st, issue_tgt = _stage_issue(st, ntgt, maxpb8)
+        claims_tgt = jnp.where(issue_tgt, st.view_key, 0)
+        nwit_ack = _slot_counts(wit_safe, ack_del)
+        in_c = jnp.zeros((n, n), jnp.int32)
+        for m in range(kk):
+            w_m = wit_safe[:, m]
+            rows = claims_tgt[t_safe]
+            # anti-echo: drop claims equal to what the witness itself
+            # delivered to this target in 5b
+            echo = deliv_wit[w_m] & (rows == st.view_key[w_m])
+            slot_in, _ = _receiver_merge(
+                w_m,
+                ack_del[:, m],
+                jnp.where(ack_del[:, m][:, None] & ~echo, rows, 0),
+            )
+            in_c = jnp.maximum(in_c, slot_in)
+        mrg = _merge_incoming(st, in_c, nwit_ack > 0, sl_start)
+        st = mrg.state
+        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
+        flapped = flapped | mrg.flapped
+        st, applied_total = jax.lax.optimization_barrier((st, applied_total))
+
+        # -- 5d: the witness response carries its (fresh) changes ---------
+        # issue set from the post-5c state: what the witness just learned
+        # from the target (pb 0) ships here — the implicit-alive path
+        st, issue_wit2 = _stage_issue(st, nsrv, maxpb8)
+        claims_wit2 = jnp.where(issue_wit2, st.view_key, 0)
+        any_resp = jnp.any(resp_del, axis=1)
+        in_d = jnp.zeros((n, n), jnp.int32)
+        for m in range(kk):
+            rows = claims_wit2[wit_safe[:, m]]
+            echo = deliv_src & (rows == st.view_key)
+            in_d = jnp.maximum(
+                in_d,
+                jnp.where(resp_del[:, m][:, None] & ~echo, rows, 0),
+            )
+        mrg = _merge_incoming(st, in_d, any_resp, sl_start)
+        st = mrg.state
+        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
+        flapped = flapped | mrg.flapped
+        return st, applied_total, flapped
+
+    def no_exchange(st: ClusterState):
+        return (
+            st,
+            jnp.int32(0),
+            jnp.zeros((n, n), dtype=bool) if damp_on else jnp.zeros((), dtype=bool),
+        )
+
+    state, xch_applied, xch_flapped = jax.lax.cond(
+        jnp.any(req_del), exchange, no_exchange, state
+    )
+
+    # the declaration sees the post-exchange view (the reference's
+    # makeSuspect runs after every witness response was applied)
     was_alive_at_target = (state.view_key[ids, t_safe] & 7) == ALIVE
     state, declared = _declare(state, declare_suspect, t_safe, SUSPECT, sl_start)
-    return state, failed, declare_suspect, declared, was_alive_at_target
+    return _PingReq(
+        state,
+        failed,
+        declare_suspect,
+        declared,
+        was_alive_at_target,
+        xch_applied,
+        xch_flapped,
+    )
 
 
 def _phase6_expiry(
@@ -926,9 +1116,10 @@ def swim_step_impl(
     ack_applied = jnp.sum(merged2.applied, dtype=jnp.int32)
 
     # -- phase 5: ping-req for failed probes --------------------------------
-    state, failed, declare_suspect, declared, was_alive_at_target = _phase5_pingreq(
-        state, net, k_loss3, sel, ack, sl_start, params
-    )
+    pr = _phase5_pingreq(state, net, k_loss3, sel, ack, sl_start, params)
+    state = pr.state
+    failed, declare_suspect = pr.failed, pr.declare_suspect
+    declared, was_alive_at_target = pr.declared, pr.was_alive_at_target
 
     # -- phase 6: suspicion countdowns fire -> faulty -----------------------
     state, expired = _phase6_expiry(state, gossiping)
@@ -936,7 +1127,7 @@ def swim_step_impl(
     # -- damping extension (active only with damp tensors present) ----------
     n_damped = jnp.int32(0)
     if state.damp is not None:
-        flaps = merged.flapped | merged2.flapped
+        flaps = merged.flapped | merged2.flapped | pr.flapped
         # a viewer that itself declares alive->suspect flaps too (the host
         # library scores these via the membership 'updated' event)
         declare_flap = declared & was_alive_at_target
@@ -961,6 +1152,7 @@ def swim_step_impl(
         "ack_changes_applied": ack_applied,
         "full_syncs": jnp.sum(full_sync, dtype=jnp.int32),
         "ping_reqs": jnp.sum(failed, dtype=jnp.int32),
+        "pingreq_changes_applied": pr.changes_applied,
         "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
         "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
         "damped_pairs": n_damped,
@@ -1286,9 +1478,8 @@ def _swim_step_sparse(
     )
 
     # -- phase 5: ping-req (shared with the dense step) ---------------------
-    state, failed, declare_suspect, _, _ = _phase5_pingreq(
-        state, net, k_loss3, sel, ack, sl_start, params
-    )
+    pr = _phase5_pingreq(state, net, k_loss3, sel, ack, sl_start, params)
+    state, failed, declare_suspect = pr.state, pr.failed, pr.declare_suspect
 
     # -- phase 6: suspicion countdowns (shared) -----------------------------
     state, expired = _phase6_expiry(state, gossiping)
@@ -1301,6 +1492,7 @@ def _swim_step_sparse(
         "ack_changes_applied": ack_applied,
         "full_syncs": jnp.sum(full_sync, dtype=jnp.int32),
         "ping_reqs": jnp.sum(failed, dtype=jnp.int32),
+        "pingreq_changes_applied": pr.changes_applied,
         "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
         "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
         "damped_pairs": jnp.int32(0),

@@ -59,7 +59,10 @@ def row_searchsorted_pallas(
         raise ValueError(f"side must be 'left' or 'right', got {side!r}")
     n, c = table.shape
     k = queries.shape[1]
-    block = min(ROW_BLOCK, max(8, n))
+    # Row count rounded up to a multiple of 8 keeps the block shape
+    # sublane-aligned — Mosaic may reject odd row blocks (e.g. 130) on
+    # real TPU even though interpret mode accepts them.
+    block = min(ROW_BLOCK, -(-max(8, n) // 8) * 8)
     padded = -(-n // block) * block
     if padded != n:
         # padding rows never influence real rows (row-independent math)

@@ -98,8 +98,33 @@ def enable_compilation_cache() -> None:
         cache_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
             ".jax_cache",
+            _host_cpu_tag(),
         )
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
     except Exception as e:  # noqa: BLE001 — the cache is an optimization only
         print(f"# compilation cache unavailable: {e!r}", file=sys.stderr)
+
+
+def _host_cpu_tag() -> str:
+    """Cache subdirectory keyed by the host CPU identity.
+
+    CPU executables embed host ISA extensions; loading one cached by a
+    different machine trips JAX's feature-mismatch warning ("could lead
+    to SIGILL").  Keying the directory per (arch, cpu model) makes
+    cross-machine reuse structurally impossible while TPU executables
+    (keyed the same way) still hit whenever the same host re-runs."""
+    import hashlib
+    import platform
+
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("model name", "hardware", "cpu model")):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    raw = f"{platform.machine()}|{model}"
+    return f"host-{hashlib.sha1(raw.encode()).hexdigest()[:12]}"
