@@ -1,0 +1,132 @@
+"""BASELINE config 4 on the delta backend: 50/50 netsplit -> heal ->
+one checksum group, at sizes the dense backend cannot reach.
+
+The netsplit uses the int32[N] group-id adjacency (swim_sim._adj) — the
+only partition form the delta step takes.  A netsplit's *transition* is
+dense by construction (every viewer accumulates other-side
+suspicion/faulty records, peak divergence ~N/2 per viewer), so
+``capacity`` is sized N/2 + slack: at 32,768 nodes the state fits one
+16 GB chip (5.4 GB); 65,536 (21.5 GB) runs on the host or the
+row-sharded mesh.
+
+Two merge paths, both reference-faithful:
+
+* heal mid-transition (default): cross-side members still suspect are
+  still pingable, so probes cross the healed link, checksums mismatch,
+  full syncs + refutations remerge the views spontaneously.
+* bridge join: if the split fully converged (all cross-entries faulty,
+  no cross-probing — the reference behaves identically: faulty members
+  are not pingable, membership.js:135-139), a single admin rejoin
+  bridges the sides (admin-join-handler.js:36-45 — the operational
+  merge path; tick-cluster's 'j').  Used automatically if the sim
+  stalls at 2 checksum groups.
+
+Usage: python benchmarks/bench_partition_heal_delta.py [n] [--heal-at T]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(
+    n: int = 4096,
+    loss: float = 0.0,
+    suspicion_ticks: int = 8,
+    heal_at: int | None = None,
+    capacity: int | None = None,
+    max_heal_ticks: int = 800,
+    check_every: int = 5,
+) -> list[dict]:
+    from ringpop_tpu.models import swim_delta as sd
+    from ringpop_tpu.models import swim_sim as sim
+    from ringpop_tpu.models.cluster import SimCluster
+
+    # Peak divergence is ~n per viewer, not n/2: the post-heal
+    # refutation storm bumps EVERY member's incarnation (both sides
+    # held the other faulty, every subject refutes on hearing it), so
+    # every column diverges from the pre-split base until rebase folds
+    # the re-converged columns back in (the periodic rebase below).
+    capacity = capacity or (n + 64)
+    params = sim.SwimParams(loss=loss, suspicion_ticks=suspicion_ticks)
+    # Storm-grade wire: the post-heal refutation wave refreshes ~n
+    # entries per viewer; the rotating wire window cycles the backlog in
+    # ~capacity/wire_cap-tick rounds, so wire 64 keeps the remerge in
+    # the low hundreds of ticks without blowing up the routed-sort cost.
+    cluster = SimCluster(
+        n,
+        params,
+        seed=4,
+        backend="delta",
+        capacity=capacity,
+        wire_cap=64,
+        claim_grid=512,
+    )
+    cluster.tick(2)  # warm up / compile
+
+    half = n // 2
+    sides = [list(range(half)), list(range(half, n))]
+    cluster.partition(sides)
+    # Heal mid-transition: suspicion has begun everywhere (the rumor
+    # front saturates in ~log2(n) ticks) but cross-side suspects are
+    # still pingable, so the healed link carries probes again.
+    split_ticks = heal_at if heal_at is not None else suspicion_ticks + 4
+    t0 = time.perf_counter()
+    cluster.tick(split_ticks)
+    groups_at_heal = len(cluster.checksum_groups())
+
+    cluster.heal_partition()
+    heal_ticks = 0
+    bridged = False
+    while heal_ticks < max_heal_ticks:
+        cluster.tick(check_every)
+        heal_ticks += check_every
+        if heal_ticks % 20 == 0:
+            # fold re-converged columns back into the base so the
+            # divergence tables drain as the merge progresses
+            cluster.rebase()
+        if cluster.converged():
+            break
+        if not bridged and heal_ticks >= 8 * suspicion_ticks:
+            groups = cluster.checksum_groups()
+            if len(groups) == 2:
+                # fully-converged split-brain: no cross-probing remains
+                # (faulty members are not pingable) — bridge with one
+                # admin rejoin, the reference's operational merge path
+                cluster.join(half, 0)
+                bridged = True
+    wall = time.perf_counter() - t0
+    groups = cluster.checksum_groups()
+    m = cluster.metrics_log[-1] if cluster.metrics_log else {}
+    return [
+        {
+            "metric": f"delta_partition_heal_n{n}",
+            "value": heal_ticks,
+            "unit": "ticks_to_remerge",
+            "split_ticks": split_ticks,
+            "groups_at_heal": groups_at_heal,
+            "bridged": bridged,
+            "wall_s": round(wall, 3),
+            "capacity": capacity,
+            "overflow_drops": int(m.get("overflow_drops", 0)),
+            "checksum_groups": len(groups),
+            "converged": cluster.converged(),
+        }
+    ]
+
+
+if __name__ == "__main__":
+    from ringpop_tpu.utils import enable_compilation_cache, pin_cpu_if_requested
+
+    pin_cpu_if_requested()
+    enable_compilation_cache()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    heal_at = None
+    if "--heal-at" in sys.argv:
+        heal_at = int(sys.argv[sys.argv.index("--heal-at") + 1])
+    for row in run(n, heal_at=heal_at):
+        print(row)

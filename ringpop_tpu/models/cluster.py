@@ -61,18 +61,16 @@ class SimCluster:
         """``backend='dense'``: the N x N state (swim_sim.py) — every
         scenario incl. partitions and mode='self' bootstrap.
         ``backend='delta'``: the O(N * C) delta-from-base state
-        (swim_delta.py) — converged-start scenarios with bounded
-        divergence (loss/kill/suspend/join/leave churn) at 65k+ nodes
-        per chip; ``capacity``/``wire_cap``/``claim_grid`` are its
-        resource caps."""
+        (swim_delta.py) — bounded-divergence scenarios (loss/kill/
+        suspend/join/leave churn) at 65k+ nodes per chip, plus group-id
+        netsplits and init='self' bootstraps when ``capacity`` is sized
+        for their ~n-wide transitions;
+        ``capacity``/``wire_cap``/``claim_grid`` are its resource
+        caps."""
         if backend not in ("dense", "delta"):
             raise ValueError(f"unknown backend: {backend!r}")
-        if backend == "delta" and (damping or init != "converged"):
-            raise ValueError(
-                "the delta backend starts from a converged base (its "
-                "divergence tables cannot bound a dense bootstrap) and "
-                "does not support damping tensors"
-            )
+        if backend == "delta" and damping:
+            raise ValueError("the delta backend does not support damping tensors")
         if backend == "delta" and params.sparse_cap:
             raise ValueError(
                 "sparse_cap is a dense-backend knob; the delta backend "
@@ -92,7 +90,7 @@ class SimCluster:
         ).astype(np.int32)
         if backend == "delta":
             self.state: Any = sdelta.init_delta(
-                n, jnp.asarray(rel), capacity=capacity
+                n, jnp.asarray(rel), capacity=capacity, mode=init
             )
         else:
             self.state = sim.init_state(
@@ -313,15 +311,25 @@ class SimCluster:
             self.state = sim.admin_leave(self.state, i)
 
     def partition(self, groups: Sequence[Sequence[int]]) -> None:
-        """Disconnect the given groups from each other (block adjacency)."""
-        if self.backend == "delta":
-            raise NotImplementedError(
-                "partitions need the dense backend: a netsplit diverges "
-                "densely by construction (swim_delta.py scope note)"
-            )
+        """Disconnect the given groups from each other (block adjacency).
+
+        Full-coverage partitions (every node in some group) take the
+        int32[N] group-id form — O(N) memory, the only form the delta
+        backend accepts (its step evaluates connectivity at gathered
+        index pairs; a bool[N, N] mask would reintroduce the N^2 it
+        exists to avoid).  Partial groupings (ungrouped nodes stay
+        connected to everyone) need the dense mask form."""
         gid = np.full(self.n, -1, dtype=np.int32)
         for g, members in enumerate(groups):
             gid[np.asarray(members, dtype=np.int32)] = g
+        if (gid >= 0).all():
+            self.net = self.net._replace(adj=jnp.asarray(gid))
+            return
+        if self.backend == "delta":
+            raise NotImplementedError(
+                "delta-backend partitions must cover every node (group-id "
+                "adjacency); partial groupings need the dense mask form"
+            )
         same = (gid[:, None] == gid[None, :]) | (gid[:, None] < 0) | (gid[None, :] < 0)
         self.net = self.net._replace(adj=jnp.asarray(same))
 

@@ -63,7 +63,9 @@ resource semantics, each surfaced in ``metrics``:
 
 * a ping/ack carries at most ``wire_cap`` changes (entries past the
   window neither bump nor evict their piggyback counter — they ship on
-  later pings), mirroring SwimParams.sparse_cap;
+  later pings; the window start rotates by tick so a backlog wider
+  than the wire cycles fairly, ``_rotating_window``), mirroring
+  SwimParams.sparse_cap;
 * a receiver consumes at most ``claim_grid`` distinct claims per tick
   (rest dropped = late packets; ``claims_dropped``);
 * a viewer tracks at most ``capacity`` divergent subjects (insertions
@@ -72,10 +74,16 @@ resource semantics, each surfaced in ``metrics``:
 
 Scope: scenarios whose divergence is bounded — steady state, loss,
 kills, suspends, joins/leaves, bounded flaps (the BASELINE config 3/5
-family and the 65k north star).  A 50/50 netsplit diverges densely by
-construction (every pair disagrees across the cut); use the dense
-backend and its row-sharded mesh path for that (BASELINE config 4).
-Bootstrapping N nodes from mode='self' is likewise inherently dense.
+family and the 65k north star) — plus block netsplits via the int32[N]
+group-id form of ``NetState.adj`` (connected iff same group; dense
+bool[N, N] masks stay dense-only).  A 50/50 netsplit's *transition* is
+dense by construction — every viewer accumulates other-side
+suspicion/faulty records, so peak per-viewer divergence reaches ~N/2
+and ``capacity`` must be sized for it (state 10 * N * (N/2 + slack)
+bytes: 32k fits one 16 GB chip, 65k needs the row-sharded mesh path or
+a capacity-bounded run whose overflow drops are repaired by full
+syncs).  Bootstrapping N nodes from mode='self' is likewise inherently
+dense.
 
 Rebase: divergence relative to the base only shrinks again when gossip
 reconverges; ``compact`` drops slots that match the base again, and
@@ -101,6 +109,7 @@ from ringpop_tpu.models.swim_sim import (
     ClusterState,
     NetState,
     SwimParams,
+    _adj,
     _apply_mask,
     _check_inc,
     _distinct_ranks,
@@ -172,26 +181,42 @@ def init_delta(
     inc: jax.Array | None = None,
     *,
     capacity: int = 256,
+    mode: str = "converged",
 ) -> DeltaState:
-    """Converged cluster: every view equals the base, tables empty.
+    """Fresh delta state (the dense ``init_state`` twin).
 
-    (mode='self' bootstrap is inherently dense divergence — use the
-    dense backend for whole-cluster bootstrap scenarios.)
+    ``mode='converged'``: every view equals the all-alive base, tables
+    empty.  ``mode='self'``: pre-join bootstrap — the base is
+    all-nonexistent (0) and each viewer holds one slot: its own alive
+    entry (dense parity: ``init_state(mode='self')``).  A whole-cluster
+    bootstrap's divergence grows toward the discovered cluster size, so
+    size ``capacity`` for the join wave (~n at full discovery) and fold
+    the converged all-alive consensus into the base with ``rebase``.
     """
     if inc is None:
         inc = jnp.zeros((n,), dtype=jnp.int32)
     inc = jnp.asarray(inc, dtype=jnp.int32)
     _check_inc(inc)
-    base_key = inc * 8 + ALIVE
-    bp_mask, bp_rank, bp_list = _base_rank_structs(base_key)
+    alive_key = inc * 8 + ALIVE
     c = capacity
+    d_subj = jnp.full((n, c), SENTINEL, dtype=jnp.int32)
+    d_key = jnp.zeros((n, c), dtype=jnp.int32)
+    if mode == "converged":
+        base_key = alive_key
+    elif mode == "self":
+        base_key = jnp.zeros((n,), dtype=jnp.int32)
+        d_subj = d_subj.at[:, 0].set(jnp.arange(n, dtype=jnp.int32))
+        d_key = d_key.at[:, 0].set(alive_key)
+    else:
+        raise ValueError(f"unknown init mode: {mode}")
+    bp_mask, bp_rank, bp_list = _base_rank_structs(base_key)
     return DeltaState(
         base_key=base_key,
         bp_mask=bp_mask,
         bp_rank=bp_rank,
         bp_list=bp_list,
-        d_subj=jnp.full((n, c), SENTINEL, dtype=jnp.int32),
-        d_key=jnp.zeros((n, c), dtype=jnp.int32),
+        d_subj=d_subj,
+        d_key=d_key,
         d_pb=jnp.full((n, c), -1, dtype=jnp.int8),
         d_sl=jnp.full((n, c), -1, dtype=jnp.int8),
         tick=jnp.zeros((), dtype=jnp.int32),
@@ -885,6 +910,26 @@ def _route_flat(
 # ---------------------------------------------------------------------------
 
 
+def _rotating_window(issuable: jax.Array, w: int, tick: jax.Array) -> jax.Array:
+    """The wire window: ``w`` of a row's issuable entries, rotated by
+    ``tick`` so a backlog wider than the wire cycles through fairly.
+
+    The plain first-``w``-in-slot-order window starves the tail of a
+    wide backlog: the front entries re-issue every tick until their
+    piggyback budgets evict them (maxpb issues each) before the next
+    block gets wire time — a netsplit-heal refutation storm of ~N fresh
+    changes drained at maxpb * C/w ticks (measured: n=256 storm, wire
+    16, stalled past 400 ticks).  Rotating the window start by
+    ``tick * w`` positions makes the backlog cycle in ~C/w-tick rounds
+    (measured: the same storm merges in ~30 ticks).  Identical to the
+    plain window whenever the backlog fits the wire (<= w issuable
+    entries per row) — the ample-cap bit-parity contract."""
+    rank = jnp.cumsum(issuable.astype(jnp.int32), axis=1)  # inclusive, 1-based
+    total = jnp.maximum(rank[:, -1:], 1)
+    start = (tick * w) % total
+    return issuable & (((rank - 1 - start) % total) < w)
+
+
 def _stage_issue_delta(
     st: DeltaState, nserve: jax.Array, maxpb: jax.Array, w: int
 ) -> tuple[DeltaState, jax.Array]:
@@ -897,7 +942,7 @@ def _stage_issue_delta(
     has = st.d_pb >= 0
     ns8 = jnp.minimum(nserve, 127).astype(jnp.int8)[:, None]
     issuable = has & (ns8 > 0) & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
-    within = issuable & (jnp.cumsum(issuable.astype(jnp.int32), axis=1) <= w)
+    within = _rotating_window(issuable, w, st.tick)
     served = has & (ns8 > 0) & ~(issuable & ~within)
     evict = served & (st.d_pb > maxpb[:, None] - ns8)
     d_pb = jnp.where(
@@ -925,10 +970,12 @@ def delta_step_impl(
         m.update(extra)
         return st, m
 
-    if net.adj is not None:
+    if net.adj is not None and net.adj.ndim != 1:
         raise NotImplementedError(
-            "delta backend models loss/kill/suspend; partition masks need "
-            "the dense backend (a netsplit diverges densely by construction)"
+            "delta backend partitions take the int32[N] group-id form of "
+            "NetState.adj (connected iff same group — block netsplits, "
+            "swim_sim._adj); dense bool[N, N] masks (arbitrary topologies) "
+            "need the dense backend"
         )
     sw = params.swim
     if sw.sparse_cap:
@@ -955,9 +1002,7 @@ def delta_step_impl(
     has_change = state.d_pb >= 0
     bump = has_change & sends[:, None]
     pb1_ok = bump & (state.d_pb + jnp.int8(1) <= maxpb[:, None])
-    within = pb1_ok & (
-        jnp.cumsum(pb1_ok.astype(jnp.int32), axis=1) <= w
-    )  # wire window, slot (=subject) order
+    within = _rotating_window(pb1_ok, w, state.tick)  # fair wire window
     bump_eff = bump & ~(pb1_ok & ~within)  # entries past the window keep budget
     pb_next = jnp.where(bump_eff, state.d_pb + jnp.int8(1), state.d_pb)
     pb_next = jnp.where(bump_eff & (pb_next > maxpb[:, None]), jnp.int8(-1), pb_next)
@@ -975,7 +1020,12 @@ def delta_step_impl(
 
     # -- phase 3: delivery + receiver merge ---------------------------------
     resp = net.up & net.responsive
-    fwd_ok = sends & ~_drop(k_loss1, (n,), sw.loss) & resp[t_safe]
+    fwd_ok = (
+        sends
+        & _adj(net, ids, t_safe)
+        & ~_drop(k_loss1, (n,), sw.loss)
+        & resp[t_safe]
+    )
     sent_valid = (send_subj < SENTINEL) & fwd_ok[:, None]
 
     # inbound ping count per receiver, scatter-free (sorted senders)
@@ -1008,9 +1058,7 @@ def delta_step_impl(
     rep_issuable = (
         has_change2 & got_ping[:, None] & (state.d_pb + jnp.int8(1) <= maxpb[:, None])
     )
-    within_rep = rep_issuable & (
-        jnp.cumsum(rep_issuable.astype(jnp.int32), axis=1) <= w
-    )
+    within_rep = _rotating_window(rep_issuable, w, state.tick)
     # receiver pb bookkeeping: advance by pings served, evict past
     # budget; windowed-out entries untouched (dense phase-4a + the
     # sparse-path window rule)
@@ -1027,7 +1075,7 @@ def delta_step_impl(
     rep_subj, rep_key = _windowed_changes(state, within_rep, w)
 
     # ack claims for sender s = reply list of its receiver (pure gather)
-    ack = fwd_ok & ~_drop(k_loss2, (n,), sw.loss)
+    ack = fwd_ok & _adj(net, t_safe, ids) & ~_drop(k_loss2, (n,), sw.loss)
     a_subj = rep_subj[t_safe]  # [N, W]
     a_key = rep_key[t_safe]
     a_subj_q = jnp.where(a_subj < SENTINEL, a_subj, 0)
@@ -1123,12 +1171,26 @@ def delta_step_impl(
     req_del = (
         failed[:, None]
         & wit_valid
+        & _adj(net, ids[:, None], wit_safe)
         & ~_drop(k_a, kshape, sw.loss)
         & resp[wit_safe]
     )
-    ping_del = req_del & ~_drop(k_b, kshape, sw.loss) & resp[t_safe][:, None]
-    ack_del = ping_del & ~_drop(k_c, kshape, sw.loss)
-    resp_del = req_del & ~_drop(k_d, kshape, sw.loss)
+    ping_del = (
+        req_del
+        & _adj(net, wit_safe, t_safe[:, None])
+        & ~_drop(k_b, kshape, sw.loss)
+        & resp[t_safe][:, None]
+    )
+    ack_del = (
+        ping_del
+        & _adj(net, t_safe[:, None], wit_safe)
+        & ~_drop(k_c, kshape, sw.loss)
+    )
+    resp_del = (
+        req_del
+        & _adj(net, wit_safe, ids[:, None])
+        & ~_drop(k_d, kshape, sw.loss)
+    )
     any_success = jnp.any(ack_del & resp_del, axis=1)
     definite_fail = jnp.any(req_del & ~ack_del & resp_del, axis=1)
     declare_suspect = failed & ~any_success & definite_fail

@@ -196,51 +196,82 @@ def shard_delta(state: DeltaState, mesh: Mesh) -> DeltaState:
 
 
 def _reject_adjacency(net: NetState) -> None:
-    """The delta backend models loss/kill/suspend only — surface its
-    clear NotImplementedError for adjacency-carrying nets at call time,
-    instead of the opaque jit pytree/sharding-structure mismatch the
-    adj=None in_shardings would otherwise produce."""
-    if net.adj is not None:
+    """The sharded delta step takes partitions in the int32[N] group-id
+    adjacency form only (replicated across the mesh) — surface a clear
+    NotImplementedError for dense bool[N, N] masks at call time, instead
+    of the opaque jit pytree/sharding-structure mismatch the adj=None
+    in_shardings would otherwise produce."""
+    if net.adj is not None and net.adj.ndim != 1:
         raise NotImplementedError(
-            "delta backend models loss/kill/suspend; partition masks need "
-            "the dense backend (a netsplit diverges densely by construction)"
+            "sharded delta partitions take the int32[N] group-id adjacency; "
+            "dense bool[N, N] masks need the dense backend"
         )
 
 
-def sharded_delta_step(mesh: Mesh) -> Callable:
+def sharded_delta_step(mesh: Mesh, net_like: NetState | None = None) -> Callable:
     """``delta_step`` compiled for the mesh.  The cross-chip traffic is
     the claim routing: the flat (receiver, subject) sort and the
     per-receiver gathers lower to collectives over the row shards —
-    the delta analog of the dense scatter-into-foreign-rows."""
+    the delta analog of the dense scatter-into-foreign-rows.  Pass
+    ``net_like=net`` when the net carries a group-id adjacency vector
+    (replicated; the only delta partition form)."""
     rep = NamedSharding(mesh, P())
     jitted = jax.jit(
         delta_step_impl,
         static_argnames=("params", "upto"),
-        in_shardings=(delta_state_sharding(mesh), net_sharding(mesh), rep),
+        in_shardings=(
+            delta_state_sharding(mesh),
+            net_sharding(mesh, like=net_like),
+            rep,
+        ),
         out_shardings=(delta_state_sharding(mesh), rep),
         donate_argnums=(0,),
     )
 
+    expect_adj = net_like is not None and net_like.adj is not None
+
     def step(state, net, key, params, upto=7):
         _reject_adjacency(net)
+        _check_adj_layout(net, expect_adj)
         return jitted(state, net, key, params, upto)
 
     return step
 
 
-def sharded_delta_run(mesh: Mesh) -> Callable:
+def sharded_delta_run(mesh: Mesh, net_like: NetState | None = None) -> Callable:
     """``delta_run`` (lax.scan over ticks) compiled for the mesh."""
     rep = NamedSharding(mesh, P())
     jitted = jax.jit(
         delta_run_impl,
         static_argnames=("params", "ticks"),
-        in_shardings=(delta_state_sharding(mesh), net_sharding(mesh), rep),
+        in_shardings=(
+            delta_state_sharding(mesh),
+            net_sharding(mesh, like=net_like),
+            rep,
+        ),
         out_shardings=(delta_state_sharding(mesh), rep),
         donate_argnums=(0,),
     )
 
+    expect_adj = net_like is not None and net_like.adj is not None
+
     def run(state, net, key, params, ticks):
         _reject_adjacency(net)
+        _check_adj_layout(net, expect_adj)
         return jitted(state, net, key, params, ticks)
 
     return run
+
+
+def _check_adj_layout(net: NetState, expect_adj: bool) -> None:
+    """Clear error when the net's adjacency presence disagrees with the
+    compiled in_shardings (built from ``net_like`` at construction) —
+    otherwise jax.jit fails deep inside with an opaque pytree/sharding
+    structure mismatch."""
+    if (net.adj is not None) != expect_adj:
+        have = "carries" if net.adj is not None else "lacks"
+        want = "with" if expect_adj else "without"
+        raise ValueError(
+            f"net {have} an adjacency vector but this sharded step was "
+            f"compiled {want} one — rebuild with net_like=net"
+        )

@@ -34,5 +34,8 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 def pytest_configure(config):
     config.addinivalue_line(
-        "markers", "slow: integration tests that spawn real worker processes"
+        "markers",
+        "slow: multi-minute soaks (alternate-lowering parity grids, "
+        "profiling prefixes, real-process integration); deselected by "
+        "default via pytest.ini addopts, run with -m slow",
     )

@@ -129,7 +129,7 @@ def test_sharded_delta_run_scan():
     assert int(m["pings_sent"]) > 0
 
 
-def test_sharded_delta_rejects_adjacency():
+def test_sharded_delta_rejects_dense_adjacency():
     from ringpop_tpu.models import swim_delta as sd
 
     mesh = parallel.make_mesh(8)
@@ -138,3 +138,32 @@ def test_sharded_delta_rejects_adjacency():
     state = parallel.shard_delta(sd.init_delta(64, capacity=16), mesh)
     with pytest.raises(NotImplementedError):
         step(state, net, jax.random.PRNGKey(0), sd.DeltaParams())
+
+
+def test_sharded_delta_partition_bit_parity():
+    """Group-id netsplit on the 8-way mesh == the single-device delta
+    trajectory (which test_bit_identical_partition_split_and_heal pins
+    to dense) — the partition form the 65k config-4 scenario uses."""
+    from ringpop_tpu.models import swim_delta as sd
+
+    n = 64
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.02, suspicion_ticks=5),
+        wire_cap=n,
+        claim_grid=2 * n,
+    )
+    gid = (jnp.arange(n) >= n // 2).astype(jnp.int32)
+    net = sim.make_net(n)._replace(adj=gid)
+    mesh = parallel.make_mesh(8)
+    step = parallel.sharded_delta_step(mesh, net_like=net)
+    sh = parallel.shard_delta(sd.init_delta(n, capacity=n), mesh)
+    ref = sd.init_delta(n, capacity=n)
+    keys = jax.random.split(jax.random.PRNGKey(7), 15)
+    for t in range(15):
+        sh, _ = step(sh, net, keys[t], params)
+        ref, _ = jax.jit(sd.delta_step_impl, static_argnames=("params",))(
+            ref, net, keys[t], params
+        )
+    np.testing.assert_array_equal(
+        np.asarray(sd.densify(sh).view_key), np.asarray(sd.densify(ref).view_key)
+    )

@@ -219,6 +219,7 @@ def test_choose_targets_large_path_matches_small(monkeypatch):
     np.testing.assert_array_equal(w0[wv0], w1[wv0])
 
 
+@pytest.mark.slow
 def test_sparse_step_bitparity_on_large_path(monkeypatch):
     """A short sparse trajectory through a kill, with the large-N
     lowerings forced on: bit-identical to the small-N lowerings."""

@@ -301,7 +301,9 @@ def test_sweep_probe_parity_with_dense():
         assert_matches_dense(delta, dense, t)
 
 
-def test_delta_rejects_partition_masks():
+def test_delta_rejects_dense_partition_masks():
+    """bool[N, N] adjacency masks stay dense-only; the delta backend
+    takes the int32[N] group-id form (test_bit_identical_partition)."""
     n = 8
     params = sim.SwimParams()
     dparams = sd.DeltaParams(swim=params)
@@ -309,6 +311,36 @@ def test_delta_rejects_partition_masks():
     net = sim.make_net(n, partitioned=True)
     with pytest.raises(NotImplementedError):
         sd.delta_step_impl(delta, net, jax.random.PRNGKey(0), dparams)
+
+
+def test_bit_identical_partition_split_and_heal():
+    """Group-id netsplit: split at tick 10, heal at tick 40 (mid-
+    transition, suspects still cross-pingable): the full divergence /
+    spontaneous-remerge cycle must stay on the dense trajectory bit for
+    bit.  Peak per-viewer divergence reaches ~n/2 (the netsplit's dense
+    transition), so capacity is ample here."""
+    n = 24
+    params = sim.SwimParams(loss=0.02, suspicion_ticks=6)
+    # ample caps for a netsplit mean claim_grid = n * n: the post-heal
+    # refutation storm can concentrate every sender's full wire on one
+    # receiver in a single tick (measured: 4n drops claims here)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=n * n)
+    dense = sim.init_state(n)
+    delta = sd.init_delta(n, capacity=n)
+    gid_split = (jnp.arange(n) >= n // 2).astype(jnp.int32)
+    gid_heal = jnp.zeros((n,), jnp.int32)
+    net = sim.make_net(n)._replace(adj=gid_heal)
+    keys = jax.random.split(jax.random.PRNGKey(3), 90)
+    for t in range(90):
+        if t == 10:
+            net = net._replace(adj=gid_split)
+        if t == 40:
+            net = net._replace(adj=gid_heal)
+        dense, md = _dense_step(dense, net, keys[t], params)
+        delta, me = _delta_step(delta, net, keys[t], dparams)
+        assert_matches_dense(delta, dense, t)
+        for k in METRIC_KEYS:
+            assert int(md[k]) == int(me[k]), f"metric {k} tick {t}"
 
 
 def test_delta_rejects_sparse_cap():
@@ -367,18 +399,92 @@ def test_simcluster_delta_kill_revive_cycle():
     assert len(set(c.checksums().values())) == 1
 
 
-def test_simcluster_delta_rejects_partition_and_damping():
+def test_simcluster_delta_scope_guards():
     from ringpop_tpu.models.cluster import SimCluster
 
     c = SimCluster(8, backend="delta")
     with pytest.raises(NotImplementedError):
-        c.partition([[0, 1, 2, 3], [4, 5, 6, 7]])
+        c.partition([[0, 1, 2], [4, 5, 6, 7]])  # partial coverage: node 3
     with pytest.raises(ValueError):
         SimCluster(8, backend="delta", damping=True)
-    with pytest.raises(ValueError):
-        SimCluster(8, backend="delta", init="self")
 
 
+def test_bit_identical_self_bootstrap():
+    """init='self' join wave: every node admin-joins against seed 0
+    (tick-cluster 'j'), then gossip discovers the rest — bit-identical
+    to the dense trajectory through the whole bootstrap, and the
+    converged consensus folds into the base via rebase."""
+    n = 20
+    params = sim.SwimParams(loss=0.02, suspicion_ticks=6)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=n * n)
+    dense = sim.init_state(n, mode="self")
+    delta = sd.init_delta(n, capacity=n + 4, mode="self")
+    np.testing.assert_array_equal(
+        np.asarray(sd.densify(delta).view_key), np.asarray(dense.view_key)
+    )
+    for j in range(1, n):
+        dense = sim.admin_join(dense, j, 0)
+        delta = sd.admin_join(delta, j, 0)
+    assert_matches_dense(delta, dense, "post-join")
+    net = sim.make_net(n)
+    keys = jax.random.split(jax.random.PRNGKey(17), 40)
+    for t in range(40):
+        dense, _ = _dense_step(dense, net, keys[t], params)
+        delta, _ = _delta_step(delta, net, keys[t], dparams)
+        assert_matches_dense(delta, dense, t)
+    vs = np.asarray(dense.view_key)
+    assert (vs == vs[0]).all(), "bootstrap failed to converge"
+    delta = sd.rebase(delta)
+    assert_matches_dense(delta, dense, "post-rebase")
+    assert int(jnp.sum(delta.d_subj < sd.SENTINEL)) == 0  # folded to base
+
+
+def test_simcluster_delta_self_bootstrap_checksums():
+    from ringpop_tpu.models.cluster import SimCluster
+
+    n = 12
+    dense = SimCluster(n, init="self", seed=5)
+    delta = SimCluster(
+        n, init="self", seed=5, backend="delta", capacity=n + 4,
+        wire_cap=n, claim_grid=n * n,
+    )
+    for c in (dense, delta):
+        assert not c.converged()
+        for j in range(1, n):
+            c.join(j, 0)
+    for _ in range(40):
+        dense.tick()
+        delta.tick()
+        assert dense.checksums() == delta.checksums()
+    assert dense.converged() and delta.converged()
+
+
+def test_simcluster_delta_partition_matches_dense_checksums():
+    """SimCluster group-id netsplit on both backends: identical
+    reference-format checksums through split, heal, and remerge."""
+    from ringpop_tpu.models.cluster import SimCluster
+
+    n = 16
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=5)
+    dense = SimCluster(n, params, seed=13)
+    delta = SimCluster(
+        n, params, seed=13, backend="delta", capacity=n, wire_cap=n,
+        claim_grid=n * n,  # netsplit-ample: see the step-parity test
+    )
+    sides = [list(range(n // 2)), list(range(n // 2, n))]
+    for c in (dense, delta):
+        c.tick(3)
+        c.partition(sides)
+        c.tick(8)  # mid-transition: suspects exist, faulty not universal
+        c.heal_partition()
+    for _ in range(60):
+        dense.tick()
+        delta.tick()
+        assert dense.checksums() == delta.checksums()
+    assert dense.converged() and delta.converged()
+
+
+@pytest.mark.slow
 def test_simcluster_delta_device_checksums_match_host():
     from ringpop_tpu.models.cluster import SimCluster
 
@@ -406,6 +512,7 @@ def test_sparsify_densify_roundtrip():
     )
 
 
+@pytest.mark.slow
 def test_upto_prefixes_compile_and_full_matches_default():
     """The profiling ``upto`` knob: every prefix executes, and the
     explicit full value (7) is the default step bit for bit."""
@@ -424,7 +531,14 @@ def test_upto_prefixes_compile_and_full_matches_default():
         jax.block_until_ready(st.d_subj)
 
 
-@pytest.mark.parametrize("method", ["sort", "scan_unrolled", "pallas"])
+@pytest.mark.parametrize(
+    "method",
+    [
+        pytest.param("sort", marks=pytest.mark.slow),
+        "scan_unrolled",  # the default lowering stays in the default run
+        pytest.param("pallas", marks=pytest.mark.slow),
+    ],
+)
 def test_wide_lowerings_bit_identical(method, monkeypatch):
     """Every wide-query searchsorted lowering (_WIDE_METHOD) traces the
     same trajectory: the non-default choices stay tested fallbacks for
@@ -440,6 +554,7 @@ def test_wide_lowerings_bit_identical(method, monkeypatch):
         assert_matches_dense(delta, dense, t)
 
 
+@pytest.mark.slow
 def test_long_horizon_occupancy_stays_bounded():
     """200 lossy ticks with a kill and a revive: divergence tables must
     not leak — after dissemination budgets expire and compact() runs,
