@@ -66,8 +66,10 @@ resource semantics, each surfaced in ``metrics``:
   later pings; the window start rotates by tick so a backlog wider
   than the wire cycles fairly, ``_rotating_window``), mirroring
   SwimParams.sparse_cap;
-* a receiver consumes at most ``claim_grid`` distinct claims per tick
-  (rest dropped = late packets; ``claims_dropped``);
+* a receiver consumes at most ``claim_grid`` distinct claims per tick,
+  row-granularly — at most ``2 * ceil(claim_grid / wire_cap)`` sender
+  rows, then ``claim_grid`` of their merged claims (rest dropped = late
+  packets; ``claims_dropped``; see ``_route_claims_multi``);
 * a viewer tracks at most ``capacity`` divergent subjects (insertions
   past that are dropped = lost updates repaired by later gossip /
   full sync; ``overflow_drops``).
@@ -832,76 +834,75 @@ def _route_claims_multi(
     segments: list[tuple[jax.Array, jax.Array, jax.Array, jax.Array]],
     grid: int,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """_route_claims over several sender segments in ONE flat sort.
+    """_route_claims over several sender segments in one routing pass.
 
     Each segment is (subj [N, W], key [N, W], valid [N, W], recv [N]) —
-    the phase-5 exchange routes one segment per witness slot, so the
-    whole stage costs a single [len * N * W] sort instead of per-slot
-    sorts + sequential merges (which would also break the one-merge-
-    per-stage convention the dense step pins)."""
-    flat_recv = jnp.concatenate(
+    the phase-5 exchange routes one segment per witness slot in a
+    single pass, preserving the one-merge-per-stage convention the
+    dense step pins.
+
+    Routing is by ROWS, not claims: a segment row's claims share one
+    receiver, so grouping needs only a [S * N] sort of row-records
+    (receiver keys), a gather of up to R = 2 * ceil(grid / W) sender
+    rows per receiver, and ONE [N, R * W] row sort to merge/dedup by
+    subject.  The earlier flat form sorted all S * N * W claim records
+    every routed tick — at 8k nodes the phase-5 stages' 3 * N * 16
+    sorts made the exchange ~15x the rest of the tick; this form is
+    ~their phase-3 cost.
+
+    Consumption is row-granular: a receiver consumes at most R sender
+    rows (the 2x margin over grid/W covers partially-filled rows), then
+    at most ``grid`` claims of their merge — excess rows/claims drop as
+    late packets (counted in ``dropped``).  The ample-cap / bit-parity
+    condition is therefore ``grid >= max_inbound_rows * W`` (for the
+    phase-5 stages max_inbound_rows is ping_req_size * N in the
+    adversarial worst case; tests use grid = 3 * n * wire_cap)."""
+    w = max(s[0].shape[1] for s in segments)
+    nrows = n * len(segments)
+    row_recv = jnp.concatenate(
         [
-            jnp.where(
-                valid, jnp.broadcast_to(recv[:, None], subj.shape), n
-            ).reshape(-1)
-            for subj, _, valid, recv in segments
+            jnp.where(jnp.any(valid, axis=1), recv, n)
+            for _, _, valid, recv in segments
         ]
+    )  # int32[S*N]; n = silent row, sorts last
+    rows_subj = jnp.concatenate(
+        [jnp.where(valid, subj, SENTINEL) for subj, _, valid, _ in segments]
+    )  # [S*N, W]
+    rows_key = jnp.concatenate(
+        [jnp.where(valid, key, 0) for _, key, valid, _ in segments]
     )
-    flat_subj = jnp.concatenate(
-        [jnp.where(valid, subj, SENTINEL).reshape(-1) for subj, _, valid, _ in segments]
-    )
-    flat_key = jnp.concatenate(
-        [jnp.where(valid, key, 0).reshape(-1) for _, key, valid, _ in segments]
-    )
-    return _route_flat(n, flat_recv, flat_subj, flat_key, grid)
+    rows_nvalid = jnp.sum(
+        (rows_subj < SENTINEL).astype(jnp.int32), axis=1
+    )  # valid-claim count per row
 
+    order = jnp.argsort(row_recv, stable=True)
+    recv_s = row_recv[order]
+    starts, ends = _run_bounds(recv_s, n)
+    counts = ends - starts  # sending rows per receiver
+    r = min(2 * -(-grid // w), nrows)  # rows consumed per receiver
+    idx = jnp.minimum(
+        starts[:, None] + jnp.arange(r, dtype=jnp.int32)[None, :], nrows - 1
+    )  # [N, R]
+    row_ok = jnp.arange(r, dtype=jnp.int32)[None, :] < counts[:, None]
+    src = jnp.where(row_ok, order[idx], 0)  # [N, R] source row ids
+    g_subj = jnp.where(row_ok[:, :, None], rows_subj[src], SENTINEL).reshape(n, r * w)
+    g_key = jnp.where(row_ok[:, :, None], rows_key[src], 0).reshape(n, r * w)
+    kept = jnp.sum(jnp.where(row_ok, rows_nvalid[src], 0), dtype=jnp.int32)
+    dropped = jnp.sum(rows_nvalid, dtype=jnp.int32) - kept
 
-def _route_flat(
-    n: int,
-    flat_recv: jax.Array,
-    flat_subj: jax.Array,
-    flat_key: jax.Array,
-    grid: int,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    flat_recv, flat_subj, flat_key = jax.lax.sort(
-        (flat_recv, flat_subj, flat_key), num_keys=2
-    )
-
-    starts, ends = _run_bounds(flat_recv, n)
-    counts = ends - starts
-    total = flat_recv.shape[0]
-    idx = jnp.minimum(starts[:, None] + jnp.arange(grid, dtype=jnp.int32)[None, :],
-                      total - 1)
-    in_run = jnp.arange(grid, dtype=jnp.int32)[None, :] < counts[:, None]
-    g_subj = jnp.where(in_run, flat_subj[idx], SENTINEL)
-    g_key = jnp.where(in_run, flat_key[idx], 0)
-
-    # merge duplicate subjects (same receiver, several senders): keep
-    # the first occurrence carrying the max key — log-step prefix max
-    # within equal-subject runs (runs are adjacent, grid is small).
-    shift = 1
-    while shift < grid:
-        prev_subj = jnp.pad(g_subj, ((0, 0), (shift, 0)), constant_values=SENTINEL)[
-            :, :grid
-        ]
-        nxt_subj = jnp.pad(g_subj, ((0, 0), (0, shift)), constant_values=SENTINEL)[
-            :, shift:
-        ]
-        nxt_key = jnp.pad(g_key, ((0, 0), (0, shift)), constant_values=0)[:, shift:]
-        g_key = jnp.where(nxt_subj == g_subj, jnp.maximum(g_key, nxt_key), g_key)
-        shift *= 2
-    first = jnp.pad(g_subj, ((0, 0), (1, 0)), constant_values=-1)[:, :grid] != g_subj
-    g_valid = in_run & first & (g_subj < SENTINEL)
-    g_subj = jnp.where(g_valid, g_subj, SENTINEL)
-    g_key = jnp.where(g_valid, g_key, 0)
-    dropped = jnp.sum(jnp.maximum(counts - grid, 0), dtype=jnp.int32)
-    # Re-pack: masking duplicates leaves SENTINEL holes mid-row, and
-    # _merge_claims binary-searches these rows — a hole breaks the
-    # sortedness contract and silently loses the claims after it.
-    order = jnp.argsort(g_subj, axis=1)
-    g_subj = jnp.take_along_axis(g_subj, order, axis=1)
-    g_key = jnp.take_along_axis(g_key, order, axis=1)
-    g_valid = g_subj < SENTINEL
+    # merge the gathered rows: subject-sort, dedup at the key max,
+    # repack (SENTINEL holes would break _merge_claims' binary search)
+    g_subj, g_key, g_valid = _sort_claim_rows(g_subj, g_key, g_subj < SENTINEL)
+    if r * w > grid:
+        # claims past the grid width are late packets (counted)
+        dropped = dropped + jnp.sum(
+            g_valid[:, grid:].astype(jnp.int32), dtype=jnp.int32
+        )
+        g_subj, g_key, g_valid = (
+            g_subj[:, :grid],
+            g_key[:, :grid],
+            g_valid[:, :grid],
+        )
     return g_subj, g_key, g_valid, dropped
 
 
@@ -1202,45 +1203,70 @@ def delta_step_impl(
         s_, e_ = _run_bounds(flat, n)
         return (e_ - s_).astype(jnp.int32)
 
+    def _stage(st, acc, pred, build_segs):
+        """Route + merge one exchange stage under a has-claims cond: in
+        the converged steady state (the 65k headline) failed probes
+        happen every tick but NOBODY holds an active change, so every
+        stage's claim set is empty and the whole stage body — segment
+        building (anti-echo lookups), routing, merging — must cost
+        nothing.  ``pred`` is the conservative any-windowed-change bit
+        (claims can only shrink from there, via delivery masks and
+        anti-echo), so a skipped stage is provably a no-op."""
+        applied, late = acc
+
+        def go(st2):
+            g = _route_claims_multi(n, build_segs(st2), params.claim_grid)
+            out = _merge_claims(st2, g[0], g[1], g[2], sl_start)
+            return out.state, out.applied_points, g[3]
+
+        def skip(st2):
+            return st2, jnp.int32(0), jnp.int32(0)
+
+        st, ap, lt = jax.lax.cond(pred, go, skip, st)
+        return st, (applied + ap, late + lt)
+
     def exchange(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
-        applied = jnp.int32(0)
-        late = jnp.int32(0)
+        acc = (jnp.int32(0), jnp.int32(0))
         nreq = jnp.sum(failed[:, None] & wit_valid, axis=1, dtype=jnp.int32)
         nsrv = _role_counts(wit_safe, req_del)
 
         # -- 5a: the ping-req body carries the source's changes ---------
         st, win_a = _stage_issue_delta(st, nreq, maxpb, w)
         sa_subj, sa_key = _windowed_changes(st, win_a, w)
-        segs = [
-            (
-                sa_subj,
-                sa_key,
-                (sa_subj < SENTINEL) & req_del[:, m][:, None],
-                wit_safe[:, m],
-            )
-            for m in range(kk)
-        ]
-        g = _route_claims_multi(n, segs, params.claim_grid)
-        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
-        st, applied, late = out.state, applied + out.applied_points, late + g[3]
+        st, acc = _stage(
+            st,
+            acc,
+            jnp.any(win_a),
+            lambda st2: [
+                (
+                    sa_subj,
+                    sa_key,
+                    (sa_subj < SENTINEL) & req_del[:, m][:, None],
+                    wit_safe[:, m],
+                )
+                for m in range(kk)
+            ],
+        )
 
         # -- 5b: the witness relay-pings the target with its changes ----
         st, win_b = _stage_issue_delta(st, nsrv, maxpb, w)
         sb_subj, sb_key = _windowed_changes(st, win_b, w)
         nping_del = _role_counts(wit_safe, ping_del)
         ntgt = _role_counts(jnp.broadcast_to(t_safe[:, None], kshape), ping_del)
-        segs = [
-            (
-                sb_subj[wit_safe[:, m]],
-                sb_key[wit_safe[:, m]],
-                (sb_subj[wit_safe[:, m]] < SENTINEL) & ping_del[:, m][:, None],
-                t_safe,
-            )
-            for m in range(kk)
-        ]
-        g = _route_claims_multi(n, segs, params.claim_grid)
-        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
-        st, applied, late = out.state, applied + out.applied_points, late + g[3]
+        st, acc = _stage(
+            st,
+            acc,
+            jnp.any(win_b),
+            lambda st2: [
+                (
+                    sb_subj[wit_safe[:, m]],
+                    sb_key[wit_safe[:, m]],
+                    (sb_subj[wit_safe[:, m]] < SENTINEL) & ping_del[:, m][:, None],
+                    t_safe,
+                )
+                for m in range(kk)
+            ],
+        )
         # the witness's delivered set (5c anti-echo): its windowed list,
         # where it made at least one delivered relay ping
         wit_sent_subj = jnp.where((nping_del > 0)[:, None], sb_subj, SENTINEL)
@@ -1248,33 +1274,35 @@ def delta_step_impl(
         # -- 5c: the target's ack carries its changes back --------------
         st, win_c = _stage_issue_delta(st, ntgt, maxpb, w)
         sc_subj, sc_key = _windowed_changes(st, win_c, w)
-        segs = []
-        for m in range(kk):
-            w_m = wit_safe[:, m]
-            subj = sc_subj[t_safe]
-            key_c = sc_key[t_safe]
-            subj_q = jnp.where(subj < SENTINEL, subj, 0)
-            # anti-echo: the witness delivered this subject in 5b and its
-            # current belief equals the claim
-            _, in_sent = _lookup_pos(wit_sent_subj[w_m], subj_q)
-            pos_w, found_w = _lookup_pos(st.d_subj[w_m], subj_q)
-            cur_w = jnp.where(
-                found_w,
-                jnp.take_along_axis(st.d_key[w_m], pos_w, axis=1),
-                st.base_key[subj_q],
-            )
-            echo = in_sent & (key_c == cur_w)
-            segs.append(
-                (
-                    subj,
-                    key_c,
-                    (subj < SENTINEL) & ack_del[:, m][:, None] & ~echo,
-                    w_m,
+
+        def segs_c(st2):
+            segs = []
+            for m in range(kk):
+                w_m = wit_safe[:, m]
+                subj = sc_subj[t_safe]
+                key_c = sc_key[t_safe]
+                subj_q = jnp.where(subj < SENTINEL, subj, 0)
+                # anti-echo: the witness delivered this subject in 5b
+                # and its current belief equals the claim
+                _, in_sent = _lookup_pos(wit_sent_subj[w_m], subj_q)
+                pos_w, found_w = _lookup_pos(st2.d_subj[w_m], subj_q)
+                cur_w = jnp.where(
+                    found_w,
+                    jnp.take_along_axis(st2.d_key[w_m], pos_w, axis=1),
+                    st2.base_key[subj_q],
                 )
-            )
-        g = _route_claims_multi(n, segs, params.claim_grid)
-        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
-        st, applied, late = out.state, applied + out.applied_points, late + g[3]
+                echo = in_sent & (key_c == cur_w)
+                segs.append(
+                    (
+                        subj,
+                        key_c,
+                        (subj < SENTINEL) & ack_del[:, m][:, None] & ~echo,
+                        w_m,
+                    )
+                )
+            return segs
+
+        st, acc = _stage(st, acc, jnp.any(win_c), segs_c)
 
         # -- 5d: the witness response carries its (fresh) changes -------
         # issue set from the post-5c state: what the witness just learned
@@ -1284,33 +1312,42 @@ def delta_step_impl(
         src_sent_subj = jnp.where(
             jnp.any(req_del, axis=1)[:, None], sa_subj, SENTINEL
         )
-        segs = []
-        for m in range(kk):
-            w_m = wit_safe[:, m]
-            subj = sd_subj[w_m]
-            key_d = sd_key[w_m]
-            subj_q = jnp.where(subj < SENTINEL, subj, 0)
-            _, in_sent = _lookup_pos(src_sent_subj, subj_q)
-            cur_s = view_lookup(st, subj_q)
-            echo = in_sent & (key_d == cur_s)
-            segs.append(
-                (
-                    subj,
-                    key_d,
-                    (subj < SENTINEL) & resp_del[:, m][:, None] & ~echo,
-                    ids,
+
+        def segs_d(st2):
+            segs = []
+            for m in range(kk):
+                w_m = wit_safe[:, m]
+                subj = sd_subj[w_m]
+                key_d = sd_key[w_m]
+                subj_q = jnp.where(subj < SENTINEL, subj, 0)
+                _, in_sent = _lookup_pos(src_sent_subj, subj_q)
+                cur_s = view_lookup(st2, subj_q)
+                echo = in_sent & (key_d == cur_s)
+                segs.append(
+                    (
+                        subj,
+                        key_d,
+                        (subj < SENTINEL) & resp_del[:, m][:, None] & ~echo,
+                        ids,
+                    )
                 )
-            )
-        g = _route_claims_multi(n, segs, params.claim_grid)
-        out = _merge_claims(st, g[0], g[1], g[2], sl_start)
-        st, applied, late = out.state, applied + out.applied_points, late + g[3]
-        return st, applied, late
+            return segs
+
+        st, acc = _stage(st, acc, jnp.any(win_d), segs_d)
+        return st, acc[0], acc[1]
 
     def no_exchange(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
         return st, jnp.int32(0), jnp.int32(0)
 
+    # With zero active changes cluster-wide the whole exchange is a
+    # proven no-op (no claims -> no merges -> no refutations -> no new
+    # changes), and in the converged steady state that is every tick —
+    # the common case must skip even the bookkeeping passes.
     state, pingreq_applied, pingreq_late = jax.lax.cond(
-        jnp.any(req_del), exchange, no_exchange, state
+        jnp.any(req_del) & jnp.any(state.d_pb >= 0),
+        exchange,
+        no_exchange,
+        state,
     )
     claims_dropped = claims_dropped + pingreq_late
 

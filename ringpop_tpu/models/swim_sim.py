@@ -815,98 +815,125 @@ def _phase5_pingreq(
             total = total + _inbound_counts(recv_idx[:, m], masks[:, m])
         return total
 
+    def _stage_merge(st, acc, pred, build_in, active):
+        """One exchange stage's merge under a has-claims cond: in the
+        converged steady state failed probes happen every tick but
+        nobody holds an active change, so every stage's claim matrix is
+        zero and the [N, N] gathers/sort-merges must cost nothing.
+        ``pred`` (any issued change at a participant) is conservative —
+        claims only shrink from there — so a skipped stage is a no-op."""
+        applied_total, flapped = acc
+
+        def go(st2):
+            mrg = _merge_incoming(st2, build_in(st2), active, sl_start)
+            return mrg.state, jnp.sum(mrg.applied, dtype=jnp.int32), mrg.flapped
+
+        def skip(st2):
+            return (
+                st2,
+                jnp.int32(0),
+                jnp.zeros((n, n), dtype=bool)
+                if damp_on
+                else jnp.zeros((), dtype=bool),
+            )
+
+        st, ap, fl = jax.lax.cond(pred, go, skip, st)
+        st, ap = jax.lax.optimization_barrier((st, ap))
+        return st, (applied_total + ap, flapped | fl)
+
     def exchange(st: ClusterState):
-        applied_total = jnp.int32(0)
-        flapped = (
-            jnp.zeros((n, n), dtype=bool) if damp_on else jnp.zeros((), dtype=bool)
+        acc = (
+            jnp.int32(0),
+            jnp.zeros((n, n), dtype=bool) if damp_on else jnp.zeros((), dtype=bool),
         )
 
         # -- 5a: the ping-req body carries the source's changes ----------
         nreq = jnp.sum(failed[:, None] & sel.wit_valid, axis=1, dtype=jnp.int32)
         st, issue_src = _stage_issue(st, nreq, maxpb8)
-        claims_src = jnp.where(issue_src, st.view_key, 0)
         deliv_src = issue_src & jnp.any(req_del, axis=1)[:, None]
         nsrv = _slot_counts(wit_safe, req_del)
-        in_a = jnp.zeros((n, n), jnp.int32)
-        for m in range(kk):
-            slot_in, _ = _receiver_merge(
-                wit_safe[:, m],
-                req_del[:, m],
-                jnp.where(req_del[:, m][:, None], claims_src, 0),
-            )
-            in_a = jnp.maximum(in_a, slot_in)
-        mrg = _merge_incoming(st, in_a, nsrv > 0, sl_start)
-        st = mrg.state
-        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
-        flapped = flapped | mrg.flapped
-        st, applied_total = jax.lax.optimization_barrier((st, applied_total))
+
+        def in_a(st2):
+            claims_src = jnp.where(issue_src, st2.view_key, 0)
+            acc_in = jnp.zeros((n, n), jnp.int32)
+            for m in range(kk):
+                slot_in, _ = _receiver_merge(
+                    wit_safe[:, m],
+                    req_del[:, m],
+                    jnp.where(req_del[:, m][:, None], claims_src, 0),
+                )
+                acc_in = jnp.maximum(acc_in, slot_in)
+            return acc_in
+
+        st, acc = _stage_merge(st, acc, jnp.any(issue_src), in_a, nsrv > 0)
 
         # -- 5b: the witness relay-pings the target with its changes -----
         st, issue_wit = _stage_issue(st, nsrv, maxpb8)
-        claims_wit = jnp.where(issue_wit, st.view_key, 0)
         nping_del = _slot_counts(wit_safe, ping_del)
         deliv_wit = issue_wit & (nping_del > 0)[:, None]
         ntgt = _slot_counts(
             jnp.broadcast_to(t_safe[:, None], kshape), ping_del
         )
-        in_b = jnp.zeros((n, n), jnp.int32)
-        for m in range(kk):
-            slot_in, _ = _receiver_merge(
-                t_safe,
-                ping_del[:, m],
-                jnp.where(
-                    ping_del[:, m][:, None], claims_wit[wit_safe[:, m]], 0
-                ),
-            )
-            in_b = jnp.maximum(in_b, slot_in)
-        mrg = _merge_incoming(st, in_b, ntgt > 0, sl_start)
-        st = mrg.state
-        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
-        flapped = flapped | mrg.flapped
-        st, applied_total = jax.lax.optimization_barrier((st, applied_total))
+
+        def in_b(st2):
+            claims_wit = jnp.where(issue_wit, st2.view_key, 0)
+            acc_in = jnp.zeros((n, n), jnp.int32)
+            for m in range(kk):
+                slot_in, _ = _receiver_merge(
+                    t_safe,
+                    ping_del[:, m],
+                    jnp.where(
+                        ping_del[:, m][:, None], claims_wit[wit_safe[:, m]], 0
+                    ),
+                )
+                acc_in = jnp.maximum(acc_in, slot_in)
+            return acc_in
+
+        st, acc = _stage_merge(st, acc, jnp.any(issue_wit), in_b, ntgt > 0)
 
         # -- 5c: the target's ack carries its changes back ----------------
         st, issue_tgt = _stage_issue(st, ntgt, maxpb8)
-        claims_tgt = jnp.where(issue_tgt, st.view_key, 0)
         nwit_ack = _slot_counts(wit_safe, ack_del)
-        in_c = jnp.zeros((n, n), jnp.int32)
-        for m in range(kk):
-            w_m = wit_safe[:, m]
-            rows = claims_tgt[t_safe]
-            # anti-echo: drop claims equal to what the witness itself
-            # delivered to this target in 5b
-            echo = deliv_wit[w_m] & (rows == st.view_key[w_m])
-            slot_in, _ = _receiver_merge(
-                w_m,
-                ack_del[:, m],
-                jnp.where(ack_del[:, m][:, None] & ~echo, rows, 0),
-            )
-            in_c = jnp.maximum(in_c, slot_in)
-        mrg = _merge_incoming(st, in_c, nwit_ack > 0, sl_start)
-        st = mrg.state
-        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
-        flapped = flapped | mrg.flapped
-        st, applied_total = jax.lax.optimization_barrier((st, applied_total))
+
+        def in_c(st2):
+            claims_tgt = jnp.where(issue_tgt, st2.view_key, 0)
+            acc_in = jnp.zeros((n, n), jnp.int32)
+            for m in range(kk):
+                w_m = wit_safe[:, m]
+                rows = claims_tgt[t_safe]
+                # anti-echo: drop claims equal to what the witness itself
+                # delivered to this target in 5b
+                echo = deliv_wit[w_m] & (rows == st2.view_key[w_m])
+                slot_in, _ = _receiver_merge(
+                    w_m,
+                    ack_del[:, m],
+                    jnp.where(ack_del[:, m][:, None] & ~echo, rows, 0),
+                )
+                acc_in = jnp.maximum(acc_in, slot_in)
+            return acc_in
+
+        st, acc = _stage_merge(st, acc, jnp.any(issue_tgt), in_c, nwit_ack > 0)
 
         # -- 5d: the witness response carries its (fresh) changes ---------
         # issue set from the post-5c state: what the witness just learned
         # from the target (pb 0) ships here — the implicit-alive path
         st, issue_wit2 = _stage_issue(st, nsrv, maxpb8)
-        claims_wit2 = jnp.where(issue_wit2, st.view_key, 0)
         any_resp = jnp.any(resp_del, axis=1)
-        in_d = jnp.zeros((n, n), jnp.int32)
-        for m in range(kk):
-            rows = claims_wit2[wit_safe[:, m]]
-            echo = deliv_src & (rows == st.view_key)
-            in_d = jnp.maximum(
-                in_d,
-                jnp.where(resp_del[:, m][:, None] & ~echo, rows, 0),
-            )
-        mrg = _merge_incoming(st, in_d, any_resp, sl_start)
-        st = mrg.state
-        applied_total += jnp.sum(mrg.applied, dtype=jnp.int32)
-        flapped = flapped | mrg.flapped
-        return st, applied_total, flapped
+
+        def in_d(st2):
+            claims_wit2 = jnp.where(issue_wit2, st2.view_key, 0)
+            acc_in = jnp.zeros((n, n), jnp.int32)
+            for m in range(kk):
+                rows = claims_wit2[wit_safe[:, m]]
+                echo = deliv_src & (rows == st2.view_key)
+                acc_in = jnp.maximum(
+                    acc_in,
+                    jnp.where(resp_del[:, m][:, None] & ~echo, rows, 0),
+                )
+            return acc_in
+
+        st, acc = _stage_merge(st, acc, jnp.any(issue_wit2), in_d, any_resp)
+        return st, acc[0], acc[1]
 
     def no_exchange(st: ClusterState):
         return (
@@ -915,8 +942,11 @@ def _phase5_pingreq(
             jnp.zeros((n, n), dtype=bool) if damp_on else jnp.zeros((), dtype=bool),
         )
 
+    # With zero active changes cluster-wide the whole exchange is a
+    # proven no-op (no claims -> no merges -> no refutations) — the
+    # converged-steady-state common case skips even the bookkeeping.
     state, xch_applied, xch_flapped = jax.lax.cond(
-        jnp.any(req_del), exchange, no_exchange, state
+        jnp.any(req_del) & jnp.any(state.pb >= 0), exchange, no_exchange, state
     )
 
     # the declaration sees the post-exchange view (the reference's

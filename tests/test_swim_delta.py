@@ -47,7 +47,7 @@ def assert_matches_dense(delta: sd.DeltaState, dense: sim.ClusterState, tick):
 
 def run_both(n, ticks, params, *, capacity=None, events=(), seed=0):
     """Drive dense + delta from the same keys; yield each tick."""
-    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
     dense = sim.init_state(n)
     delta = sd.init_delta(n, capacity=capacity or n)
     net = sim.make_net(n)
@@ -136,7 +136,7 @@ def test_admin_join_and_revive_match_dense():
     through the re-dissemination of the fresh incarnation."""
     n = 16
     params = sim.SwimParams(loss=0.0, suspicion_ticks=5)
-    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
     dense = sim.init_state(n)
     delta = sd.init_delta(n, capacity=n)
     net = sim.make_net(n)
@@ -167,7 +167,7 @@ def test_compact_and_rebase_preserve_views():
     the post-maintenance trajectory stays on the dense trajectory."""
     n = 24
     params = sim.SwimParams(loss=0.05, suspicion_ticks=8)
-    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
     dense = sim.init_state(n)
     delta = sd.init_delta(n, capacity=n)
     net = sim.make_net(n)
@@ -198,7 +198,7 @@ def test_rebase_folds_converged_fault():
     path.  Views must be unchanged by the fold."""
     n = 16
     params = sim.SwimParams(loss=0.0, suspicion_ticks=4)
-    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
     delta = sd.init_delta(n, capacity=n)
     net = sim.make_net(n)
     net = net._replace(up=net.up.at[2].set(False))
@@ -275,7 +275,7 @@ def test_delta_run_scan_matches_steps():
     """delta_run (lax.scan) == the same ticks stepped individually."""
     n = 16
     params = sim.SwimParams(loss=0.03)
-    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=4 * n)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
     net = sim.make_net(n)
     key = jax.random.PRNGKey(5)
     stepped = sd.init_delta(n, capacity=n)
@@ -321,10 +321,10 @@ def test_bit_identical_partition_split_and_heal():
     transition), so capacity is ample here."""
     n = 24
     params = sim.SwimParams(loss=0.02, suspicion_ticks=6)
-    # ample caps for a netsplit mean claim_grid = n * n: the post-heal
+    # ample caps for a netsplit mean claim_grid = 3 * n * n: the post-heal
     # refutation storm can concentrate every sender's full wire on one
     # receiver in a single tick (measured: 4n drops claims here)
-    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=n * n)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
     dense = sim.init_state(n)
     delta = sd.init_delta(n, capacity=n)
     gid_split = (jnp.arange(n) >= n // 2).astype(jnp.int32)
@@ -367,7 +367,7 @@ def test_simcluster_delta_matches_dense_checksums():
     dense = SimCluster(n, params, seed=11)
     delta = SimCluster(
         n, params, seed=11, backend="delta", capacity=n, wire_cap=n,
-        claim_grid=4 * n,
+        claim_grid=3 * n * n,
     )
     dense.kill(3)
     delta.kill(3)
@@ -416,7 +416,7 @@ def test_bit_identical_self_bootstrap():
     converged consensus folds into the base via rebase."""
     n = 20
     params = sim.SwimParams(loss=0.02, suspicion_ticks=6)
-    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=n * n)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
     dense = sim.init_state(n, mode="self")
     delta = sd.init_delta(n, capacity=n + 4, mode="self")
     np.testing.assert_array_equal(
@@ -446,7 +446,7 @@ def test_simcluster_delta_self_bootstrap_checksums():
     dense = SimCluster(n, init="self", seed=5)
     delta = SimCluster(
         n, init="self", seed=5, backend="delta", capacity=n + 4,
-        wire_cap=n, claim_grid=n * n,
+        wire_cap=n, claim_grid=3 * n * n,
     )
     for c in (dense, delta):
         assert not c.converged()
@@ -469,7 +469,7 @@ def test_simcluster_delta_partition_matches_dense_checksums():
     dense = SimCluster(n, params, seed=13)
     delta = SimCluster(
         n, params, seed=13, backend="delta", capacity=n, wire_cap=n,
-        claim_grid=n * n,  # netsplit-ample: see the step-parity test
+        claim_grid=3 * n * n,  # netsplit-ample: see _route_claims_multi
     )
     sides = [list(range(n // 2)), list(range(n // 2, n))]
     for c in (dense, delta):

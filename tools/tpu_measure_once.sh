@@ -17,7 +17,7 @@ print('probe ok', float((x@x).sum()))" >> "$LOG" 2>&1
 }
 
 say "waiting for TPU tunnel"
-for i in $(seq 1 48); do    # up to 4 h of 5-min waits
+for i in $(seq 1 120); do    # up to 10 h of 5-min waits
   if probe; then say "tunnel up after $i probes"; break; fi
   say "probe $i failed; sleeping 300s"
   sleep 300
