@@ -43,18 +43,43 @@ def main():
 
     tallies = collections.Counter()
     elems = collections.Counter()
-    for m in re.finditer(r'"stablehlo\.sort"\((.*?)\)', txt):
-        shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", m.group(1))
-        if not shapes:
-            continue
-        dims = shapes[0]
-        nops = len(shapes)
+
+    def _tally_sort(dims: str, nops: int) -> None:
         key_ = f"sort [{dims}] x{nops}ops"
         tallies[key_] += 1
         total = 1
         for d in dims.split("x"):
             total *= int(d)
         elems[key_] += total * nops
+
+    # older jax: inline "stablehlo.sort"(...) ops
+    for m in re.finditer(r'"stablehlo\.sort"\((.*?)\)', txt):
+        shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", m.group(1))
+        if shapes:
+            _tally_sort(shapes[0], len(shapes))
+
+    # newer jax: each sort call site lowers to a private func (named
+    # @sort*, @argsort*, ...) whose body holds the stablehlo.sort —
+    # tally CALLS to sort-bodied funcs by the call's operand signature,
+    # skipping calls made from inside other sort-bodied funcs (an
+    # argsort func calling its comparator must not double count).
+    chunks = re.split(r"(?=func\.func)", txt)
+    sort_funcs = set()
+    for ch in chunks:
+        m = re.match(r"func\.func(?: private)? @([\w$.]+)", ch)
+        if m and "stablehlo.sort" in ch:
+            sort_funcs.add(m.group(1))
+    call_re = re.compile(r"(?:func\.)?call @([\w$.]+)\([^)]*\)\s*:\s*\(([^)]*)\)")
+    for ch in chunks:
+        m = re.match(r"func\.func(?: private)? @([\w$.]+)", ch)
+        if m and m.group(1) in sort_funcs:
+            continue
+        for cm in call_re.finditer(ch):
+            if cm.group(1) not in sort_funcs:
+                continue
+            shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", cm.group(2))
+            if shapes:
+                _tally_sort(shapes[0], len(shapes))
     for opname in ("scatter", "while", "dynamic_gather"):
         for m in re.finditer(rf'"stablehlo\.{opname}"\((.*?)\)', txt):
             shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", m.group(1))

@@ -1,8 +1,9 @@
 #!/bin/bash
 # Wait for the tunneled TPU to come back, then take the round's on-chip
-# measurements in one pass (lowering race, per-phase bisect, headline
-# bench attempt).  Each stage has its own hard timeout; everything logs
-# to $LOG.  Usage: tools/tpu_measure_once.sh [logfile]
+# measurements in one pass, HEADLINE FIRST (the tunnel can die again at
+# any time — the bar for the round is the first stage).  Each stage has
+# its own hard timeout; everything logs to $LOG.
+# Usage: tools/tpu_measure_once.sh [logfile]
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_measure.log}
@@ -17,33 +18,41 @@ print('probe ok', float((x@x).sum()))" >> "$LOG" 2>&1
 }
 
 say "waiting for TPU tunnel"
-for i in $(seq 1 120); do    # up to 10 h of 5-min waits
+for i in $(seq 1 120); do   # up to 10 h of 5-min waits
   if probe; then say "tunnel up after $i probes"; break; fi
   say "probe $i failed; sleeping 300s"
   sleep 300
 done
 if ! probe; then say "tunnel never came back; giving up"; exit 1; fi
 
-say "=== stage 1: searchsorted lowering race (n=65536)"
-timeout 2400 python -u -m benchmarks.profile_searchsorted 65536 >> "$LOG" 2>&1
+say "=== stage 1: HEADLINE bench child delta@64:65536"
+timeout 1800 python -u bench.py --child delta@64:65536 >> "$LOG" 2>&1
 say "stage 1 rc=$?"
 
-say "=== stage 2: delta phase bisect (n=65536, C=64)"
-timeout 2400 python -u -m benchmarks.profile_delta_bisect 65536 64 >> "$LOG" 2>&1
-say "stage 2 rc=$?"
+say "=== stage 2: ladder rungs above 65536"
+timeout 1800 python -u bench.py --child delta@64:131072 >> "$LOG" 2>&1
+say "stage 2a rc=$?"
+timeout 1800 python -u bench.py --child delta@64:262144 >> "$LOG" 2>&1
+say "stage 2b rc=$?"
 
-say "=== stage 3: headline bench child delta@64:65536"
-timeout 1800 python -u bench.py --child delta@64:65536 >> "$LOG" 2>&1
+say "=== stage 3: delta phase bisect (n=65536, C=64) — incl. exchange"
+timeout 2400 python -u -m benchmarks.profile_delta_bisect 65536 64 >> "$LOG" 2>&1
 say "stage 3 rc=$?"
 
-say "=== stage 4: sparse-vs-dense decision (16k then 32k)"
-timeout 1800 python -u benchmarks/profile_sparse.py 16384 >> "$LOG" 2>&1
-say "stage 4a rc=$?"
-timeout 1800 python -u benchmarks/profile_sparse.py 32768 >> "$LOG" 2>&1
-say "stage 4b rc=$?"
+say "=== stage 4: searchsorted lowering race (n=65536)"
+timeout 2400 python -u -m benchmarks.profile_searchsorted 65536 >> "$LOG" 2>&1
+say "stage 4 rc=$?"
 
-say "=== stage 5: delta scale 262144 (20-tick batches, C=256)"
-timeout 3600 python -u benchmarks/bench_delta_scale.py 262144 20 >> "$LOG" 2>&1
-say "stage 5 rc=$?"
+say "=== stage 5: delta scale 262144 and 1M (VERDICT item 5)"
+timeout 2400 python -u benchmarks/bench_delta_scale.py 262144 20 >> "$LOG" 2>&1
+say "stage 5a rc=$?"
+timeout 3600 python -u benchmarks/bench_delta_scale.py 1048576 5 >> "$LOG" 2>&1
+say "stage 5b rc=$?"
+
+say "=== stage 6: config-4 netsplit heal on the delta backend"
+timeout 3600 python -u benchmarks/bench_partition_heal_delta.py 8192 >> "$LOG" 2>&1
+say "stage 6a rc=$?"
+timeout 5400 python -u benchmarks/bench_partition_heal_delta.py 32768 >> "$LOG" 2>&1
+say "stage 6b rc=$?"
 
 say "done"
