@@ -41,17 +41,27 @@ def run(
     capacity: int | None = None,
     max_heal_ticks: int = 800,
     check_every: int = 5,
+    sided: bool = False,
 ) -> list[dict]:
     from ringpop_tpu.models import swim_delta as sd
     from ringpop_tpu.models import swim_sim as sim
     from ringpop_tpu.models.cluster import SimCluster
 
-    # Peak divergence is ~n per viewer, not n/2: the post-heal
-    # refutation storm bumps EVERY member's incarnation (both sides
-    # held the other faulty, every subject refutes on hearing it), so
-    # every column diverges from the pre-split base until rebase folds
-    # the re-converged columns back in (the periodic rebase below).
-    capacity = capacity or (n + 64)
+    if sided:
+        # Sided mode (swim_delta.make_sides): per-side base rows absorb
+        # each side's consensus via anti-entropy rebase folds, so the
+        # capacity only has to hold the in-flight rumor front — n/16
+        # measured ample at 1024 (converges in ~30 post-heal ticks);
+        # 65,536 at C=4096 is a 2.7 GB state on one chip (vs 21.5 GB
+        # for the unsided ~n capacity).
+        capacity = capacity or max(256, n // 16)
+    else:
+        # Peak divergence is ~n per viewer, not n/2: the post-heal
+        # refutation storm bumps EVERY member's incarnation (both sides
+        # held the other faulty, every subject refutes on hearing it),
+        # so every column diverges from the pre-split base until rebase
+        # folds the re-converged columns back in (the periodic rebase).
+        capacity = capacity or (n + 64)
     params = sim.SwimParams(loss=loss, suspicion_ticks=suspicion_ticks)
     # Storm-grade wire: the post-heal refutation wave refreshes ~n
     # entries per viewer; the rotating wire window cycles the backlog in
@@ -70,13 +80,27 @@ def run(
 
     half = n // 2
     sides = [list(range(half)), list(range(half, n))]
-    cluster.partition(sides)
+    if sided:
+        cluster.split_sides(sides)
+    else:
+        cluster.partition(sides)
     # Heal mid-transition: suspicion has begun everywhere (the rumor
     # front saturates in ~log2(n) ticks) but cross-side suspects are
-    # still pingable, so the healed link carries probes again.
+    # still pingable, so the healed link carries probes again.  (A
+    # FULLY converged split-brain cannot remerge spontaneously in any
+    # backend — faulty members are not pingable, membership.js:135-139
+    # — that variant needs the bridge join below, and at equal
+    # incarnations even a bridge spreads the faulty consensus; the
+    # reference's operational answer is refreshed incarnations.)
     split_ticks = heal_at if heal_at is not None else suspicion_ticks + 4
     t0 = time.perf_counter()
-    cluster.tick(split_ticks)
+    done = 0
+    while done < split_ticks:
+        step_t = min(5, split_ticks - done)
+        cluster.tick(step_t)
+        done += step_t
+        if sided:
+            cluster.rebase(anti_entropy=True)
     groups_at_heal = len(cluster.checksum_groups())
 
     cluster.heal_partition()
@@ -85,10 +109,12 @@ def run(
     while heal_ticks < max_heal_ticks:
         cluster.tick(check_every)
         heal_ticks += check_every
-        if heal_ticks % 20 == 0:
+        if heal_ticks % (10 if sided else 20) == 0:
             # fold re-converged columns back into the base so the
-            # divergence tables drain as the merge progresses
-            cluster.rebase()
+            # divergence tables drain as the merge progresses (the
+            # unsided cadence stays at 20 — the round-3/4 recorded
+            # trajectories depend on it)
+            cluster.rebase(anti_entropy=sided)
         if cluster.converged():
             break
         if not bridged and heal_ticks >= 8 * suspicion_ticks:
@@ -100,11 +126,14 @@ def run(
                 cluster.join(half, 0)
                 bridged = True
     wall = time.perf_counter() - t0
+    if sided and cluster.converged():
+        cluster.rebase(anti_entropy=True)
+        cluster.fold_sides()  # leave sided mode: single base again
     groups = cluster.checksum_groups()
     m = cluster.metrics_log[-1] if cluster.metrics_log else {}
     return [
         {
-            "metric": f"delta_partition_heal_n{n}",
+            "metric": f"delta_partition_heal{'_sided' if sided else ''}_n{n}",
             "value": heal_ticks,
             "unit": "ticks_to_remerge",
             "split_ticks": split_ticks,
@@ -128,5 +157,5 @@ if __name__ == "__main__":
     heal_at = None
     if "--heal-at" in sys.argv:
         heal_at = int(sys.argv[sys.argv.index("--heal-at") + 1])
-    for row in run(n, heal_at=heal_at):
+    for row in run(n, heal_at=heal_at, sided="--sided" in sys.argv):
         print(row)

@@ -359,10 +359,33 @@ class SimCluster:
         if self.backend == "delta":
             self.state = sdelta.compact(self.state)
 
-    def rebase(self) -> None:
-        """Fold majority divergence into the base (swim_delta.rebase)."""
+    def rebase(self, anti_entropy: bool = False) -> None:
+        """Fold majority divergence into the base (swim_delta.rebase;
+        per-side in sided mode; anti_entropy=True applies the bulk
+        full-sync fold — see _fold_group)."""
         if self.backend == "delta":
-            self.state = sdelta.rebase(self.state)
+            self.state = sdelta.rebase(self.state, anti_entropy=anti_entropy)
+
+    def split_sides(self, groups: Sequence[Sequence[int]]) -> None:
+        """Enter the delta backend's sided mode for a block netsplit
+        (swim_delta.make_sides) AND partition the network to match.
+        Keeps a 50/50 split at O(N * C): each side's consensus folds
+        into its own base row via the periodic ``rebase``."""
+        if self.backend != "delta":
+            raise ValueError("split_sides is a delta-backend operation")
+        gid = np.full(self.n, -1, dtype=np.int32)
+        for g, members in enumerate(groups):
+            gid[np.asarray(members, dtype=np.int32)] = g
+        if (gid < 0).any():
+            raise ValueError("split_sides groups must cover every node")
+        self.state = sdelta.make_sides(self.state, gid)
+        self.net = self.net._replace(adj=jnp.asarray(gid))
+
+    def fold_sides(self) -> None:
+        """Leave sided mode after the remerge converges
+        (swim_delta.fold_to_single); rebase first to drain residue."""
+        if self.backend == "delta" and self.state.side is not None:
+            self.state = sdelta.fold_to_single(self.state)
 
     # -- stats ---------------------------------------------------------------
 

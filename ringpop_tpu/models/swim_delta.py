@@ -144,37 +144,92 @@ class DeltaState(NamedTuple):
     count (-1 = no recorded change), ``d_sl`` the suspicion countdown
     (-1 = no timer).  A slot is live iff ``d_subj < SENTINEL``; a live
     slot may redundantly equal the base (until ``compact``).
+
+    **Sided mode** (``side is not None`` — the structured-netsplit
+    representation): ``base_key``/``bp_*`` carry one row per base GROUP
+    ([G, N] / [G, N]), ``side[i]`` names viewer i's base row, and a
+    cross-side full sync flips the receiver to
+    ``merge_to[own_side, sender_side]`` — a host-precomputed row whose
+    base is the lattice merge of the two (``make_sides`` /
+    ``merge_base_rows``).  A 50/50 netsplit then keeps O(N * C) state:
+    each side's consensus lives in its base row, the merged consensus
+    in a third, and per-viewer tables hold only the rumor front.
+    ``side=None`` is the single-base fast path, bit-identical to the
+    pre-sided backend.
     """
 
-    base_key: jax.Array  # int32[N]
-    bp_mask: jax.Array  # bool[N]  base-pingable (alive|suspect)
-    bp_rank: jax.Array  # int32[N] exclusive prefix count of bp_mask
-    bp_list: jax.Array  # int32[N] base-pingable subjects ascending, n-padded
+    base_key: jax.Array  # int32[N] | int32[G, N] (sided)
+    bp_mask: jax.Array  # bool[N] | [G, N]  base-pingable (alive|suspect)
+    bp_rank: jax.Array  # int32[N] | [G, N] exclusive prefix count of bp_mask
+    bp_list: jax.Array  # int32[N] | [G, N] base-pingable subjects ascending
     d_subj: jax.Array  # int32[N, C]
     d_key: jax.Array  # int32[N, C]
     d_pb: jax.Array  # int8[N, C]
     d_sl: jax.Array  # int8[N, C]
     tick: jax.Array  # int32[]
     overflow_drops: jax.Array  # int32[] cumulative table-capacity drops
+    side: jax.Array | None = None  # int32[N] viewer's base row (sided mode)
+    merge_to: jax.Array | None = None  # int32[G, G] full-sync flip table
 
     @property
     def n(self) -> int:
-        return self.base_key.shape[0]
+        return self.base_key.shape[-1]
 
     @property
     def capacity(self) -> int:
         return self.d_subj.shape[1]
 
+    @property
+    def groups(self) -> int:
+        return 1 if self.side is None else self.base_key.shape[0]
+
+    # -- side-indexed base accessors (single-base: plain indexing) -------
+
+    def base_at(self, q: jax.Array) -> jax.Array:
+        """base view of subject ``q`` ([N] or [N, K], row-aligned)."""
+        qc = jnp.clip(q, 0, self.n - 1)
+        if self.side is None:
+            return self.base_key[qc]
+        s = self.side if q.ndim == 1 else self.side[:, None]
+        return self.base_key[s, qc]
+
+    def bp_mask_at(self, q: jax.Array) -> jax.Array:
+        qc = jnp.clip(q, 0, self.n - 1)
+        if self.side is None:
+            return self.bp_mask[qc]
+        s = self.side if q.ndim == 1 else self.side[:, None]
+        return self.bp_mask[s, qc]
+
+    def bp_rank_at(self, q: jax.Array) -> jax.Array:
+        qc = jnp.clip(q, 0, self.n - 1)
+        if self.side is None:
+            return self.bp_rank[qc]
+        s = self.side if q.ndim == 1 else self.side[:, None]
+        return self.bp_rank[s, qc]
+
+    def bp_list_at(self, r: jax.Array) -> jax.Array:
+        """r-th base-pingable subject per viewer row (r [N] or [N, K])."""
+        if self.side is None:
+            return self.bp_list[r]
+        s = self.side if r.ndim == 1 else self.side[:, None]
+        return self.bp_list[s, r]
+
 
 def _base_rank_structs(
     base_key: jax.Array,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    n = base_key.shape[0]
+    """Pingability rank structures; shape-polymorphic over [N] (single
+    base) and [G, N] (sided mode, one row per base group)."""
+    n = base_key.shape[-1]
     status = base_key & 7
     bp_mask = (status == ALIVE) | (status == SUSPECT)
-    bp_rank = jnp.cumsum(bp_mask.astype(jnp.int32)) - bp_mask.astype(jnp.int32)
-    ids = jnp.arange(n, dtype=jnp.int32)
-    bp_list = jnp.sort(jnp.where(bp_mask, ids, n))
+    bp_rank = jnp.cumsum(bp_mask.astype(jnp.int32), axis=-1) - bp_mask.astype(
+        jnp.int32
+    )
+    ids = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32), base_key.shape
+    )
+    bp_list = jnp.sort(jnp.where(bp_mask, ids, n), axis=-1)
     return bp_mask, bp_rank, bp_list
 
 
@@ -304,14 +359,19 @@ def view_lookup(state: DeltaState, q: jax.Array) -> jax.Array:
     pos, found = _lookup_pos(state.d_subj, q)
     dk = jnp.take_along_axis(state.d_key, pos if q.ndim > 1 else pos[:, None], axis=1)
     dk = dk if q.ndim > 1 else dk[:, 0]
-    return jnp.where(found, dk, state.base_key[jnp.clip(q, 0, state.n - 1)])
+    return jnp.where(found, dk, state.base_at(q))
 
 
 def densify(state: DeltaState) -> ClusterState:
     """Materialize the equivalent dense ClusterState (tests / hand-off
     to the dense backend; O(N^2) memory — small N only)."""
     n, c = state.n, state.capacity
-    vk = jnp.broadcast_to(state.base_key[None, :], (n, n)).astype(jnp.int32)
+    base_rows = (
+        jnp.broadcast_to(state.base_key[None, :], (n, n))
+        if state.side is None
+        else state.base_key[state.side]
+    )
+    vk = base_rows.astype(jnp.int32)
     pb = jnp.full((n, n), -1, dtype=jnp.int8)
     sl = jnp.full((n, n), -1, dtype=jnp.int8)
     live = state.d_subj < SENTINEL
@@ -408,16 +468,20 @@ def _phase0_stats(state: DeltaState) -> _Stats:
     subj_safe = jnp.where(live, state.d_subj, 0)
     d_status = state.d_key & 7
     ping_now = live & ((d_status == ALIVE) | (d_status == SUSPECT))
-    ping_base = live & state.bp_mask[subj_safe]
+    ping_base = live & state.bp_mask_at(subj_safe)
 
     # counts: base total corrected by the delta slots (self excluded for
-    # pingability, included for the ring-ish server count)
-    p_total = jnp.sum(state.bp_mask, dtype=jnp.int32)
+    # pingability, included for the ring-ish server count); per base
+    # row in sided mode ([G] totals gathered by each viewer's side)
+    if state.side is None:
+        p_total = jnp.sum(state.bp_mask, dtype=jnp.int32)
+    else:
+        p_total = jnp.sum(state.bp_mask, axis=1, dtype=jnp.int32)[state.side]
     corr = jnp.sum(ping_now.astype(jnp.int32) - ping_base.astype(jnp.int32), axis=1)
     own_pos, own_found = _lookup_pos(state.d_subj, ids)
     own_key = jnp.where(
         own_found, jnp.take_along_axis(state.d_key, own_pos[:, None], axis=1)[:, 0],
-        state.base_key,
+        state.base_at(ids),
     )
     own_status = own_key & 7
     self_pingable_in_view = (own_status == ALIVE) | (own_status == SUSPECT)
@@ -425,11 +489,17 @@ def _phase0_stats(state: DeltaState) -> _Stats:
     ping_count = server_count - self_pingable_in_view.astype(jnp.int32)
 
     # digest: base sum corrected by the delta slots
-    h_base_total = jnp.sum(_hash1(state.base_key, ids), dtype=jnp.uint32)
+    if state.side is None:
+        h_base_total = jnp.sum(_hash1(state.base_key, ids), dtype=jnp.uint32)
+    else:
+        h_base_total = jnp.sum(
+            _hash1(state.base_key, ids[None, :]), axis=1, dtype=jnp.uint32
+        )[state.side]
     h_corr = jnp.sum(
         jnp.where(
             live,
-            _hash1(state.d_key, subj_safe) - _hash1(state.base_key[subj_safe], subj_safe),
+            _hash1(state.d_key, subj_safe)
+            - _hash1(state.base_at(subj_safe), subj_safe),
             jnp.uint32(0),
         ),
         axis=1,
@@ -543,7 +613,7 @@ def _selection(
     removed = (ping_base & ~ping_now & ~is_self) | (is_self & live & ping_base)
     d_slot = added.astype(jnp.int32) - removed.astype(jnp.int32)
     self_in_delta = jnp.any(is_self & live, axis=1)
-    self_extra = state.bp_mask & ~self_in_delta
+    self_extra = state.bp_mask_at(ids) & ~self_in_delta
 
     # ``d_subj`` is subject-sorted, so slot order IS subject order: the
     # correction prefix/rank arrays need no argsort (a [N, C+1] row sort
@@ -557,7 +627,7 @@ def _selection(
     big = jnp.int32(1 << 30)
     F = jnp.where(
         corr_live,
-        state.bp_rank[jnp.clip(state.d_subj, 0, n - 1)] + (cpd - d_slot),
+        state.bp_rank_at(state.d_subj) + (cpd - d_slot),
         big,
     )
     shift = 1
@@ -591,7 +661,7 @@ def _selection(
     corr_below_self = jnp.where(
         state.d_subj[:, -1] < ids, cpd[:, -1], corr_below_self
     )
-    g_self = state.bp_rank[ids] + corr_below_self
+    g_self = state.bp_rank_at(ids) + corr_below_self
     r_eff = r_clip + (
         self_extra[:, None] & (r_clip >= g_self[:, None])
     ).astype(jnp.int32)
@@ -605,7 +675,7 @@ def _selection(
     cpd_at = jnp.where(in_corr, jnp.take_along_axis(cpd, ks_safe, axis=1), 0)
     added_answer = in_corr & (d_at == 1) & (F_at == r_eff)
     rprime = jnp.clip(r_eff - cpd_at, 0, n - 1)
-    picks = jnp.where(added_answer, su_at, state.bp_list[rprime])  # [N, k+1]
+    picks = jnp.where(added_answer, su_at, state.bp_list_at(rprime))  # [N, k+1]
 
     target = jnp.where(valid[:, 0], picks[:, 0], -1)
     has_target = valid[:, 0]
@@ -678,7 +748,7 @@ def _merge_claims(
     cur = jnp.where(
         found,
         jnp.take_along_axis(state.d_key, pos, axis=1),
-        state.base_key[subj_q],
+        state.base_at(subj_q),
     )
     applies = valid & ~is_self & _apply_mask(cur, c_key)
 
@@ -704,7 +774,7 @@ def _merge_claims(
     self_cur_inc = jnp.where(
         jnp.any((state.d_subj == ids[:, None]) & stats_live, axis=1),
         jnp.max(jnp.where((state.d_subj == ids[:, None]) & stats_live, state.d_key, 0), axis=1),
-        state.base_key,
+        state.base_at(ids),
     ) >> 3
     new_self_key = (jnp.maximum(self_cur_inc, rumor_inc) + 1) * 8 + ALIVE
     self_slot = (state.d_subj == ids[:, None]) & stats_live
@@ -1109,9 +1179,71 @@ def delta_step_impl(
             return out.state, out.applied_points
 
         def with_fs(st2):
-            # receiver's delta table is its entire divergence from the
-            # shared base: full sync = those claims + base claims at
-            # sender slots the receiver doesn't override.
+            # receiver's delta table is its entire divergence from ITS
+            # base: full sync = those claims + base claims at sender
+            # slots the receiver doesn't override (+ in sided mode the
+            # base FLIP below, which covers the receiver-base-vs-
+            # sender-base bulk without materializing it as claims).
+            fs_provider_side = None
+            if st2.side is not None:
+                # Sided mode: the full-sync PROVIDER is the ping
+                # receiver (t_safe); the adopter is the ping sender
+                # (this viewer row).  A cross-side sync flips the
+                # adopter onto the merge row — its base becomes the
+                # lattice merge of both bases (host invariant of
+                # merge_to), so every UNSLOTTED entry adopts
+                # lmerge(base_s, base_r) wholesale.  Flip before the
+                # claim merges: provider slots then apply against the
+                # post-flip view.  Sided deviation (documented):
+                # flip-adopted entries get no pb records — peers learn
+                # them via their own syncs.
+                fs_provider_side = st2.side[t_safe]
+                flip = fs_apply & (fs_provider_side != st2.side)
+                st2 = st2._replace(
+                    side=jnp.where(
+                        flip, st2.merge_to[st2.side, fs_provider_side], st2.side
+                    )
+                )
+                # Absorb the merged base: slots the new base already
+                # covers (slot value does not beat M) drop — the view
+                # rises monotonically to M, stale slots stop masking
+                # the better base value, and the row drains so the
+                # refutation below always has a free slot.  Dropped
+                # slots' pb duty is forfeited (flip semantics); their
+                # suspicion timers are void (status superseded).
+                live2 = st2.d_subj < SENTINEL
+                subj2 = jnp.where(live2, st2.d_subj, 0)
+                m_at = st2.base_at(subj2)
+                is_self_slot = st2.d_subj == ids[:, None]
+                keep = live2 & (
+                    ~flip[:, None]
+                    | _apply_mask(m_at, st2.d_key)
+                    | is_self_slot  # permanent (see make_sides)
+                )
+                # a kept self slot superseded by M adopts M's value so
+                # the view still rises (refutation below then sees it)
+                lift_self = live2 & is_self_slot & flip[:, None] & ~_apply_mask(
+                    m_at, st2.d_key
+                ) & (m_at > st2.d_key)
+                st2 = st2._replace(
+                    d_key=jnp.where(lift_self, m_at, st2.d_key),
+                    d_pb=jnp.where(lift_self, jnp.int8(-1), st2.d_pb),
+                    d_sl=jnp.where(lift_self, jnp.int8(-1), st2.d_sl),
+                )
+                f_subj = jnp.where(keep, st2.d_subj, SENTINEL)
+                order_f = jnp.argsort(f_subj, axis=1)
+                st2 = st2._replace(
+                    d_subj=jnp.take_along_axis(f_subj, order_f, axis=1),
+                    d_key=jnp.take_along_axis(
+                        jnp.where(keep, st2.d_key, 0), order_f, axis=1
+                    ),
+                    d_pb=jnp.take_along_axis(
+                        jnp.where(keep, st2.d_pb, jnp.int8(-1)), order_f, axis=1
+                    ),
+                    d_sl=jnp.take_along_axis(
+                        jnp.where(keep, st2.d_sl, jnp.int8(-1)), order_f, axis=1
+                    ),
+                )
             fs_subj0 = st2.d_subj[t_safe]  # [N, C]
             fs_key0 = st2.d_key[t_safe]
             fs_valid0 = (fs_subj0 < SENTINEL) & fs_apply[:, None]
@@ -1125,11 +1257,16 @@ def delta_step_impl(
             )
             st3 = out.state
             # base claims at sender-side slots absent from the
-            # receiver's table (receiver's view there == base)
+            # receiver's table (receiver's view there == its base)
             live3 = st3.d_subj < SENTINEL
             subj_safe3 = jnp.where(live3, st3.d_subj, 0)
             rpos, rfound = _lookup_pos(st2.d_subj[t_safe], subj_safe3)
-            base_claim = st3.base_key[subj_safe3]
+            if st3.side is None:
+                base_claim = st3.base_key[subj_safe3]
+            else:
+                # the PROVIDER's base: its view at its unslotted
+                # subjects is exactly its base row
+                base_claim = st3.base_key[fs_provider_side[:, None], subj_safe3]
             applies_b = (
                 live3
                 & fs_apply[:, None]
@@ -1144,10 +1281,25 @@ def delta_step_impl(
                 applies_b & (nst == SUSPECT), jnp.int8(sl_start), st3.d_sl
             )
             d_sl = jnp.where(applies_b & (nst != SUSPECT), jnp.int8(-1), d_sl)
-            return (
-                st3._replace(d_key=d_key, d_pb=d_pb, d_sl=d_sl),
-                out.applied_points + jnp.sum(applies_b, dtype=jnp.int32),
-            )
+            st4 = st3._replace(d_key=d_key, d_pb=d_pb, d_sl=d_sl)
+            applied_b = out.applied_points + jnp.sum(applies_b, dtype=jnp.int32)
+            if st4.side is not None:
+                # a flip can adopt a suspect/faulty claim about the
+                # sender ITSELF through the merged base (the dense full
+                # sync would refute in the same merge) — refute now
+                own_now = view_lookup(st4, ids)
+                own_st = own_now & 7
+                need_ref = fs_apply & ((own_st == SUSPECT) | (own_st == FAULTY))
+                out2 = _merge_claims(
+                    st4,
+                    ids[:, None],
+                    own_now[:, None],
+                    need_ref[:, None],
+                    sl_start,
+                )
+                st4 = out2.state
+                applied_b = applied_b + out2.applied_points
+            return st4, applied_b
 
         return jax.lax.cond(any_fs, with_fs, normal, st)
 
@@ -1286,10 +1438,16 @@ def delta_step_impl(
                 # and its current belief equals the claim
                 _, in_sent = _lookup_pos(wit_sent_subj[w_m], subj_q)
                 pos_w, found_w = _lookup_pos(st2.d_subj[w_m], subj_q)
+                if st2.side is None:
+                    base_w = st2.base_key[subj_q]
+                else:
+                    # the WITNESS's base row (its view is being probed),
+                    # not the source viewer's
+                    base_w = st2.base_key[st2.side[w_m][:, None], subj_q]
                 cur_w = jnp.where(
                     found_w,
                     jnp.take_along_axis(st2.d_key[w_m], pos_w, axis=1),
-                    st2.base_key[subj_q],
+                    base_w,
                 )
                 echo = in_sent & (key_c == cur_w)
                 segs.append(
@@ -1473,7 +1631,10 @@ def materialize_rows(state: DeltaState, idx: jax.Array) -> jax.Array:
     subj = state.d_subj[idx]  # [K, C]
     keyv = state.d_key[idx]
     live = subj < SENTINEL
-    rows = jnp.broadcast_to(state.base_key[None, :], (idx.shape[0], n))
+    if state.side is None:
+        rows = jnp.broadcast_to(state.base_key[None, :], (idx.shape[0], n))
+    else:
+        rows = state.base_key[state.side[idx]]
     k_ids = jnp.arange(idx.shape[0], dtype=jnp.int32)[:, None]
     # NOT unique_indices: every empty slot maps to the same dropped
     # column n, so the index array repeats n whenever a row has two or
@@ -1501,7 +1662,10 @@ def _converged_impl(
     ref_subj = state.d_subj[ref]  # [C]
     ref_key = state.d_key[ref]
     ref_live = ref_subj < SENTINEL
-    ref_row = state.base_key.at[jnp.where(ref_live, ref_subj, n)].set(
+    ref_base = (
+        state.base_key if state.side is None else state.base_key[state.side[ref]]
+    )
+    ref_row = ref_base.at[jnp.where(ref_live, ref_subj, n)].set(
         jnp.where(ref_live, ref_key, 0), mode="drop"
     )
 
@@ -1510,10 +1674,25 @@ def _converged_impl(
     ok_slots = jnp.all(
         jnp.where(slots_live, state.d_key == ref_row[subj_safe], True), axis=1
     )
-    div = ref_live & (ref_key != state.base_key[jnp.clip(ref_subj, 0, n - 1)])
-    q = jnp.broadcast_to(jnp.where(div, ref_subj, 0)[None, :], (n, c))
-    _, found = _lookup_pos(state.d_subj, q)
-    ok_cover = jnp.all(jnp.where(div[None, :], found, True), axis=1)
+    # viewer i must hold a slot wherever the ref row diverges from i's
+    # OWN base.  Single base: one divergence set, checked by lookup.
+    # Sided: the set differs per base row — count i's slots at its
+    # side's divergence subjects and require all of them present
+    # (exact, O(N * C + G * N); slot VALUES are checked by ok_slots).
+    if state.side is None:
+        div_ref = ref_live & (ref_key != ref_base[jnp.clip(ref_subj, 0, n - 1)])
+        q = jnp.broadcast_to(jnp.where(div_ref, ref_subj, 0)[None, :], (n, c))
+        _, found = _lookup_pos(state.d_subj, q)
+        ok_cover = jnp.all(jnp.where(div_ref[None, :], found, True), axis=1)
+    else:
+        need_cover = state.base_key != ref_row[None, :]  # bool[G, N]
+        need_count = jnp.sum(need_cover, axis=1, dtype=jnp.int32)[state.side]
+        have = jnp.sum(
+            slots_live & need_cover[state.side[:, None], subj_safe],
+            axis=1,
+            dtype=jnp.int32,
+        )
+        ok_cover = have == need_count
     row_same = ok_slots & ok_cover
     return jnp.all(jnp.where(live, row_same, True)) | (jnp.sum(live) <= 1)
 
@@ -1530,10 +1709,16 @@ def compact(state: DeltaState) -> DeltaState:
     live = state.d_subj < SENTINEL
     subj_safe = jnp.where(live, state.d_subj, 0)
     needed = live & (
-        (state.d_key != state.base_key[subj_safe])
+        (state.d_key != state.base_at(subj_safe))
         | (state.d_pb >= 0)
         | (state.d_sl >= 0)
     )
+    if state.side is not None:
+        # sided mode keeps permanent self slots (see make_sides)
+        needed = needed | (
+            live
+            & (state.d_subj == jnp.arange(state.n, dtype=jnp.int32)[:, None])
+        )
     d_subj = jnp.where(needed, state.d_subj, SENTINEL)
     order = jnp.argsort(d_subj, axis=1)
     return state._replace(
@@ -1548,7 +1733,7 @@ def compact(state: DeltaState) -> DeltaState:
     )
 
 
-def rebase(state: DeltaState) -> DeltaState:
+def rebase(state: DeltaState, anti_entropy: bool = False) -> DeltaState:
     """Fold majority divergence into the base (host-side, rare).
 
     For each subject, if most viewers have converged on one new value
@@ -1569,68 +1754,31 @@ def rebase(state: DeltaState) -> DeltaState:
     d_sl = np.asarray(state.d_sl).copy()
     base = np.asarray(state.base_key).copy()
 
-    live = d_subj < int(SENTINEL)
-    rows, cols = np.nonzero(live)
-    if rows.size == 0:
-        return state
-    subs = d_subj[rows, cols]
-    keys = d_key[rows, cols]
-    busy = (d_pb[rows, cols] >= 0) | (d_sl[rows, cols] >= 0)
-    cnt = np.bincount(subs, minlength=n)  # slot-holders per subject
-
-    # Candidate fold value per subject: the most common value among
-    # droppable (non-busy) slots.  Post-compact these all differ from
-    # the current base.  Busy slots keep their slot either way (their
-    # pb/sl records need a home even when the value matches the base).
-    dr = ~busy
-    if not dr.any():
-        return state
-    s_d, k_d, r_d = subs[dr], keys[dr], rows[dr]
-    order = np.lexsort((k_d, s_d))
-    s_s, k_s = s_d[order], k_d[order]
-    new_run = np.ones(len(s_s), dtype=bool)
-    new_run[1:] = (s_s[1:] != s_s[:-1]) | (k_s[1:] != k_s[:-1])
-    run_ids = np.cumsum(new_run) - 1
-    run_counts = np.bincount(run_ids)
-    run_subj = s_s[new_run]
-    run_key = k_s[new_run]
-    # inserts needed = viewers with no slot at the subject (they hold
-    # the old base view and must keep holding it after the fold)
-    gains = run_counts - (n - cnt[run_subj])
-    # best candidate per subject (max gain)
-    best = np.lexsort((gains, run_subj))
-    last_of_subj = np.ones(len(best), dtype=bool)
-    last_of_subj[:-1] = run_subj[best][1:] != run_subj[best][:-1]
-    pick = best[last_of_subj]
-    pick = pick[gains[pick] > 0]
-    if pick.size == 0:
-        return state
-
-    occ = live.sum(axis=1)
-    for p in pick[np.argsort(-gains[pick])]:
-        j = int(run_subj[p])
-        v = int(run_key[p])
-        has_slot = np.zeros((n,), dtype=bool)
-        has_slot[rows[subs == j]] = True
-        need_insert_idx = np.flatnonzero(~has_slot)
-        if np.any(occ[need_insert_idx] >= cap):
-            continue  # a compensating insert would overflow; skip
-        # drop convergent droppable slots of value v
-        drop_mask = live & (d_subj == j) & (d_key == v) & (d_pb < 0) & (d_sl < 0)
-        d_subj[drop_mask] = int(SENTINEL)
-        # insert compensating (j, old base) slots
-        for i in need_insert_idx:
-            free = np.flatnonzero(d_subj[i] == int(SENTINEL))
-            c = free[0]
-            d_subj[i, c] = j
-            d_key[i, c] = base[j]
-            d_pb[i, c] = -1
-            d_sl[i, c] = -1
-        base[j] = v
-        live = d_subj < int(SENTINEL)
-        occ = live.sum(axis=1)
-        rows, cols = np.nonzero(live)
-        subs = d_subj[rows, cols]
+    if state.side is None:
+        _fold_group(
+            d_subj, d_key, d_pb, d_sl, base, np.arange(n), cap,
+            anti_entropy=anti_entropy,
+        )
+    else:
+        side = np.asarray(state.side)
+        for g in range(base.shape[0]):
+            members = np.flatnonzero(side == g)
+            if members.size:
+                _fold_group(
+                    d_subj, d_key, d_pb, d_sl, base[g], members, cap,
+                    anti_entropy=anti_entropy,
+                )
+        # Refresh merge-target rows: a flip must never regress the
+        # adopter's view, so every merge row is lifted to the lattice
+        # merge of itself and its source rows after per-side folds.
+        mt = np.asarray(state.merge_to)
+        for g1 in range(mt.shape[0]):
+            for g2 in range(mt.shape[1]):
+                m = int(mt[g1, g2])
+                if m != g1 or m != g2:
+                    base[m] = _lmerge_np(
+                        base[m], _lmerge_np(base[g1], base[g2])
+                    )
 
     order2 = np.argsort(d_subj, axis=1)
     d_subj = np.take_along_axis(d_subj, order2, axis=1)
@@ -1655,6 +1803,345 @@ def rebase(state: DeltaState) -> DeltaState:
         d_pb=jnp.asarray(d_pb),
         d_sl=jnp.asarray(d_sl),
     )
+
+
+def make_sides(state: DeltaState, gid: np.ndarray | jax.Array) -> DeltaState:
+    """Enter sided mode for a block netsplit (host-side, at split time).
+
+    ``gid[i]`` in 0..G-1 assigns every viewer a side.  Creates G + 1
+    base rows — one per side (each a copy of the current base) plus ONE
+    merge row (their lattice merge — initially identical) — and the
+    ``merge_to`` flip table: ``merge_to[g, g] = g``; any cross pair
+    flips to the merge row.  Per-side `rebase` then lets each side's
+    consensus (e.g. "the other side is faulty") fold into its own row
+    while the merge row tracks the lattice merge of all — the
+    structured-netsplit representation that keeps a 50/50 split at
+    O(N * C).  Use with the matching group-id ``NetState.adj``."""
+    if state.side is not None:
+        raise ValueError("already sided; fold_to_single first")
+    gid = np.asarray(gid, dtype=np.int32)
+    g = int(gid.max()) + 1 if gid.size else 1
+    base = np.asarray(state.base_key)
+    rows = np.broadcast_to(base, (g + 1, base.shape[0])).copy()
+    merge_to = np.full((g + 1, g + 1), g, dtype=np.int32)
+    np.fill_diagonal(merge_to, np.arange(g + 1))
+    bp_mask, bp_rank, bp_list = _base_rank_structs(jnp.asarray(rows))
+    state = state._replace(
+        base_key=jnp.asarray(rows),
+        bp_mask=bp_mask,
+        bp_rank=bp_rank,
+        bp_list=bp_list,
+        side=jnp.asarray(gid),
+        merge_to=jnp.asarray(merge_to),
+    )
+    # Permanent self slots: in sided mode every viewer always holds its
+    # own entry, so the self-refutation (membership.js:243-254) is an
+    # in-place update that can NEVER be starved by a full table — a
+    # dropped refutation leaves the member believing itself faulty and
+    # silent forever (measured: 12 permanently-silent members at n=64
+    # before this).  compact / folds / flips all preserve them.
+    # One vectorized pass: viewers lacking a self slot write
+    # (i, base[i], -1, -1) into their first free column, then re-sort.
+    n = state.n
+    d_subj = np.asarray(state.d_subj).copy()
+    d_key = np.asarray(state.d_key).copy()
+    d_pb = np.asarray(state.d_pb).copy()
+    d_sl = np.asarray(state.d_sl).copy()
+    ids = np.arange(n)
+    has_self = (d_subj == ids[:, None]).any(axis=1)
+    need = ~has_self
+    if need.any():
+        free_col = np.argmax(d_subj == int(SENTINEL), axis=1)
+        if not (d_subj[need, free_col[need]] == int(SENTINEL)).all():
+            raise ValueError("make_sides: no free slot for a self entry")
+        r = ids[need]
+        c = free_col[need]
+        d_subj[r, c] = r
+        d_key[r, c] = base[r]
+        d_pb[r, c] = -1
+        d_sl[r, c] = -1
+        order = np.argsort(d_subj, axis=1)
+        d_subj = np.take_along_axis(d_subj, order, axis=1)
+        d_key = np.take_along_axis(d_key, order, axis=1)
+        d_pb = np.take_along_axis(d_pb, order, axis=1)
+        d_sl = np.take_along_axis(d_sl, order, axis=1)
+        state = state._replace(
+            d_subj=jnp.asarray(d_subj),
+            d_key=jnp.asarray(d_key),
+            d_pb=jnp.asarray(d_pb),
+            d_sl=jnp.asarray(d_sl),
+        )
+    return state
+
+
+def fold_to_single(state: DeltaState) -> DeltaState:
+    """Leave sided mode (host-side, after the remerge converges).
+
+    The single base becomes the lattice merge of all rows; viewers
+    whose own base row still differs from it at some subject get
+    compensating slots (their views must not move).  Call after
+    `rebase` has drained the merge — the residual diffs are then ~0."""
+    if state.side is None:
+        return state
+    base_rows = np.asarray(state.base_key)
+    side = np.asarray(state.side)
+    merged = base_rows[0].copy()
+    for gr in range(1, base_rows.shape[0]):
+        merged = _lmerge_np(merged, base_rows[gr])
+    d_subj = np.asarray(state.d_subj).copy()
+    d_key = np.asarray(state.d_key).copy()
+    d_pb = np.asarray(state.d_pb).copy()
+    d_sl = np.asarray(state.d_sl).copy()
+    n, cap = state.n, state.capacity
+    for i in range(n):
+        own = base_rows[side[i]]
+        diff = np.flatnonzero(own != merged)
+        if diff.size == 0:
+            continue
+        row = d_subj[i]
+        have = set(row[row < int(SENTINEL)].tolist())
+        need = [j for j in diff if j not in have]
+        free = np.flatnonzero(row == int(SENTINEL))
+        if len(need) > free.size:
+            raise ValueError(
+                f"viewer {i}: {len(need)} compensating slots exceed free "
+                f"capacity {free.size}; rebase before fold_to_single"
+            )
+        for c, j in zip(free, need):
+            d_subj[i, c] = j
+            d_key[i, c] = own[j]
+            d_pb[i, c] = -1
+            d_sl[i, c] = -1
+        order = np.argsort(d_subj[i])
+        d_subj[i] = d_subj[i][order]
+        d_key[i] = np.where(d_subj[i] < int(SENTINEL), d_key[i][order], 0)
+        d_pb[i] = np.where(d_subj[i] < int(SENTINEL), d_pb[i][order], -1)
+        d_sl[i] = np.where(d_subj[i] < int(SENTINEL), d_sl[i][order], -1)
+    bp_mask, bp_rank, bp_list = _base_rank_structs(jnp.asarray(merged))
+    return state._replace(
+        base_key=jnp.asarray(merged),
+        bp_mask=bp_mask,
+        bp_rank=bp_rank,
+        bp_list=bp_list,
+        d_subj=jnp.asarray(d_subj),
+        d_key=jnp.asarray(d_key),
+        d_pb=jnp.asarray(d_pb),
+        d_sl=jnp.asarray(d_sl),
+        side=None,
+        merge_to=None,
+    )
+
+
+def _lmerge_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Pointwise lattice merge of two base rows (the host twin of
+    _apply_mask: numeric max, except leave is only beaten by alive)."""
+    beats = (b > a) & ~(((a & 7) == LEAVE) & ((b & 7) != ALIVE)) & (b > 0)
+    return np.where(beats, b, a)
+
+
+def _fold_group(
+    d_subj: np.ndarray,
+    d_key: np.ndarray,
+    d_pb: np.ndarray,
+    d_sl: np.ndarray,
+    base_row: np.ndarray,
+    members: np.ndarray,
+    cap: int,
+    anti_entropy: bool = False,
+) -> None:
+    """The rebase fold over one viewer group, in place.
+
+    Default (view-preserving): for each subject, if the group's
+    droppable (non-busy) slots mostly agree on one value, fold it into
+    ``base_row``; group members WITHOUT a slot get compensating slots
+    carrying the old base value (their views must not move).  A subject
+    folds only when it nets slots back and no compensating insert would
+    overflow.  Non-member rows are untouched (their views live against
+    other base rows).
+
+    ``anti_entropy=True`` (sided netsplit maintenance): fold each
+    subject to the group's LATTICE-MAX value and drop superseded slots
+    (value <= fold) without compensation — members' views advance
+    monotonically to a value a peer genuinely holds, i.e. the
+    dissemination layer's full-sync delivery applied in bulk at
+    maintenance time (dissemination.js:61-76 semantics on a schedule).
+    This is what keeps a capacity-bounded heal moving: the refutation
+    storm's per-viewer divergence exceeds any bounded table, and the
+    view-preserving fold wedges on never-unanimous columns (measured:
+    n=256/C=64 heal pinned at 256 digests with ~8k drops/tick).
+    Subjects involving leave-status values are skipped (numeric max is
+    not the lattice join across the leave guard).  Active pb records on
+    dropped slots are forfeited (their duty passes to the base
+    consensus) — a documented bounded-resource deviation."""
+    if anti_entropy:
+        _fold_group_anti_entropy(d_subj, d_key, d_pb, d_sl, base_row, members)
+        return
+    nm = members.size
+    n = base_row.shape[0]
+    ds = d_subj[members]
+    dk = d_key[members]
+    dpb = d_pb[members]
+    dsl = d_sl[members]
+
+    live = ds < int(SENTINEL)
+    rows, cols = np.nonzero(live)
+    if rows.size == 0:
+        return
+    subs = ds[rows, cols]
+    busy = (dpb[rows, cols] >= 0) | (dsl[rows, cols] >= 0)
+    cnt = np.bincount(subs, minlength=n)  # member slot-holders per subject
+
+    dr = ~busy
+    if not dr.any():
+        return
+    s_d, k_d = subs[dr], dk[rows, cols][dr]
+    order = np.lexsort((k_d, s_d))
+    s_s, k_s = s_d[order], k_d[order]
+    new_run = np.ones(len(s_s), dtype=bool)
+    new_run[1:] = (s_s[1:] != s_s[:-1]) | (k_s[1:] != k_s[:-1])
+    run_ids = np.cumsum(new_run) - 1
+    run_counts = np.bincount(run_ids)
+    run_subj = s_s[new_run]
+    run_key = k_s[new_run]
+    gains = run_counts - (nm - cnt[run_subj])
+    best = np.lexsort((gains, run_subj))
+    last_of_subj = np.ones(len(best), dtype=bool)
+    last_of_subj[:-1] = run_subj[best][1:] != run_subj[best][:-1]
+    pick = best[last_of_subj]
+    pick = pick[gains[pick] > 0]
+    if pick.size == 0:
+        return
+
+    occ = live.sum(axis=1)
+    for p in pick[np.argsort(-gains[pick])]:
+        j = int(run_subj[p])
+        v = int(run_key[p])
+        has_slot = np.zeros((nm,), dtype=bool)
+        has_slot[rows[subs == j]] = True
+        need_insert_idx = np.flatnonzero(~has_slot)
+        if np.any(occ[need_insert_idx] >= cap):
+            continue  # a compensating insert would overflow; skip
+        drop_mask = live & (ds == j) & (dk == v) & (dpb < 0) & (dsl < 0)
+        ds[drop_mask] = int(SENTINEL)
+        for i in need_insert_idx:
+            free = np.flatnonzero(ds[i] == int(SENTINEL))
+            c = free[0]
+            ds[i, c] = j
+            dk[i, c] = base_row[j]
+            dpb[i, c] = -1
+            dsl[i, c] = -1
+        base_row[j] = v
+        live = ds < int(SENTINEL)
+        occ = live.sum(axis=1)
+        rows, cols = np.nonzero(live)
+        subs = ds[rows, cols]
+
+    d_subj[members] = ds
+    d_key[members] = dk
+    d_pb[members] = dpb
+    d_sl[members] = dsl
+
+
+def _fold_group_anti_entropy(
+    d_subj: np.ndarray,
+    d_key: np.ndarray,
+    d_pb: np.ndarray,
+    d_sl: np.ndarray,
+    base_row: np.ndarray,
+    members: np.ndarray,
+) -> None:
+    """Lattice-max fold (see _fold_group's anti_entropy doc), in place,
+    fully vectorized: one lexsort over the group's live slots."""
+    ds = d_subj[members]
+    dk = d_key[members]
+    live = ds < int(SENTINEL)
+    rows, cols = np.nonzero(live)
+    if rows.size == 0:
+        return
+    subs = ds[rows, cols]
+    keys = dk[rows, cols]
+    order = np.lexsort((keys, subs))
+    s_s, k_s = subs[order], keys[order]
+    starts = np.ones(len(s_s), dtype=bool)
+    starts[1:] = s_s[1:] != s_s[:-1]
+    run_subj = s_s[starts]
+    # ascending key sort per run -> run max is the last element
+    ends = np.flatnonzero(np.append(starts[1:], True))
+    run_max = k_s[ends]
+    has_leave = (
+        np.add.reduceat((k_s & 7) == LEAVE, np.flatnonzero(starts)) > 0
+    )
+    fold = (
+        (run_max > base_row[run_subj])
+        & ~has_leave
+        & ((base_row[run_subj] & 7) != LEAVE)
+        # never fold SUSPECT values: their suspicion timers live in
+        # slots, so a base-resident suspect would neither expire to
+        # faulty nor ever be re-disseminated — a frozen consensus the
+        # protocol cannot leave (measured: one column stuck suspect
+        # forever at n=64).  Suspects stay in bounded tables; only the
+        # stable alive/faulty states fold.
+        & ((run_max & 7) != SUSPECT)
+    )
+    if not fold.any():
+        return
+    v_of = base_row.copy()
+    v_of[run_subj[fold]] = run_max[fold]
+    folded = np.zeros(base_row.shape[0], dtype=bool)
+    folded[run_subj[fold]] = True
+    # drop superseded member slots (value <= the fold), keep newer ones;
+    # self slots are permanent (sided mode, see make_sides) — lift their
+    # value to the fold instead so the view still advances
+    subs_all = np.where(live, ds, 0)
+    is_self_slot = live & (ds == members[:, None])
+    superseded = live & folded[subs_all] & (dk <= v_of[subs_all])
+    drop = superseded & ~is_self_slot
+    lift = superseded & is_self_slot
+    ds[drop] = int(SENTINEL)
+    dkm = d_key[members]
+    dpm = d_pb[members]
+    dsm = d_sl[members]
+    dkm[drop] = 0
+    dpm[drop] = -1
+    dsm[drop] = -1
+    dkm[lift] = v_of[subs_all][lift]
+    dpm[lift] = -1
+    dsm[lift] = -1
+    base_row[folded] = v_of[folded]
+
+    # Refutation (membership.js:243-254 applied to the bulk delivery):
+    # a fold may carry a suspect/faulty rumor about a MEMBER of this
+    # very side — without the refutation the member's own view of
+    # itself goes non-alive and it stops gossiping forever (the dense
+    # path refutes on every such arrival).  Re-assert alive at
+    # rumor_inc + 1 with a fresh dissemination record, unless a
+    # surviving self slot already overrides the folded value.
+    folded_self = folded[members] & np.isin(
+        v_of[members] & 7, (SUSPECT, FAULTY)
+    )
+    for li in np.flatnonzero(folded_self):
+        i = int(members[li])
+        row = ds[li]
+        hit = np.flatnonzero(row == i)
+        new_key = ((int(v_of[i]) >> 3) + 1) * 8 + ALIVE
+        if hit.size:
+            if int(dkm[li, hit[0]]) > int(v_of[i]):
+                continue  # already refuted past the rumor
+            c = int(hit[0])
+        else:
+            free = np.flatnonzero(row == int(SENTINEL))
+            if not free.size:
+                continue  # full row: the gossip path will refute later
+            c = int(free[0])
+            ds[li, c] = i
+        dkm[li, c] = new_key
+        dpm[li, c] = 0
+        dsm[li, c] = -1
+
+    d_subj[members] = ds
+    d_key[members] = dkm
+    d_pb[members] = dpm
+    d_sl[members] = dsm
 
 
 # ---------------------------------------------------------------------------
@@ -1693,18 +2180,26 @@ def _set_entry(
     return st
 
 
+def _base_row_np(state: DeltaState, viewer: int) -> np.ndarray:
+    """Viewer's base row as numpy (side-aware)."""
+    base = np.asarray(state.base_key)
+    if state.side is None:
+        return base
+    return base[int(np.asarray(state.side)[viewer])]
+
+
 def view_of(state: DeltaState, viewer: int, subject: int) -> int:
     row = np.asarray(state.d_subj[viewer])
     hit = np.nonzero(row == subject)[0]
     if hit.size:
         return int(np.asarray(state.d_key[viewer])[hit[0]])
-    return int(np.asarray(state.base_key)[subject])
+    return int(_base_row_np(state, viewer)[subject])
 
 
 def _materialize_row(state: DeltaState, i: int):
     """Dense (vk, pb, sl) of viewer ``i`` (host-side numpy)."""
     n = state.n
-    vk = np.asarray(state.base_key).copy()
+    vk = _base_row_np(state, i).copy()
     pb = np.full(n, -1, np.int8)
     sl = np.full(n, -1, np.int8)
     subj = np.asarray(state.d_subj[i])
@@ -1734,7 +2229,7 @@ def _write_row(
     pressure, so it must not pollute ``overflow_drops`` (at 65k nodes a
     single join would otherwise add ~n to the metric)."""
     n, cap = state.n, state.capacity
-    base = np.asarray(state.base_key)
+    base = _base_row_np(state, i)
     need = (vk != base) | (pb >= 0) | (sl >= 0)
     subs = np.flatnonzero(need)
     dropped = 0
@@ -1801,6 +2296,15 @@ def admin_join(state: DeltaState, joiner: int, seed: int) -> DeltaState:
     jvk = np.where(learned, svk, jvk)
     jpb = np.where(learned, np.int8(0), jpb)
     jvk[joiner] = ALIVE if j_key == 0 else j_key
+    if state.side is not None:
+        # a cross-side join is a full-sync adoption: flip the joiner to
+        # the merge row first so the re-sparsification below happens
+        # against a base that already carries both sides' consensus
+        side = np.asarray(state.side).copy()
+        j_g, s_g = int(side[joiner]), int(side[seed])
+        if j_g != s_g:
+            side[joiner] = int(np.asarray(state.merge_to)[j_g, s_g])
+            state = state._replace(side=jnp.asarray(side))
     return _write_row(state, joiner, jvk, jpb, jsl, elide_redundant=True)
 
 

@@ -592,3 +592,124 @@ def test_long_horizon_occupancy_stays_bounded():
     # genuinely divergent subjects
     assert occ_checkpoints[-1] <= 8, occ_checkpoints
     assert occ_checkpoints[-1] <= occ_checkpoints[0] + 4, occ_checkpoints
+
+
+# ---------------------------------------------------------------------------
+# sided mode (make_sides / per-side rebase / fold_to_single)
+# ---------------------------------------------------------------------------
+
+
+def test_sided_trivial_matches_unsided():
+    """All viewers on side 0 (G=1 + merge row): every trajectory must be
+    bit-identical to the unsided single-base state — the sided machinery
+    may not perturb the default path."""
+    n = 24
+    params = sim.SwimParams(loss=0.05, suspicion_ticks=8)
+    dparams = sd.DeltaParams(swim=params, wire_cap=n, claim_grid=3 * n * n)
+    a = sd.init_delta(n, capacity=n)
+    b = sd.make_sides(sd.init_delta(n, capacity=n), np.zeros(n, np.int32))
+    net = sim.make_net(n)._replace(up=jnp.ones(n, bool).at[3].set(False))
+    keys = jax.random.split(jax.random.PRNGKey(0), 30)
+    for t in range(30):
+        a, _ = _delta_step(a, net, keys[t], dparams)
+        b, _ = _delta_step(b, net, keys[t], dparams)
+        da, db = sd.densify(a), sd.densify(b)
+        np.testing.assert_array_equal(
+            np.asarray(da.view_key), np.asarray(db.view_key), err_msg=str(t)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(da.pb), np.asarray(db.pb), err_msg=f"pb {t}"
+        )
+
+
+def test_sided_netsplit_bounded_capacity_heals():
+    """The structured netsplit: sides split at capacity n/4 (far below
+    the ~n/2 the unsided transition needs), each side's consensus folds
+    into its base row via anti-entropy rebases, the mid-transition heal
+    remerges to one view, and fold_to_single returns to a single base."""
+    n = 64
+    cap = 16
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=6)
+    dparams = sd.DeltaParams(swim=params, wire_cap=8, claim_grid=64)
+    st = sd.make_sides(
+        sd.init_delta(n, capacity=cap), (np.arange(n) >= n // 2).astype(np.int32)
+    )
+    gid = (jnp.arange(n) >= n // 2).astype(jnp.int32)
+    net = sim.make_net(n)._replace(adj=gid)
+    key = jax.random.PRNGKey(1)
+    for t in range(8):  # split; heal mid-transition
+        key, sub = jax.random.split(key)
+        st, _ = _delta_step(st, net, sub, dparams)
+        if t % 4 == 3:
+            st = sd.rebase(st, anti_entropy=True)
+    net = net._replace(adj=jnp.zeros((n,), jnp.int32))
+    conv = None
+    for t in range(300):
+        key, sub = jax.random.split(key)
+        st, m = _delta_step(st, net, sub, dparams)
+        if t % 10 == 9:
+            st = sd.rebase(st, anti_entropy=True)
+        if t > 3 and bool(sd._converged_impl(st, net.up, net.responsive)):
+            # converged views may still agree on in-flight suspects;
+            # the fixed point is all-alive once they refute/expire
+            row0 = np.asarray(sd.materialize_rows(st, jnp.asarray([0])))[0]
+            if set((row0 & 7).tolist()) == {sim.ALIVE}:
+                conv = t
+                break
+    assert conv is not None, "sided heal failed to reach the all-alive fixed point"
+    st = sd.rebase(st, anti_entropy=True)
+    st = sd.fold_to_single(st)
+    assert st.side is None
+    # single base now carries the converged all-alive consensus
+    assert set((np.asarray(st.base_key) & 7).tolist()) == {sim.ALIVE}
+
+
+def test_sided_split_consensus_folds_to_side_bases():
+    """During the split each side converges on other-side-faulty INSIDE
+    its base row with bounded tables (the whole point of sided mode)."""
+    n = 32
+    params = sim.SwimParams(loss=0.0, suspicion_ticks=5)
+    dparams = sd.DeltaParams(swim=params, wire_cap=8, claim_grid=64)
+    st = sd.make_sides(
+        sd.init_delta(n, capacity=16), (np.arange(n) >= n // 2).astype(np.int32)
+    )
+    gid = (jnp.arange(n) >= n // 2).astype(jnp.int32)
+    net = sim.make_net(n)._replace(adj=gid)
+    key = jax.random.PRNGKey(1)
+    for t in range(60):
+        key, sub = jax.random.split(key)
+        st, m = _delta_step(st, net, sub, dparams)
+        if t % 10 == 9:
+            st = sd.rebase(st, anti_entropy=True)
+    base = np.asarray(st.base_key)
+    assert set((base[0][n // 2:] & 7).tolist()) == {sim.FAULTY}
+    assert set((base[1][: n // 2] & 7).tolist()) == {sim.FAULTY}
+    assert set((base[0][: n // 2] & 7).tolist()) == {sim.ALIVE}
+    # occupancy drained back to ~0 by the folds
+    assert int(jnp.max(jnp.sum((st.d_subj < sd.SENTINEL).astype(jnp.int32), axis=1))) <= 4
+
+
+def test_simcluster_sided_scenario():
+    from ringpop_tpu.models.cluster import SimCluster
+
+    n = 32
+    c = SimCluster(
+        n, sim.SwimParams(loss=0.0, suspicion_ticks=5), seed=2,
+        backend="delta", capacity=16, wire_cap=8, claim_grid=64,
+    )
+    c.split_sides([list(range(n // 2)), list(range(n // 2, n))])
+    for _ in range(2):
+        c.tick(4)
+        c.rebase(anti_entropy=True)
+    c.heal_partition()
+    for t in range(60):
+        c.tick()
+        if t % 10 == 9:
+            c.rebase(anti_entropy=True)
+        if c.converged():
+            break
+    assert c.converged()
+    c.rebase(anti_entropy=True)
+    c.fold_sides()
+    assert c.state.side is None
+    assert len(set(c.checksums().values())) == 1

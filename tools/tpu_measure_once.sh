@@ -50,9 +50,11 @@ timeout 3600 python -u benchmarks/bench_delta_scale.py 1048576 5 >> "$LOG" 2>&1
 say "stage 5b rc=$?"
 
 say "=== stage 6: config-4 netsplit heal on the delta backend"
-timeout 3600 python -u benchmarks/bench_partition_heal_delta.py 8192 >> "$LOG" 2>&1
+timeout 3600 python -u benchmarks/bench_partition_heal_delta.py 8192 --sided >> "$LOG" 2>&1
 say "stage 6a rc=$?"
-timeout 5400 python -u benchmarks/bench_partition_heal_delta.py 32768 >> "$LOG" 2>&1
-say "stage 6b rc=$?"
+timeout 5400 python -u benchmarks/bench_partition_heal_delta.py 65536 --sided >> "$LOG" 2>&1
+say "stage 6b (SIDED 65k, the config-4 north star) rc=$?"
+timeout 3600 python -u benchmarks/bench_partition_heal_delta.py 32768 >> "$LOG" 2>&1
+say "stage 6c (unsided 32k, exact trajectory) rc=$?"
 
 say "done"
