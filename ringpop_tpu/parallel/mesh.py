@@ -167,12 +167,16 @@ def sharded_run(
 # ---------------------------------------------------------------------------
 
 
-def delta_state_sharding(mesh: Mesh) -> DeltaState:
+def delta_state_sharding(mesh: Mesh, sided: bool = False) -> DeltaState:
     """Shardings for ``DeltaState``: the [N, C] divergence tables are
     viewer-row sharded like the dense views; the shared base and its
     O(N) rank structures are replicated — every viewer's selection and
     merge reads them at arbitrary subject indices, and they change only
-    via init/compact/rebase, not inside the step."""
+    via init/compact/rebase, not inside the step.  ``sided=True``
+    covers the structured-netsplit state: the [G, N] base rows and the
+    [G, G] flip table replicate, the [N] side vector rides along
+    replicated too (each viewer's side is read at gathered indices by
+    the routing)."""
     row = NamedSharding(mesh, P(AXIS, None))
     rep = NamedSharding(mesh, P())
     return DeltaState(
@@ -186,13 +190,17 @@ def delta_state_sharding(mesh: Mesh) -> DeltaState:
         d_sl=row,
         tick=rep,
         overflow_drops=rep,
+        side=rep if sided else None,
+        merge_to=rep if sided else None,
     )
 
 
 def shard_delta(state: DeltaState, mesh: Mesh) -> DeltaState:
     """Place an (unsharded) delta state onto the mesh."""
     _check_divisible(state.n, mesh)
-    return jax.device_put(state, delta_state_sharding(mesh))
+    return jax.device_put(
+        state, delta_state_sharding(mesh, sided=state.side is not None)
+    )
 
 
 def _reject_adjacency(net: NetState) -> None:
@@ -208,7 +216,11 @@ def _reject_adjacency(net: NetState) -> None:
         )
 
 
-def sharded_delta_step(mesh: Mesh, net_like: NetState | None = None) -> Callable:
+def sharded_delta_step(
+    mesh: Mesh,
+    net_like: NetState | None = None,
+    state_like: DeltaState | None = None,
+) -> Callable:
     """``delta_step`` compiled for the mesh.  The cross-chip traffic is
     the claim routing: the flat (receiver, subject) sort and the
     per-receiver gathers lower to collectives over the row shards —
@@ -220,11 +232,14 @@ def sharded_delta_step(mesh: Mesh, net_like: NetState | None = None) -> Callable
         delta_step_impl,
         static_argnames=("params", "upto"),
         in_shardings=(
-            delta_state_sharding(mesh),
+            delta_state_sharding(mesh, sided=_sided(state_like)),
             net_sharding(mesh, like=net_like),
             rep,
         ),
-        out_shardings=(delta_state_sharding(mesh), rep),
+        out_shardings=(
+            delta_state_sharding(mesh, sided=_sided(state_like)),
+            rep,
+        ),
         donate_argnums=(0,),
     )
 
@@ -238,18 +253,22 @@ def sharded_delta_step(mesh: Mesh, net_like: NetState | None = None) -> Callable
     return step
 
 
-def sharded_delta_run(mesh: Mesh, net_like: NetState | None = None) -> Callable:
+def sharded_delta_run(
+    mesh: Mesh,
+    net_like: NetState | None = None,
+    state_like: DeltaState | None = None,
+) -> Callable:
     """``delta_run`` (lax.scan over ticks) compiled for the mesh."""
     rep = NamedSharding(mesh, P())
     jitted = jax.jit(
         delta_run_impl,
         static_argnames=("params", "ticks"),
         in_shardings=(
-            delta_state_sharding(mesh),
+            delta_state_sharding(mesh, sided=_sided(state_like)),
             net_sharding(mesh, like=net_like),
             rep,
         ),
-        out_shardings=(delta_state_sharding(mesh), rep),
+        out_shardings=(delta_state_sharding(mesh, sided=_sided(state_like)), rep),
         donate_argnums=(0,),
     )
 
@@ -261,6 +280,10 @@ def sharded_delta_run(mesh: Mesh, net_like: NetState | None = None) -> Callable:
         return jitted(state, net, key, params, ticks)
 
     return run
+
+
+def _sided(state_like: DeltaState | None) -> bool:
+    return state_like is not None and state_like.side is not None
 
 
 def _check_adj_layout(net: NetState, expect_adj: bool) -> None:
