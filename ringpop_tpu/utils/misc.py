@@ -92,6 +92,15 @@ def enable_compilation_cache() -> None:
     bench, the profilers — reuse the executable.  Best-effort:
     platforms whose executables don't serialize just compile live
     (JAX logs a warning)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # CPU-pinned runs skip the cache: its purpose is amortizing the
+        # tunneled TPU's minutes-long remote compiles, CPU compiles are
+        # cheap — and XLA:CPU AOT cache loads log a spurious
+        # machine-feature-mismatch error ("could lead to SIGILL", the
+        # embedded feature list carries internal +prefer-no-scatter/
+        # -gather flags the runtime probe never reports) on EVERY warm
+        # start, even on the machine that wrote the entry.
+        return
     try:
         import jax
 
