@@ -42,12 +42,19 @@ def run(
     max_heal_ticks: int = 800,
     check_every: int = 5,
     sided: bool = False,
+    backend: str = "delta",
+    wire_cap: int = 64,
 ) -> list[dict]:
     from ringpop_tpu.models import swim_delta as sd
     from ringpop_tpu.models import swim_sim as sim
     from ringpop_tpu.models.cluster import SimCluster
 
-    if sided:
+    if backend == "dense":
+        # the unbounded-wire control (bench_sided_bound): same
+        # trajectory shape, reference piggyback semantics, no caps
+        # (capacity/wire are delta knobs the dense backend ignores)
+        capacity = None
+    elif sided:
         # Sided mode (swim_delta.make_sides): per-side base rows absorb
         # each side's consensus via anti-entropy rebase folds, so the
         # capacity only has to hold the in-flight rumor front — n/16
@@ -71,11 +78,13 @@ def run(
         n,
         params,
         seed=4,
-        backend="delta",
-        capacity=capacity,
-        wire_cap=64,
+        backend=backend,
+        capacity=capacity or 256,
+        wire_cap=wire_cap,
         claim_grid=512,
     )
+    if sided and backend != "delta":
+        raise ValueError("sided mode is a delta-backend representation")
     cluster.tick(2)  # warm up / compile
 
     half = n // 2
@@ -103,12 +112,27 @@ def run(
             cluster.rebase(anti_entropy=True)
     groups_at_heal = len(cluster.checksum_groups())
 
+    print(
+        f"# split done: {groups_at_heal} checksum groups at heal "
+        f"({time.perf_counter() - t0:.0f}s)",
+        file=sys.stderr,
+        flush=True,
+    )
     cluster.heal_partition()
     heal_ticks = 0
     bridged = False
     while heal_ticks < max_heal_ticks:
         cluster.tick(check_every)
         heal_ticks += check_every
+        if heal_ticks % 20 == 0 or heal_ticks == check_every:
+            # long-run progress evidence (the 65k config runs for hours)
+            print(
+                f"# heal tick {heal_ticks}: "
+                f"{len(cluster.checksum_groups())} groups "
+                f"({time.perf_counter() - t0:.0f}s)",
+                file=sys.stderr,
+                flush=True,
+            )
         if heal_ticks % (10 if sided else 20) == 0:
             # fold re-converged columns back into the base so the
             # divergence tables drain as the merge progresses (the
@@ -131,9 +155,10 @@ def run(
         cluster.fold_sides()  # leave sided mode: single base again
     groups = cluster.checksum_groups()
     m = cluster.metrics_log[-1] if cluster.metrics_log else {}
+    prefix = "dense" if backend == "dense" else "delta"
     return [
         {
-            "metric": f"delta_partition_heal{'_sided' if sided else ''}_n{n}",
+            "metric": f"{prefix}_partition_heal{'_sided' if sided else ''}_n{n}",
             "value": heal_ticks,
             "unit": "ticks_to_remerge",
             "split_ticks": split_ticks,

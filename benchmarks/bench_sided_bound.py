@@ -1,0 +1,144 @@
+"""Bound the sided-mode dissemination deviation (config-4 family).
+
+Sided mode deviates from the reference's per-message piggyback
+semantics (/root/reference/lib/dissemination.js:138-177): flip-adopted
+entries carry no dissemination records, and the anti-entropy fold is
+bulk delivery on a maintenance schedule rather than per-ping piggyback
+(documented in swim_delta.py).  This bench separates the deviation's
+two candidate costs at matched n by running THREE configurations of the
+identical 50/50-netsplit trajectory:
+
+* ``dense`` — unbounded wire, reference piggyback semantics: the
+  protocol-fidelity control.
+* ``delta unsided`` at wire W — per-message piggyback kept, wire
+  bounded: (dense - unsided) is the WIRE-CAP cost.
+* ``delta sided`` at the SAME wire W — adds the flip/fold schedule:
+  (unsided - sided) is the FOLD-SCHEDULE cost (negative = the bulk
+  fold is a speedup over wire-capped per-message piggyback).
+
+Two metrics per configuration (both tick counts — load-immune):
+
+* detection: post-split ticks until the cluster reads exactly 2
+  checksum groups (each side internally converged on the other side
+  faulty) — the netsplit twin of the kill-detection latency bound.
+* heal: the config-4 metric (tick-cluster.js:88-115): heal the link
+  mid-transition at the same tick in every configuration, count
+  post-heal ticks to ONE checksum group.
+
+Usage: python benchmarks/bench_sided_bound.py [n] [--wire W]
+       [--configs dense,unsided,sided] [--skip-detection]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.bench_partition_heal_delta import run as heal_run
+
+
+def measure_detection(
+    n: int,
+    backend: str,
+    sided: bool,
+    wire_cap: int,
+    loss: float = 0.0,
+    suspicion_ticks: int = 8,
+    max_ticks: int = 400,
+) -> dict:
+    """Post-split ticks until exactly 2 checksum groups."""
+    from ringpop_tpu.models import swim_sim as sim
+    from ringpop_tpu.models.cluster import SimCluster
+
+    if sided:
+        capacity = max(256, n // 16)
+    elif backend == "delta":
+        capacity = n + 64
+    else:
+        capacity = 256  # ignored by the dense backend
+    params = sim.SwimParams(loss=loss, suspicion_ticks=suspicion_ticks)
+    cluster = SimCluster(
+        n,
+        params,
+        seed=4,
+        backend=backend,
+        capacity=capacity,
+        wire_cap=wire_cap,
+        claim_grid=512,
+    )
+    cluster.tick(2)
+    half = n // 2
+    sides = [list(range(half)), list(range(half, n))]
+    if sided:
+        cluster.split_sides(sides)
+    else:
+        cluster.partition(sides)
+    t0 = time.perf_counter()
+    ticks = 0
+    groups = -1
+    while ticks < max_ticks:
+        cluster.tick(1)
+        ticks += 1
+        if sided and ticks % 5 == 0:
+            # same 5-tick fold cadence as the heal bench's split phase
+            cluster.rebase(anti_entropy=True)
+        # every tick: the bench differences detection ticks between
+        # configurations, so a sampling quantization would bias the
+        # wire-cap/fold-schedule deltas it exists to measure
+        groups = len(cluster.checksum_groups())
+        if groups == 2:
+            break
+    m = cluster.metrics_log[-1] if cluster.metrics_log else {}
+    return {
+        "metric": f"netsplit_detection_{backend}{'_sided' if sided else ''}_n{n}",
+        "value": ticks,
+        "unit": "ticks_to_2_groups",
+        "checksum_groups": groups,
+        "wire_cap": None if backend == "dense" else wire_cap,
+        "overflow_drops": int(m.get("overflow_drops", 0)),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+CONFIGS = {
+    "dense": dict(backend="dense", sided=False),
+    "unsided": dict(backend="delta", sided=False),
+    "sided": dict(backend="delta", sided=True),
+}
+
+
+def main() -> None:
+    from ringpop_tpu.utils import enable_compilation_cache, pin_cpu_if_requested
+
+    pin_cpu_if_requested()
+    enable_compilation_cache()
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 and not sys.argv[1].startswith("-") else 1024
+    wire = 64
+    if "--wire" in sys.argv:
+        wire = int(sys.argv[sys.argv.index("--wire") + 1])
+    names = ["dense", "unsided", "sided"]
+    if "--configs" in sys.argv:
+        names = sys.argv[sys.argv.index("--configs") + 1].split(",")
+
+    for name in names:
+        cfg = CONFIGS[name]
+        if not ("--skip-detection" in sys.argv):
+            row = measure_detection(n, cfg["backend"], cfg["sided"], wire)
+            print(json.dumps(row), flush=True)
+        for row in heal_run(
+            n,
+            backend=cfg["backend"],
+            sided=cfg["sided"],
+            wire_cap=wire,
+        ):
+            row["config"] = name
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -318,11 +318,17 @@ class SimCluster:
         backend accepts (its step evaluates connectivity at gathered
         index pairs; a bool[N, N] mask would reintroduce the N^2 it
         exists to avoid).  Partial groupings (ungrouped nodes stay
-        connected to everyone) need the dense mask form."""
+        connected to everyone) need the dense mask form.  Layout
+        continuity: once this net carries a bool[N, N] mask (a previous
+        partial partition on the dense backend), later full-coverage
+        partitions keep the mask form — a step compiled against the
+        mask layout (sharded_step's in_shardings, or any traced jit)
+        must never see the adj flip to a different ndim mid-run."""
         gid = np.full(self.n, -1, dtype=np.int32)
         for g, members in enumerate(groups):
             gid[np.asarray(members, dtype=np.int32)] = g
-        if (gid >= 0).all():
+        keep_mask = self.net.adj is not None and self.net.adj.ndim == 2
+        if (gid >= 0).all() and not keep_mask:
             self.net = self.net._replace(adj=jnp.asarray(gid))
             return
         if self.backend == "delta":

@@ -926,8 +926,20 @@ def _route_claims_multi(
     late packets (counted in ``dropped``).  The ample-cap / bit-parity
     condition is therefore ``grid >= max_inbound_rows * W`` (for the
     phase-5 stages max_inbound_rows is ping_req_size * N in the
-    adversarial worst case; tests use grid = 3 * n * wire_cap)."""
-    w = max(s[0].shape[1] for s in segments)
+    adversarial worst case; tests use grid = 3 * n * wire_cap).
+
+    Invariant: every segment shares ONE width W — the jnp.concatenate
+    of the [N, W] row blocks requires it, and the R = 2 * ceil(grid/W)
+    rows-per-receiver bound is computed from that single W.  A caller
+    with narrower segments must pad them to the common width with
+    SENTINEL subjects."""
+    w = segments[0][0].shape[1]
+    if any(s[0].shape[1] != w for s in segments):
+        raise ValueError(
+            "_route_claims_multi segments must share one claim width; got "
+            f"{[s[0].shape[1] for s in segments]} — pad narrower segments "
+            "to the common width with SENTINEL"
+        )
     nrows = n * len(segments)
     row_recv = jnp.concatenate(
         [
@@ -997,7 +1009,13 @@ def _rotating_window(issuable: jax.Array, w: int, tick: jax.Array) -> jax.Array:
     entries per row) — the ample-cap bit-parity contract."""
     rank = jnp.cumsum(issuable.astype(jnp.int32), axis=1)  # inclusive, 1-based
     total = jnp.maximum(rank[:, -1:], 1)
-    start = (tick * w) % total
+    # uint32 product: tick * w overflows int32 after ~2^31/w ticks,
+    # which would make the rotation sequence jump discontinuously on
+    # very long horizons; unsigned arithmetic keeps the start advancing
+    # by w (mod total) per tick for the full uint32 period
+    start = (
+        (tick.astype(jnp.uint32) * jnp.uint32(w)) % total.astype(jnp.uint32)
+    ).astype(jnp.int32)
     return issuable & (((rank - 1 - start) % total) < w)
 
 
@@ -1051,6 +1069,12 @@ def delta_step_impl(
     sw = params.swim
     if sw.sparse_cap:
         raise ValueError("sparse_cap is a dense-backend knob; use wire_cap here")
+    if sw.phase_mod != 1:
+        raise ValueError(
+            "phase_mod staggering is the dense-step fidelity experiment "
+            "(benchmarks/bench_phase_offset.py); the delta backend runs "
+            "lockstep periods"
+        )
     n = state.n
     w = params.wire_cap
     ids = jnp.arange(n, dtype=jnp.int32)
@@ -1070,15 +1094,27 @@ def delta_step_impl(
         return cut(state, _t=t_safe + wit[:, 0] + stats.digest.astype(jnp.int32))
 
     # -- phase 2: sender issues up to W changes -----------------------------
+    # window + budget bookkeeping under a has-claims cond: a tick where
+    # no SENDER holds an active change (the converged common case) pays
+    # two [N, C] mask passes for the pred instead of the rotating
+    # window's cumsum + where chain
     has_change = state.d_pb >= 0
     bump = has_change & sends[:, None]
-    pb1_ok = bump & (state.d_pb + jnp.int8(1) <= maxpb[:, None])
-    within = _rotating_window(pb1_ok, w, state.tick)  # fair wire window
-    bump_eff = bump & ~(pb1_ok & ~within)  # entries past the window keep budget
-    pb_next = jnp.where(bump_eff, state.d_pb + jnp.int8(1), state.d_pb)
-    pb_next = jnp.where(bump_eff & (pb_next > maxpb[:, None]), jnp.int8(-1), pb_next)
-    state = state._replace(d_pb=pb_next)
 
+    def p2_issue(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+        pb1_ok = bump & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
+        within = _rotating_window(pb1_ok, w, st.tick)  # fair wire window
+        bump_eff = bump & ~(pb1_ok & ~within)  # past-window entries keep budget
+        pb_next = jnp.where(bump_eff, st.d_pb + jnp.int8(1), st.d_pb)
+        pb_next = jnp.where(
+            bump_eff & (pb_next > maxpb[:, None]), jnp.int8(-1), pb_next
+        )
+        return st._replace(d_pb=pb_next), within
+
+    def p2_quiet(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+        return st, jnp.zeros(st.d_pb.shape, bool)
+
+    state, within = jax.lax.cond(jnp.any(bump), p2_issue, p2_quiet, state)
     send_subj, send_key = _windowed_changes(state, within, w)
     if upto <= 2:
         # anchor phase-1 outputs too: without t_safe/wit in the live set
@@ -1124,22 +1160,32 @@ def delta_step_impl(
         return cut(state, _t=ping_applied)
 
     # -- phase 4: receiver replies; sender merges the ack -------------------
-    # (post phase-3 state: reply content includes changes just applied)
+    # (post phase-3 state: reply content includes changes just applied;
+    # same has-claims gate as phase 2 — a no-receiver-holds-changes tick
+    # skips the window and the serve/evict bookkeeping)
     has_change2 = state.d_pb >= 0
-    rep_issuable = (
-        has_change2 & got_ping[:, None] & (state.d_pb + jnp.int8(1) <= maxpb[:, None])
+    rep_possible = has_change2 & got_ping[:, None]
+
+    def p4_issue(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+        rep_issuable = rep_possible & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
+        within_rep = _rotating_window(rep_issuable, w, st.tick)
+        # receiver pb bookkeeping: advance by pings served, evict past
+        # budget; windowed-out entries untouched (dense phase-4a + the
+        # sparse-path window rule)
+        inb8 = jnp.minimum(inbound, 127).astype(jnp.int8)[:, None]
+        served = rep_possible & ~(rep_issuable & ~within_rep)
+        evict = served & (st.d_pb > maxpb[:, None] - inb8)
+        pb_after = jnp.where(
+            evict, jnp.int8(-1), jnp.where(served, st.d_pb + inb8, st.d_pb)
+        )
+        return st._replace(d_pb=pb_after), within_rep
+
+    def p4_quiet(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+        return st, jnp.zeros(st.d_pb.shape, bool)
+
+    state, within_rep = jax.lax.cond(
+        jnp.any(rep_possible), p4_issue, p4_quiet, state
     )
-    within_rep = _rotating_window(rep_issuable, w, state.tick)
-    # receiver pb bookkeeping: advance by pings served, evict past
-    # budget; windowed-out entries untouched (dense phase-4a + the
-    # sparse-path window rule)
-    inb8 = jnp.minimum(inbound, 127).astype(jnp.int8)[:, None]
-    served = got_ping[:, None] & has_change2 & ~(rep_issuable & ~within_rep)
-    evict = served & (state.d_pb > maxpb[:, None] - inb8)
-    pb_after = jnp.where(
-        evict, jnp.int8(-1), jnp.where(served, state.d_pb + inb8, state.d_pb)
-    )
-    state = state._replace(d_pb=pb_after)
 
     h_post = _phase0_stats(state).digest  # receiver digests after merge
 
@@ -1184,6 +1230,18 @@ def delta_step_impl(
             # slots the receiver doesn't override (+ in sided mode the
             # base FLIP below, which covers the receiver-base-vs-
             # sender-base bulk without materializing it as claims).
+            #
+            # Provider snapshot taken BEFORE the flip/absorb pass: a
+            # provider that itself flips as an adopter this tick
+            # compacts slots into its merged base — shipping the
+            # post-flip table alongside the pre-flip base row
+            # (fs_provider_side) would draw the sync from two
+            # inconsistent snapshots and omit values the provider's
+            # served view actually held.  One consistent pre-flip
+            # snapshot (table + side + base) is the view the provider
+            # held when it answered the ping.
+            fs_subj0 = st2.d_subj[t_safe]  # [N, C]
+            fs_key0 = st2.d_key[t_safe]
             fs_provider_side = None
             if st2.side is not None:
                 # Sided mode: the full-sync PROVIDER is the ping
@@ -1244,8 +1302,6 @@ def delta_step_impl(
                         jnp.where(keep, st2.d_sl, jnp.int8(-1)), order_f, axis=1
                     ),
                 )
-            fs_subj0 = st2.d_subj[t_safe]  # [N, C]
-            fs_key0 = st2.d_key[t_safe]
             fs_valid0 = (fs_subj0 < SENTINEL) & fs_apply[:, None]
             # merge the W-wide ack list into the C-wide claim set (the
             # non-full-sync senders still apply their normal claims)
@@ -1257,10 +1313,12 @@ def delta_step_impl(
             )
             st3 = out.state
             # base claims at sender-side slots absent from the
-            # receiver's table (receiver's view there == its base)
+            # receiver's table (receiver's view there == its base) —
+            # checked against the SAME pre-flip snapshot the claims
+            # came from
             live3 = st3.d_subj < SENTINEL
             subj_safe3 = jnp.where(live3, st3.d_subj, 0)
-            rpos, rfound = _lookup_pos(st2.d_subj[t_safe], subj_safe3)
+            rpos, rfound = _lookup_pos(fs_subj0, subj_safe3)
             if st3.side is None:
                 base_claim = st3.base_key[subj_safe3]
             else:
@@ -1377,121 +1435,193 @@ def delta_step_impl(
         st, ap, lt = jax.lax.cond(pred, go, skip, st)
         return st, (applied + ap, late + lt)
 
+    # skip-branch stand-ins for windowed (subject, key) lists; width
+    # must match _windowed_changes' min(w, C) cap or the cond branches
+    # disagree on shape
+    w_eff = min(w, state.capacity)
+    w_empty = (
+        jnp.full((n, w_eff), SENTINEL, jnp.int32),
+        jnp.zeros((n, w_eff), jnp.int32),
+    )
+
     def exchange(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
+        # Each stage runs under a claims-on-the-hop-path cond: the stage
+        # (its issue/serve bookkeeping, role-count sorts, window
+        # compaction, routing, merging) is a provable no-op unless some
+        # node that ISSUES in that stage holds an active change — a
+        # node with no d_pb >= 0 row has nothing to issue, serve, or
+        # evict.  The preds are cheap gathers of a per-node has-change
+        # bit (refreshed between stages: a 5a merge can hand the
+        # witness fresh changes to relay in 5b).  Round-4 ran the
+        # bookkeeping passes whenever ANY node held a change anywhere
+        # (~20% of the quiet tick at n=8,192); the per-stage preds
+        # additionally require that node to sit on this tick's hop
+        # path.
         acc = (jnp.int32(0), jnp.int32(0))
-        nreq = jnp.sum(failed[:, None] & wit_valid, axis=1, dtype=jnp.int32)
-        nsrv = _role_counts(wit_safe, req_del)
 
         # -- 5a: the ping-req body carries the source's changes ---------
-        st, win_a = _stage_issue_delta(st, nreq, maxpb, w)
-        sa_subj, sa_key = _windowed_changes(st, win_a, w)
-        st, acc = _stage(
-            st,
-            acc,
-            jnp.any(win_a),
-            lambda st2: [
-                (
-                    sa_subj,
-                    sa_key,
-                    (sa_subj < SENTINEL) & req_del[:, m][:, None],
-                    wit_safe[:, m],
-                )
-                for m in range(kk)
-            ],
+        def go_a(st2):
+            nreq = jnp.sum(failed[:, None] & wit_valid, axis=1, dtype=jnp.int32)
+            st2, win_a = _stage_issue_delta(st2, nreq, maxpb, w)
+            sa = _windowed_changes(st2, win_a, w)
+            st2, acc2 = _stage(
+                st2,
+                (jnp.int32(0), jnp.int32(0)),
+                jnp.any(win_a),
+                lambda st3: [
+                    (
+                        sa[0],
+                        sa[1],
+                        (sa[0] < SENTINEL) & req_del[:, m][:, None],
+                        wit_safe[:, m],
+                    )
+                    for m in range(kk)
+                ],
+            )
+            return st2, acc2[0], acc2[1], sa[0]
+
+        def skip_a(st2):
+            return st2, jnp.int32(0), jnp.int32(0), w_empty[0]
+
+        st, ap, lt, sa_subj = jax.lax.cond(
+            jnp.any((st.d_pb >= 0) & failed[:, None]), go_a, skip_a, st
         )
+        acc = (acc[0] + ap, acc[1] + lt)
 
         # -- 5b: the witness relay-pings the target with its changes ----
-        st, win_b = _stage_issue_delta(st, nsrv, maxpb, w)
-        sb_subj, sb_key = _windowed_changes(st, win_b, w)
-        nping_del = _role_counts(wit_safe, ping_del)
-        ntgt = _role_counts(jnp.broadcast_to(t_safe[:, None], kshape), ping_del)
-        st, acc = _stage(
-            st,
-            acc,
-            jnp.any(win_b),
-            lambda st2: [
-                (
-                    sb_subj[wit_safe[:, m]],
-                    sb_key[wit_safe[:, m]],
-                    (sb_subj[wit_safe[:, m]] < SENTINEL) & ping_del[:, m][:, None],
-                    t_safe,
-                )
-                for m in range(kk)
-            ],
+        hc_b = jnp.any(st.d_pb >= 0, axis=1)
+
+        def go_b(st2):
+            nsrv = _role_counts(wit_safe, req_del)
+            st2, win_b = _stage_issue_delta(st2, nsrv, maxpb, w)
+            sb_subj, sb_key = _windowed_changes(st2, win_b, w)
+            nping_del = _role_counts(wit_safe, ping_del)
+            st2, acc2 = _stage(
+                st2,
+                (jnp.int32(0), jnp.int32(0)),
+                jnp.any(win_b),
+                lambda st3: [
+                    (
+                        sb_subj[wit_safe[:, m]],
+                        sb_key[wit_safe[:, m]],
+                        (sb_subj[wit_safe[:, m]] < SENTINEL)
+                        & ping_del[:, m][:, None],
+                        t_safe,
+                    )
+                    for m in range(kk)
+                ],
+            )
+            # the witness's delivered set (5c anti-echo): its windowed
+            # list, where it made at least one delivered relay ping
+            wit_sent = jnp.where((nping_del > 0)[:, None], sb_subj, SENTINEL)
+            return st2, acc2[0], acc2[1], wit_sent
+
+        def skip_b(st2):
+            return st2, jnp.int32(0), jnp.int32(0), w_empty[0]
+
+        st, ap, lt, wit_sent_subj = jax.lax.cond(
+            jnp.any(req_del & hc_b[wit_safe]), go_b, skip_b, st
         )
-        # the witness's delivered set (5c anti-echo): its windowed list,
-        # where it made at least one delivered relay ping
-        wit_sent_subj = jnp.where((nping_del > 0)[:, None], sb_subj, SENTINEL)
+        acc = (acc[0] + ap, acc[1] + lt)
 
         # -- 5c: the target's ack carries its changes back --------------
-        st, win_c = _stage_issue_delta(st, ntgt, maxpb, w)
-        sc_subj, sc_key = _windowed_changes(st, win_c, w)
+        hc_c = jnp.any(st.d_pb >= 0, axis=1)
 
-        def segs_c(st2):
-            segs = []
-            for m in range(kk):
-                w_m = wit_safe[:, m]
-                subj = sc_subj[t_safe]
-                key_c = sc_key[t_safe]
-                subj_q = jnp.where(subj < SENTINEL, subj, 0)
-                # anti-echo: the witness delivered this subject in 5b
-                # and its current belief equals the claim
-                _, in_sent = _lookup_pos(wit_sent_subj[w_m], subj_q)
-                pos_w, found_w = _lookup_pos(st2.d_subj[w_m], subj_q)
-                if st2.side is None:
-                    base_w = st2.base_key[subj_q]
-                else:
-                    # the WITNESS's base row (its view is being probed),
-                    # not the source viewer's
-                    base_w = st2.base_key[st2.side[w_m][:, None], subj_q]
-                cur_w = jnp.where(
-                    found_w,
-                    jnp.take_along_axis(st2.d_key[w_m], pos_w, axis=1),
-                    base_w,
-                )
-                echo = in_sent & (key_c == cur_w)
-                segs.append(
-                    (
-                        subj,
-                        key_c,
-                        (subj < SENTINEL) & ack_del[:, m][:, None] & ~echo,
-                        w_m,
+        def go_c(st2):
+            ntgt = _role_counts(
+                jnp.broadcast_to(t_safe[:, None], kshape), ping_del
+            )
+            st2, win_c = _stage_issue_delta(st2, ntgt, maxpb, w)
+            sc_subj, sc_key = _windowed_changes(st2, win_c, w)
+
+            def segs_c(st3):
+                segs = []
+                for m in range(kk):
+                    w_m = wit_safe[:, m]
+                    subj = sc_subj[t_safe]
+                    key_c = sc_key[t_safe]
+                    subj_q = jnp.where(subj < SENTINEL, subj, 0)
+                    # anti-echo: the witness delivered this subject in
+                    # 5b and its current belief equals the claim
+                    _, in_sent = _lookup_pos(wit_sent_subj[w_m], subj_q)
+                    pos_w, found_w = _lookup_pos(st3.d_subj[w_m], subj_q)
+                    if st3.side is None:
+                        base_w = st3.base_key[subj_q]
+                    else:
+                        # the WITNESS's base row (its view is being
+                        # probed), not the source viewer's
+                        base_w = st3.base_key[st3.side[w_m][:, None], subj_q]
+                    cur_w = jnp.where(
+                        found_w,
+                        jnp.take_along_axis(st3.d_key[w_m], pos_w, axis=1),
+                        base_w,
                     )
-                )
-            return segs
+                    echo = in_sent & (key_c == cur_w)
+                    segs.append(
+                        (
+                            subj,
+                            key_c,
+                            (subj < SENTINEL) & ack_del[:, m][:, None] & ~echo,
+                            w_m,
+                        )
+                    )
+                return segs
 
-        st, acc = _stage(st, acc, jnp.any(win_c), segs_c)
+            st2, acc2 = _stage(
+                st2, (jnp.int32(0), jnp.int32(0)), jnp.any(win_c), segs_c
+            )
+            return st2, acc2[0], acc2[1]
+
+        def skip_c(st2):
+            return st2, jnp.int32(0), jnp.int32(0)
+
+        st, ap, lt = jax.lax.cond(
+            jnp.any(ping_del & hc_c[t_safe][:, None]), go_c, skip_c, st
+        )
+        acc = (acc[0] + ap, acc[1] + lt)
 
         # -- 5d: the witness response carries its (fresh) changes -------
         # issue set from the post-5c state: what the witness just learned
         # from the target ships straight back — the implicit-alive path
-        st, win_d = _stage_issue_delta(st, nsrv, maxpb, w)
-        sd_subj, sd_key = _windowed_changes(st, win_d, w)
-        src_sent_subj = jnp.where(
-            jnp.any(req_del, axis=1)[:, None], sa_subj, SENTINEL
-        )
+        hc_d = jnp.any(st.d_pb >= 0, axis=1)
 
-        def segs_d(st2):
-            segs = []
-            for m in range(kk):
-                w_m = wit_safe[:, m]
-                subj = sd_subj[w_m]
-                key_d = sd_key[w_m]
-                subj_q = jnp.where(subj < SENTINEL, subj, 0)
-                _, in_sent = _lookup_pos(src_sent_subj, subj_q)
-                cur_s = view_lookup(st2, subj_q)
-                echo = in_sent & (key_d == cur_s)
-                segs.append(
-                    (
-                        subj,
-                        key_d,
-                        (subj < SENTINEL) & resp_del[:, m][:, None] & ~echo,
-                        ids,
+        def go_d(st2):
+            nsrv = _role_counts(wit_safe, req_del)
+            st2, win_d = _stage_issue_delta(st2, nsrv, maxpb, w)
+            sd_subj, sd_key = _windowed_changes(st2, win_d, w)
+            src_sent_subj = jnp.where(
+                jnp.any(req_del, axis=1)[:, None], sa_subj, SENTINEL
+            )
+
+            def segs_d(st3):
+                segs = []
+                for m in range(kk):
+                    w_m = wit_safe[:, m]
+                    subj = sd_subj[w_m]
+                    key_d = sd_key[w_m]
+                    subj_q = jnp.where(subj < SENTINEL, subj, 0)
+                    _, in_sent = _lookup_pos(src_sent_subj, subj_q)
+                    cur_s = view_lookup(st3, subj_q)
+                    echo = in_sent & (key_d == cur_s)
+                    segs.append(
+                        (
+                            subj,
+                            key_d,
+                            (subj < SENTINEL) & resp_del[:, m][:, None] & ~echo,
+                            ids,
+                        )
                     )
-                )
-            return segs
+                return segs
 
-        st, acc = _stage(st, acc, jnp.any(win_d), segs_d)
+            st2, acc2 = _stage(
+                st2, (jnp.int32(0), jnp.int32(0)), jnp.any(win_d), segs_d
+            )
+            return st2, acc2[0], acc2[1]
+
+        st, ap, lt = jax.lax.cond(
+            jnp.any(req_del & hc_d[wit_safe]), go_d, skip_c, st
+        )
+        acc = (acc[0] + ap, acc[1] + lt)
         return st, acc[0], acc[1]
 
     def no_exchange(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
@@ -1509,13 +1639,16 @@ def delta_step_impl(
     )
     claims_dropped = claims_dropped + pingreq_late
 
-    # the declaration sees the post-exchange view (dense convention)
-    cur_t = view_lookup(state, t_safe)
-    dec_key = jnp.where(cur_t > 0, (cur_t >> 3) * 8 + SUSPECT, 0)
+    # the declaration sees the post-exchange view (dense convention);
+    # the view lookup rides inside the cond — declarations are rare
+    # (every witness path must definitely fail), the quiet tick must
+    # not pay the [N] table search
     dec_valid = declare_suspect & (t_safe != ids)
     any_dec = jnp.any(dec_valid)
 
     def dec_merge(st: DeltaState) -> DeltaState:
+        cur_t = view_lookup(st, t_safe)
+        dec_key = jnp.where(cur_t > 0, (cur_t >> 3) * 8 + SUSPECT, 0)
         out = _merge_claims(
             st, t_safe[:, None], dec_key[:, None], dec_valid[:, None], sl_start
         )
@@ -1526,18 +1659,32 @@ def delta_step_impl(
         return cut(state, _t=jnp.sum(dec_valid.astype(jnp.int32)))
 
     # -- phase 6: suspicion countdowns fire -> faulty -----------------------
-    sl = state.d_sl
-    sl1 = jnp.where(sl > 0, sl - 1, sl)
-    expired = (
-        (sl1 == 0)
-        & ((state.d_key & 7) == SUSPECT)
-        & gossiping[:, None]
-        & (state.d_subj < SENTINEL)
+    # (gated: with no live countdown anywhere — the converged common
+    # case — decrement, expiry test, and rewrites are all no-ops)
+    def p6_countdown(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+        sl = st.d_sl
+        sl1 = jnp.where(sl > 0, sl - 1, sl)
+        expired = (
+            (sl1 == 0)
+            & ((st.d_key & 7) == SUSPECT)
+            & gossiping[:, None]
+            & (st.d_subj < SENTINEL)
+        )
+        d_key = jnp.where(expired, (st.d_key >> 3) * 8 + FAULTY, st.d_key)
+        d_pb = jnp.where(expired, jnp.int8(0), st.d_pb)
+        sl1 = jnp.where(expired, jnp.int8(-1), sl1)
+        return (
+            st._replace(d_key=d_key, d_pb=d_pb, d_sl=sl1),
+            jnp.sum(expired, dtype=jnp.int32),
+        )
+
+    def p6_quiet(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+        return st, jnp.int32(0)
+
+    state, n_expired = jax.lax.cond(
+        jnp.any(state.d_sl >= 0), p6_countdown, p6_quiet, state
     )
-    d_key = jnp.where(expired, (state.d_key >> 3) * 8 + FAULTY, state.d_key)
-    d_pb = jnp.where(expired, jnp.int8(0), state.d_pb)
-    sl1 = jnp.where(expired, jnp.int8(-1), sl1)
-    state = state._replace(d_key=d_key, d_pb=d_pb, d_sl=sl1, tick=state.tick + 1)
+    state = state._replace(tick=state.tick + 1)
 
     metrics = {
         "pings_sent": jnp.sum(sends, dtype=jnp.int32),
@@ -1548,7 +1695,7 @@ def delta_step_impl(
         "ping_reqs": jnp.sum(failed, dtype=jnp.int32),
         "pingreq_changes_applied": pingreq_applied,
         "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
-        "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
+        "faulty_declared": n_expired,
         "claims_dropped": claims_dropped,
         "overflow_drops": state.overflow_drops,
         "max_occupancy": jnp.max(

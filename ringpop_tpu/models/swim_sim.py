@@ -156,6 +156,20 @@ class SwimParams(NamedTuple):
     # reshuffled round-robin, but a member can go unprobed for many
     # rounds — the coupon-collector tail the reference iterator avoids).
     probe: str = "sweep"
+    # Per-node staggered protocol periods (gossip.js:38-51: each node's
+    # first tick lands randomly in [0, minProtocolPeriod) and periods
+    # self-schedule per node; the sims' default is lockstep).  When
+    # phase_mod = P > 1, one tick models 1/P of a protocol period: node
+    # i initiates its probe only on ticks with tick % P == phase_i (a
+    # fixed pseudo-random assignment), while timers, deliveries, and
+    # relay/witness service run every tick — matching the reference,
+    # where suspicion is wall-clock and a node answers RPCs at any
+    # offset.  Callers must scale tick-denominated knobs by P
+    # (suspicion_ticks, detection-latency readouts) to keep wall-clock
+    # semantics.  Dense backend only (the fidelity experiment,
+    # benchmarks/bench_phase_offset.py); 1 = lockstep, bit-identical to
+    # the previous behavior.
+    phase_mod: int = 1
 
 
 class ClusterState(NamedTuple):
@@ -692,6 +706,13 @@ def _phase01_select(
         (target, has_target, wit, wit_valid)
     )
     sends = gossiping & has_target
+    if params.phase_mod > 1:
+        # staggered periods: only the in-phase residue class initiates
+        # probes this tick; everything else (timers, witness service,
+        # deliveries) stays per-tick — see SwimParams.phase_mod
+        ids_p = jnp.arange(n, dtype=jnp.int32)
+        phase = (ids_p * jnp.int32(0x9E37 | 1)) % jnp.int32(params.phase_mod)
+        sends = sends & (state.tick % jnp.int32(params.phase_mod) == phase)
     t_safe = jnp.where(sends, target, 0)
     return _Selection(
         gossiping, sends, t_safe, wit, wit_valid, maxpb.astype(jnp.int8)[:, None], h_pre

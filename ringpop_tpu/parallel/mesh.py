@@ -125,7 +125,7 @@ def sharded_step(
     if like is not None:
         damping = like.damp is not None
     rep = NamedSharding(mesh, P())
-    return jax.jit(
+    jitted = jax.jit(
         swim_step_impl,
         static_argnames=("params",),
         in_shardings=(
@@ -136,6 +136,14 @@ def sharded_step(
         out_shardings=(state_sharding(mesh, damping), rep),
         donate_argnums=(0,),
     )
+
+    expect_adj = _adj_layout(net_like)
+
+    def step(state, net, key, params):
+        _check_adj_layout(net, expect_adj)
+        return jitted(state, net, key, params)
+
+    return step
 
 
 def sharded_run(
@@ -149,7 +157,7 @@ def sharded_run(
     if like is not None:
         damping = like.damp is not None
     rep = NamedSharding(mesh, P())
-    return jax.jit(
+    jitted = jax.jit(
         swim_run_impl,
         static_argnames=("params", "ticks"),
         in_shardings=(
@@ -160,6 +168,14 @@ def sharded_run(
         out_shardings=(state_sharding(mesh, damping), rep),
         donate_argnums=(0,),
     )
+
+    expect_adj = _adj_layout(net_like)
+
+    def run(state, net, key, params, ticks):
+        _check_adj_layout(net, expect_adj)
+        return jitted(state, net, key, params, ticks)
+
+    return run
 
 
 # ---------------------------------------------------------------------------
@@ -243,7 +259,7 @@ def sharded_delta_step(
         donate_argnums=(0,),
     )
 
-    expect_adj = net_like is not None and net_like.adj is not None
+    expect_adj = _adj_layout(net_like)
 
     def step(state, net, key, params, upto=7):
         _reject_adjacency(net)
@@ -272,7 +288,7 @@ def sharded_delta_run(
         donate_argnums=(0,),
     )
 
-    expect_adj = net_like is not None and net_like.adj is not None
+    expect_adj = _adj_layout(net_like)
 
     def run(state, net, key, params, ticks):
         _reject_adjacency(net)
@@ -286,15 +302,29 @@ def _sided(state_like: DeltaState | None) -> bool:
     return state_like is not None and state_like.side is not None
 
 
-def _check_adj_layout(net: NetState, expect_adj: bool) -> None:
-    """Clear error when the net's adjacency presence disagrees with the
-    compiled in_shardings (built from ``net_like`` at construction) —
-    otherwise jax.jit fails deep inside with an opaque pytree/sharding
-    structure mismatch."""
-    if (net.adj is not None) != expect_adj:
-        have = "carries" if net.adj is not None else "lacks"
-        want = "with" if expect_adj else "without"
-        raise ValueError(
-            f"net {have} an adjacency vector but this sharded step was "
-            f"compiled {want} one — rebuild with net_like=net"
-        )
+def _adj_layout(net_like: NetState | None) -> int | None:
+    """The adjacency layout a compiled step expects: None (no adj) or
+    the adj ndim (1 = group-id vector, 2 = bool mask)."""
+    if net_like is None or net_like.adj is None:
+        return None
+    return net_like.adj.ndim
+
+
+def _check_adj_layout(net: NetState, expect: int | None) -> None:
+    """Clear error when the net's adjacency layout (presence AND ndim)
+    disagrees with the compiled in_shardings (built from ``net_like``
+    at construction) — otherwise jax.jit fails deep inside with an
+    opaque pytree/sharding structure mismatch.  Presence alone is not
+    enough: a group-id int32[N] vector and a bool[N, N] mask are both
+    "present" but compile to different layouts (Cluster.partition can
+    produce either on the dense backend)."""
+    have = _adj_layout(net)
+    if have == expect:
+        return
+    names = {None: "no adjacency", 1: "a group-id vector (ndim 1)",
+             2: "an adjacency mask (ndim 2)"}
+    raise ValueError(
+        f"net carries {names.get(have, f'adj ndim {have}')} but this "
+        f"sharded step was compiled for {names.get(expect, f'adj ndim {expect}')}"
+        " — rebuild with net_like=net"
+    )
