@@ -630,13 +630,10 @@ def _selection(
         state.bp_rank_at(state.d_subj) + (cpd - d_slot),
         big,
     )
-    shift = 1
+    # suffix-min in one fused pass (the doubling loop did log2(C) padded
+    # copies of the [N, C] array per tick)
     cc = F.shape[1]
-    while shift < cc:
-        F = jnp.minimum(
-            F, jnp.pad(F, ((0, 0), (0, shift)), constant_values=big)[:, shift:]
-        )
-        shift *= 2
+    F = jax.lax.cummin(F, axis=1, reverse=True)
 
     ranks, valid = _distinct_ranks(stats.ping_count, k + 1, k_sel)
     r_clip = jnp.clip(
@@ -1135,12 +1132,6 @@ def delta_step_impl(
     )
     sent_valid = (send_subj < SENTINEL) & fwd_ok[:, None]
 
-    # inbound ping count per receiver, scatter-free (sorted senders)
-    tgt_sorted = jnp.sort(jnp.where(fwd_ok, t_safe, n))
-    starts, ends = _run_bounds(tgt_sorted, n)
-    inbound = (ends - starts).astype(jnp.int32)
-    got_ping = inbound > 0
-
     any_claims = jnp.any(sent_valid)
 
     def ping_merge(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
@@ -1162,18 +1153,27 @@ def delta_step_impl(
     # -- phase 4: receiver replies; sender merges the ack -------------------
     # (post phase-3 state: reply content includes changes just applied;
     # same has-claims gate as phase 2 — a no-receiver-holds-changes tick
-    # skips the window and the serve/evict bookkeeping)
+    # skips the window and the serve/evict bookkeeping.  The inbound
+    # ping count — an [N] sort — rides INSIDE the cond: it is consumed
+    # only here, and the conservative pred ``any change & any delivered
+    # ping`` is a superset of the exact ``any(rep_possible)``, so the
+    # skipped branch is still a provable no-op while the converged tick
+    # skips the sort too.)
     has_change2 = state.d_pb >= 0
-    rep_possible = has_change2 & got_ping[:, None]
 
     def p4_issue(st: DeltaState) -> tuple[DeltaState, jax.Array]:
-        rep_issuable = rep_possible & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
+        # inbound ping count per receiver, scatter-free (sorted senders)
+        tgt_sorted = jnp.sort(jnp.where(fwd_ok, t_safe, n))
+        starts, ends = _run_bounds(tgt_sorted, n)
+        inbound = (ends - starts).astype(jnp.int32)
+        rep_possible2 = has_change2 & (inbound > 0)[:, None]
+        rep_issuable = rep_possible2 & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
         within_rep = _rotating_window(rep_issuable, w, st.tick)
         # receiver pb bookkeeping: advance by pings served, evict past
         # budget; windowed-out entries untouched (dense phase-4a + the
         # sparse-path window rule)
         inb8 = jnp.minimum(inbound, 127).astype(jnp.int8)[:, None]
-        served = rep_possible & ~(rep_issuable & ~within_rep)
+        served = rep_possible2 & ~(rep_issuable & ~within_rep)
         evict = served & (st.d_pb > maxpb[:, None] - inb8)
         pb_after = jnp.where(
             evict, jnp.int8(-1), jnp.where(served, st.d_pb + inb8, st.d_pb)
@@ -1184,10 +1184,15 @@ def delta_step_impl(
         return st, jnp.zeros(st.d_pb.shape, bool)
 
     state, within_rep = jax.lax.cond(
-        jnp.any(rep_possible), p4_issue, p4_quiet, state
+        jnp.any(has_change2) & jnp.any(fwd_ok), p4_issue, p4_quiet, state
     )
 
-    h_post = _phase0_stats(state).digest  # receiver digests after merge
+    # receiver digests after merge — only the phase-3 merge can move a
+    # digest (p2/p4 touch budgets, not values), so a no-claims tick
+    # reuses h_pre instead of paying the second [N, C] hash pass
+    h_post = jax.lax.cond(
+        any_claims, lambda st: _phase0_stats(st).digest, lambda st: h_pre, state
+    )
 
     rep_subj, rep_key = _windowed_changes(state, within_rep, w)
 
@@ -1201,10 +1206,21 @@ def delta_step_impl(
     # subject this sender delivered this tick whose value equals the
     # sender's CURRENT belief (post phase-3 merge — the dense step
     # compares against state.view_key after the receiver-side merge).
-    sent_sorted = jnp.where(sent_valid, send_subj, SENTINEL)
-    _, sent_hit = _lookup_pos(sent_sorted, a_subj_q)
-    cur_at_a = view_lookup(state, a_subj_q)
-    echo = sent_hit & (a_key == cur_at_a)
+    # Gated: with no reply claims anywhere (a_subj all SENTINEL, the
+    # converged case) a_raw is False regardless of echo, so the
+    # delivered-set lookup and the [N, W] view search are skipped.
+    def _echo(_):
+        sent_sorted = jnp.where(sent_valid, send_subj, SENTINEL)
+        _, sent_hit = _lookup_pos(sent_sorted, a_subj_q)
+        cur_at_a = view_lookup(state, a_subj_q)
+        return sent_hit & (a_key == cur_at_a)
+
+    echo = jax.lax.cond(
+        jnp.any(a_subj < SENTINEL),
+        _echo,
+        lambda _: jnp.zeros(a_subj.shape, bool),
+        None,
+    )
 
     # full sync (dissemination.js:100-118): receiver had nothing
     # issuable for this sender (all claims echoed or none) but the

@@ -147,7 +147,10 @@ class SwimParams(NamedTuple):
     # path via lax.cond.
     sparse_cap: int = 0
     # Probe-target policy.  "sweep" (default): deterministic rotation
-    # ``(start_i + tick) mod n`` with a uniform fallback when the swept
+    # ``(start_i + tick // phase_mod) mod n`` — the index advances once
+    # per protocol PERIOD, so staggered nodes (phase_mod > 1) still
+    # cover every member instead of a coset — with a uniform fallback
+    # when the swept
     # slot is not pingable — restores the reference iterator's guarantee
     # that every stable member is probed once per n-tick round
     # (membership-iterator.js:33-40), bounding worst-case detection
@@ -689,7 +692,18 @@ def _phase01_select(
         while math.gcd(mult, n) != 1:
             mult += 1
         start = (ids * jnp.int32(mult)) % jnp.int32(n)
-        swept = (start + state.tick) % jnp.int32(n)
+        # With staggered periods the sweep index advances once per
+        # PROTOCOL PERIOD (tick // P), not per sub-tick: node i only
+        # probes on sub-ticks with tick % P == phase_i, and a per-sub-
+        # tick sweep would restrict it to the coset {start_i + phase_i
+        # + kP} forever — worse, phase_i and start_i share the affine
+        # i*mult map, so (start+phase) mod P covered only the subgroup
+        # generated by 2*mult mod P and members in the other residue
+        # classes were NEVER swept (observed: undetectable victims at
+        # P=4).  Per-period advance is the reference iterator's
+        # semantics (one target per period per node) and is
+        # bit-identical at P=1.
+        swept = (start + state.tick // jnp.int32(params.phase_mod)) % jnp.int32(n)
         ok = pingable[ids, swept]
         target = jnp.where(ok, swept, target)
         has_target = has_target | ok

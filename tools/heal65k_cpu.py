@@ -1,0 +1,37 @@
+"""Round-5 insurance for BASELINE config 4 at n=65,536: the sided heal
+to one checksum group, run to completion on the CPU host (VERDICT r4
+item 2 allows any platform — the staged TPU config re-times it when the
+tunnel cooperates).
+
+Capacity rides at n/32 (=2,048) instead of the bench default n/16: the
+sided fold keeps the live front far below either bound, per-tick sort
+cost scales ~C log C, and the round has a wall-clock budget — drops (if
+any) are recorded in the row and the config-4 metric (ticks to
+groups=1) is drop-tolerant the same way the 1,024-node cap-256 row
+converged through 130k drops.
+
+Run: JAX_PLATFORMS=cpu python tools/heal65k_cpu.py [n] [capacity]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+from benchmarks.bench_partition_heal_delta import run
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    cap = int(sys.argv[2]) if len(sys.argv) > 2 else max(256, n // 32)
+    for row in run(n, sided=True, capacity=cap):
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

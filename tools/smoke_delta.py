@@ -1,0 +1,43 @@
+"""Quick CPU smoke of the delta step after an edit (run with
+JAX_PLATFORMS=cpu; pins at the jax-config level like the benches)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+import jax
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.05), wire_cap=16, claim_grid=64
+    )
+    st = sd.init_delta(n, capacity=64)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+    m = None
+    for _ in range(12):
+        key, sub = jax.random.split(key)
+        st, m = sd.delta_step(st, net, sub, params)
+    print(
+        "12 ticks ok; occupancy",
+        int(m["max_occupancy"]),
+        "pings",
+        int(m["pings_sent"]),
+        "suspects",
+        int(m["suspects_declared"]),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+#!/bin/bash
+# Round-5 TPU triage: the tunnel answered this round but the first
+# delta@64:65536 attempt CRASHED the TPU worker (UNAVAILABLE: worker
+# process crashed or restarted), which then wedged the tunnel for 10+
+# minutes.  So: capture the on-chip ladder BOTTOM-UP first (every rung
+# is a real on-chip datapoint we have never had for the delta backend),
+# and only then retry 65k / bisect the crash — each crash costs ~15 min
+# of worker recovery, so risky stages go last and re-probe after.
+# Usage: tools/tpu_triage_r5.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-tools/tpu_triage_r5.log}
+: > "$LOG"
+say() { echo "[$(date +%H:%M:%S)] $*" >> "$LOG"; }
+
+# Pause the round's CPU benches while the TPU owns the host core (the
+# box is single-core; compile + dispatch contend).  Bracket patterns so
+# pkill -f never matches this script's own argv.
+pause_cpu() { pkill -STOP -f "bench_[p]hase_offset|bench_[s]ided_bound|bench_[p]ingreq" 2>/dev/null; }
+resume_cpu() { pkill -CONT -f "bench_[p]hase_offset|bench_[s]ided_bound|bench_[p]ingreq" 2>/dev/null; }
+
+probe() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256,256))
+print('probe ok', float((x@x).sum()))" >> "$LOG" 2>&1
+}
+
+wait_up() {  # $1 = max probes, 120s apart
+  for i in $(seq 1 "$1"); do
+    if probe; then say "tunnel up after $i probes"; return 0; fi
+    say "probe $i failed; sleeping 120s"
+    sleep 120
+  done
+  return 1
+}
+
+say "waiting for TPU worker to recover from the 65k crash"
+if ! wait_up 90; then say "tunnel never recovered; giving up"; resume_cpu; exit 1; fi
+
+pause_cpu
+say "=== ladder bottom-up: every rung is a first-ever on-chip delta datapoint"
+for n in 8192 16384 32768; do
+  say "--- delta@64:$n"
+  timeout 1200 python -u bench.py --child delta@64:$n >> "$LOG" 2>&1
+  rc=$?
+  say "delta@64:$n rc=$rc"
+  if [ $rc -ne 0 ]; then
+    say "rung $n failed; re-probing before continuing"
+    resume_cpu
+    if ! wait_up 20; then say "worker did not recover; stopping ladder"; exit 1; fi
+    pause_cpu
+  fi
+done
+
+say "=== risky: retry the 65536 headline on a fresh worker"
+timeout 1800 python -u bench.py --child delta@64:65536 >> "$LOG" 2>&1
+rc65=$?
+say "delta@64:65536 retry rc=$rc65"
+
+if [ $rc65 -ne 0 ]; then
+  resume_cpu
+  say "=== 65k failed again: wait for recovery, then bisect the phase"
+  if ! wait_up 20; then say "worker did not recover post-65k; giving up"; exit 1; fi
+  pause_cpu
+  say "--- profile_delta_bisect 65536 64 (finds the crashing phase)"
+  timeout 2400 python -u -m benchmarks.profile_delta_bisect 65536 64 >> "$LOG" 2>&1
+  say "bisect rc=$?"
+fi
+resume_cpu
+say "done"
