@@ -125,6 +125,22 @@ def load(path: str, device: Any | None = None) -> SimCluster:
 
         state_cls = DeltaState if backend == "delta" else ClusterState
         cluster.state = load_tuple(state_cls, "state")
+        if backend == "delta" and cluster.state.digest is None:
+            # checkpoints predating the carried derivatives (optional
+            # fields absent): backfill from the oracles once at load
+            from ringpop_tpu.models.swim_delta import refresh_carried
+
+            cluster.state = refresh_carried(cluster.state)
+        elif backend == "delta" and (
+            os.environ.get("RINGPOP_CARRY_SLOTBASE", "0") == "1"
+            and cluster.state.d_bpmask is None
+        ):
+            # digest already carried; the operator asked for the
+            # slot-base carry this process — populate just that
+            from ringpop_tpu.models.swim_delta import compute_slot_base
+
+            bpm, bpr = compute_slot_base(cluster.state)
+            cluster.state = cluster.state._replace(d_bpmask=bpm, d_bprank=bpr)
         cluster.net = load_tuple(NetState, "net")
         cluster.key = jax.numpy.asarray(data["key"])
     if device is not None:

@@ -170,6 +170,30 @@ class DeltaState(NamedTuple):
     overflow_drops: jax.Array  # int32[] cumulative table-capacity drops
     side: jax.Array | None = None  # int32[N] viewer's base row (sided mode)
     merge_to: jax.Array | None = None  # int32[G, G] full-sync flip table
+    # Rolling per-viewer view digest (uint32[N]) — the incremental twin
+    # of the reference's membership checksum, which is UPDATED on each
+    # membership change rather than recomputed per ping
+    # (membership.js:43-55 computeChecksum on change).  Recomputing it
+    # from scratch was the single largest cost of a converged tick
+    # (~22 ms of a 27 ms quiet tick at n=8,192 on CPU: two [N, C] hash
+    # passes plus base gathers, every tick).  Maintained at every d_key/
+    # base mutation: _merge_claims adds per-claim hash deltas (uint32
+    # wrap-around sums commute), phase-6 expiries adjust in their cond,
+    # and the rare full-sync flip/absorb branch recomputes wholesale.
+    # init_delta/make_sides/rebase/compact/sparsify populate it;
+    # compute_digest() is the from-scratch oracle (invariant-tested).
+    digest: jax.Array | None = None  # uint32[N]
+    # Per-slot snapshots of the base pingability structures at each
+    # slot's subject — the carried form of ``bp_mask_at(d_subj)`` /
+    # ``bp_rank_at(d_subj)``, whose [N, C] random gathers were the
+    # other half of the converged tick's phase-0/selection cost.  They
+    # change ONLY when a slot's subject changes (insertion, reorder,
+    # base rebuild) — never on value updates — so the step maintains
+    # them with [N, K]-sized gathers under the insert cond instead of
+    # [N, C] gathers every tick.  SENTINEL slots hold (False, 0).
+    # compute_slot_base() is the from-scratch oracle.
+    d_bpmask: jax.Array | None = None  # bool[N, C]
+    d_bprank: jax.Array | None = None  # int32[N, C]
 
     @property
     def n(self) -> int:
@@ -267,7 +291,7 @@ def init_delta(
     else:
         raise ValueError(f"unknown init mode: {mode}")
     bp_mask, bp_rank, bp_list = _base_rank_structs(base_key)
-    return DeltaState(
+    st = DeltaState(
         base_key=base_key,
         bp_mask=bp_mask,
         bp_rank=bp_rank,
@@ -279,6 +303,7 @@ def init_delta(
         tick=jnp.zeros((), dtype=jnp.int32),
         overflow_drops=jnp.zeros((), dtype=jnp.int32),
     )
+    return refresh_carried(st)
 
 
 # ---------------------------------------------------------------------------
@@ -420,7 +445,7 @@ def sparsify(
         d_pb[i, : len(js)] = pb[i, js]
         d_sl[i, : len(js)] = sl[i, js]
     bp_mask, bp_rank, bp_list = _base_rank_structs(jnp.asarray(base))
-    return DeltaState(
+    st = DeltaState(
         base_key=jnp.asarray(base),
         bp_mask=bp_mask,
         bp_rank=bp_rank,
@@ -432,6 +457,7 @@ def sparsify(
         tick=dense.tick,
         overflow_drops=jnp.zeros((), jnp.int32),
     )
+    return refresh_carried(st)
 
 
 # ---------------------------------------------------------------------------
@@ -461,6 +487,86 @@ class _Stats(NamedTuple):
     own_key: jax.Array  # int32[N] view(i, i)
 
 
+def compute_slot_base(state: DeltaState) -> tuple[jax.Array, jax.Array]:
+    """(bool[N, C], int32[N, C]) base pingability mask/rank at each
+    slot's subject — the from-scratch oracle for the carried
+    ``d_bpmask``/``d_bprank`` (SENTINEL slots hold (False, 0))."""
+    live = state.d_subj < SENTINEL
+    subj_safe = jnp.where(live, state.d_subj, 0)
+    return (
+        state.bp_mask_at(subj_safe) & live,
+        jnp.where(live, state.bp_rank_at(subj_safe), 0),
+    )
+
+
+def compute_digest(state: DeltaState) -> jax.Array:
+    """uint32[N] view digest from scratch — the oracle for the carried
+    ``state.digest`` (base hash total corrected by the delta slots)."""
+    n = state.n
+    ids = jnp.arange(n, dtype=jnp.int32)
+    live = state.d_subj < SENTINEL
+    subj_safe = jnp.where(live, state.d_subj, 0)
+    if state.side is None:
+        h_base_total = jnp.broadcast_to(
+            jnp.sum(_hash1(state.base_key, ids), dtype=jnp.uint32), (n,)
+        )
+    else:
+        h_base_total = jnp.sum(
+            _hash1(state.base_key, ids[None, :]), axis=1, dtype=jnp.uint32
+        )[state.side]
+    h_corr = jnp.sum(
+        jnp.where(
+            live,
+            _hash1(state.d_key, subj_safe)
+            - _hash1(state.base_at(subj_safe), subj_safe),
+            jnp.uint32(0),
+        ),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    return h_base_total + h_corr
+
+
+def refresh_carried(state: DeltaState) -> DeltaState:
+    """Recompute every carried derivative from the oracles — the one
+    call that makes any hand-mutated/rebuilt state step-ready.
+
+    The rolling digest is always carried (clear win on every platform).
+    The slot-base snapshots are an A/B lowering knob like
+    RINGPOP_WIDE_METHOD: they trade the per-tick [N, C] base gathers
+    for extra cond-carry volume on the active paths — measured a ~2%
+    LOSS on single-core CPU (151,269 vs 154,637 idle node-rounds/s at
+    n=8,192, both idle-box with narrowed cond carries) but aimed at TPU, where random gathers cost far more
+    relative to elementwise; RINGPOP_CARRY_SLOTBASE=1 enables them for
+    the on-chip race.  Read at state-BUILD time only — inside the step
+    the carry configuration is a property of the state (see
+    _refresh_in_step)."""
+    state = state._replace(digest=compute_digest(state))
+    # the env enables the carry for fresh builds; a state that ALREADY
+    # carries the snapshots keeps them (a mid-run rebase must not
+    # silently drop a forced/loaded carry)
+    if (
+        os.environ.get("RINGPOP_CARRY_SLOTBASE", "0") == "1"
+        or state.d_bpmask is not None
+    ):
+        bpm, bpr = compute_slot_base(state)
+        return state._replace(d_bpmask=bpm, d_bprank=bpr)
+    return state._replace(d_bpmask=None, d_bprank=None)
+
+
+def _refresh_in_step(state: DeltaState) -> DeltaState:
+    """Wholesale recompute of the carried derivatives INSIDE the step
+    (the full-sync flip path).  Keys the slot-base recompute on the
+    STATE's carry configuration, never the env var: a traced lax.cond
+    branch must return the same pytree structure as its sibling, and
+    the env can legitimately disagree with a loaded state's carry."""
+    state = state._replace(digest=compute_digest(state))
+    if state.d_bpmask is not None:
+        bpm, bpr = compute_slot_base(state)
+        return state._replace(d_bpmask=bpm, d_bprank=bpr)
+    return state
+
+
 def _phase0_stats(state: DeltaState) -> _Stats:
     n = state.n
     ids = jnp.arange(n, dtype=jnp.int32)
@@ -468,7 +574,11 @@ def _phase0_stats(state: DeltaState) -> _Stats:
     subj_safe = jnp.where(live, state.d_subj, 0)
     d_status = state.d_key & 7
     ping_now = live & ((d_status == ALIVE) | (d_status == SUSPECT))
-    ping_base = live & state.bp_mask_at(subj_safe)
+    ping_base = (
+        state.d_bpmask
+        if state.d_bpmask is not None
+        else live & state.bp_mask_at(subj_safe)
+    )
 
     # counts: base total corrected by the delta slots (self excluded for
     # pingability, included for the ring-ish server count); per base
@@ -488,24 +598,10 @@ def _phase0_stats(state: DeltaState) -> _Stats:
     server_count = p_total + corr
     ping_count = server_count - self_pingable_in_view.astype(jnp.int32)
 
-    # digest: base sum corrected by the delta slots
-    if state.side is None:
-        h_base_total = jnp.sum(_hash1(state.base_key, ids), dtype=jnp.uint32)
-    else:
-        h_base_total = jnp.sum(
-            _hash1(state.base_key, ids[None, :]), axis=1, dtype=jnp.uint32
-        )[state.side]
-    h_corr = jnp.sum(
-        jnp.where(
-            live,
-            _hash1(state.d_key, subj_safe)
-            - _hash1(state.base_at(subj_safe), subj_safe),
-            jnp.uint32(0),
-        ),
-        axis=1,
-        dtype=jnp.uint32,
-    )
-    digest = h_base_total + h_corr
+    # digest: the carried rolling value when present (the step path —
+    # maintained at every mutation), else the from-scratch oracle
+    # (host tools, states built before the carry existed)
+    digest = state.digest if state.digest is not None else compute_digest(state)
     return _Stats(live, ping_now, ping_base, ping_count, server_count, digest, own_key)
 
 
@@ -625,9 +721,14 @@ def _selection(
     corr_live = d_slot != 0
     cpd = jnp.cumsum(d_slot, axis=1)  # inclusive prefix, subject order
     big = jnp.int32(1 << 30)
+    slot_rank = (
+        state.d_bprank
+        if state.d_bprank is not None
+        else state.bp_rank_at(jnp.where(live, state.d_subj, 0))
+    )
     F = jnp.where(
         corr_live,
-        state.bp_rank_at(state.d_subj) + (cpd - d_slot),
+        slot_rank + (cpd - d_slot),
         big,
     )
     # suffix-min in one fused pass (the doubling loop did log2(C) padded
@@ -781,7 +882,34 @@ def _merge_claims(
     d_pb = jnp.where(upd_self, jnp.int8(0), d_pb)
     d_sl = jnp.where(upd_self, jnp.int8(-1), d_sl)
 
-    state = state._replace(d_key=d_key, d_pb=d_pb, d_sl=d_sl)
+    # rolling digest (see DeltaState.digest): claim-aligned hash deltas
+    # for the matched updates (old value ``cur`` is already in hand) and
+    # the self refutation at an existing slot; insertions add theirs
+    # under the insert cond below.  uint32 wrap-around sums commute, so
+    # the increments compose in any order with the base decomposition.
+    if state.digest is not None:
+        d_matched = jnp.sum(
+            jnp.where(
+                applies & found,
+                _hash1(c_key, subj_q) - _hash1(cur, subj_q),
+                jnp.uint32(0),
+            ),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+        old_self_key = jnp.max(
+            jnp.where(self_slot, state.d_key, 0), axis=1
+        )  # the (unique) self slot's pre-update value
+        d_self = jnp.where(
+            refuted & has_self_slot,
+            _hash1(new_self_key, ids) - _hash1(old_self_key, ids),
+            jnp.uint32(0),
+        )
+        digest = state.digest + d_matched + d_self
+    else:
+        digest = None
+
+    state = state._replace(d_key=d_key, d_pb=d_pb, d_sl=d_sl, digest=digest)
 
     # --- insertions: applying claims whose subject has no slot --------
     ins = applies & ~found
@@ -843,8 +971,55 @@ def _merge_claims(
         m_key = jnp.take_along_axis(m_key, order, axis=1)[:, :cap]
         m_pb = jnp.take_along_axis(m_pb, order, axis=1)[:, :cap]
         m_sl = jnp.take_along_axis(m_sl, order, axis=1)[:, :cap]
+        if st.d_bpmask is not None:
+            # carried base-pingability snapshots: gather at the KEPT
+            # inserted subjects only ([N, K+1]-sized, inside this cond)
+            # and ride the same reorder as the tables
+            bpm_new = jnp.where(keep, state.bp_mask_at(subj_q), False)
+            bpr_new = jnp.where(keep, state.bp_rank_at(subj_q), 0)
+            bpm_self = keep_self & state.bp_mask_at(ids)
+            bpr_self = jnp.where(keep_self, state.bp_rank_at(ids), 0)
+            m_bpm = jnp.concatenate(
+                [st.d_bpmask, bpm_new, bpm_self[:, None]], axis=1
+            )
+            m_bpr = jnp.concatenate(
+                [st.d_bprank, bpr_new, bpr_self[:, None]], axis=1
+            )
+            m_bpm = jnp.take_along_axis(m_bpm, order, axis=1)[:, :cap]
+            m_bpr = jnp.take_along_axis(m_bpr, order, axis=1)[:, :cap]
+        else:
+            m_bpm = None
+            m_bpr = None
+        if st.digest is not None:
+            # KEPT insertions only (dropped claims never reach the
+            # table); the old view value at a not-found subject is its
+            # base — which is exactly ``cur`` where ~found
+            d_ins = jnp.sum(
+                jnp.where(
+                    keep,
+                    _hash1(c_key, subj_q) - _hash1(cur, subj_q),
+                    jnp.uint32(0),
+                ),
+                axis=1,
+                dtype=jnp.uint32,
+            ) + jnp.where(
+                keep_self,
+                _hash1(new_self_key, ids) - _hash1(st.base_at(ids), ids),
+                jnp.uint32(0),
+            )
+            digest2 = st.digest + d_ins
+        else:
+            digest2 = None
         return (
-            st._replace(d_subj=m_subj, d_key=m_key, d_pb=m_pb, d_sl=m_sl),
+            st._replace(
+                d_subj=m_subj,
+                d_key=m_key,
+                d_pb=m_pb,
+                d_sl=m_sl,
+                digest=digest2,
+                d_bpmask=m_bpm,
+                d_bprank=m_bpr,
+            ),
             dropped,
         )
 
@@ -1064,6 +1239,17 @@ def delta_step_impl(
             "need the dense backend"
         )
     sw = params.swim
+    if state.digest is None:
+        raise ValueError(
+            "delta_step requires the rolling digest (DeltaState.digest); "
+            "init_delta/make_sides/sparsify populate it — for a hand-built "
+            "state use swim_delta.refresh_carried(state)"
+        )
+    if (state.d_bpmask is None) != (state.d_bprank is None):
+        raise ValueError(
+            "DeltaState.d_bpmask/d_bprank must be carried together "
+            "(refresh_carried populates or clears both)"
+        )
     if sw.sparse_cap:
         raise ValueError("sparse_cap is a dense-backend knob; use wire_cap here")
     if sw.phase_mod != 1:
@@ -1098,20 +1284,25 @@ def delta_step_impl(
     has_change = state.d_pb >= 0
     bump = has_change & sends[:, None]
 
-    def p2_issue(st: DeltaState) -> tuple[DeltaState, jax.Array]:
-        pb1_ok = bump & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
-        within = _rotating_window(pb1_ok, w, st.tick)  # fair wire window
+    # The cond carries ONLY the field this phase can change (d_pb): a
+    # whole-state carry makes the cond's output buffers copy every
+    # [N, C] table per tick — measured as the dominant cost of adding
+    # state fields, since XLA does not reliably alias identity branches.
+    def p2_issue(d_pb: jax.Array) -> tuple[jax.Array, jax.Array]:
+        pb1_ok = bump & (d_pb + jnp.int8(1) <= maxpb[:, None])
+        within = _rotating_window(pb1_ok, w, state.tick)  # fair wire window
         bump_eff = bump & ~(pb1_ok & ~within)  # past-window entries keep budget
-        pb_next = jnp.where(bump_eff, st.d_pb + jnp.int8(1), st.d_pb)
+        pb_next = jnp.where(bump_eff, d_pb + jnp.int8(1), d_pb)
         pb_next = jnp.where(
             bump_eff & (pb_next > maxpb[:, None]), jnp.int8(-1), pb_next
         )
-        return st._replace(d_pb=pb_next), within
+        return pb_next, within
 
-    def p2_quiet(st: DeltaState) -> tuple[DeltaState, jax.Array]:
-        return st, jnp.zeros(st.d_pb.shape, bool)
+    def p2_quiet(d_pb: jax.Array) -> tuple[jax.Array, jax.Array]:
+        return d_pb, jnp.zeros(d_pb.shape, bool)
 
-    state, within = jax.lax.cond(jnp.any(bump), p2_issue, p2_quiet, state)
+    d_pb2, within = jax.lax.cond(jnp.any(bump), p2_issue, p2_quiet, state.d_pb)
+    state = state._replace(d_pb=d_pb2)
     send_subj, send_key = _windowed_changes(state, within, w)
     if upto <= 2:
         # anchor phase-1 outputs too: without t_safe/wit in the live set
@@ -1161,38 +1352,38 @@ def delta_step_impl(
     # skips the sort too.)
     has_change2 = state.d_pb >= 0
 
-    def p4_issue(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+    def p4_issue(d_pb: jax.Array) -> tuple[jax.Array, jax.Array]:
         # inbound ping count per receiver, scatter-free (sorted senders)
         tgt_sorted = jnp.sort(jnp.where(fwd_ok, t_safe, n))
         starts, ends = _run_bounds(tgt_sorted, n)
         inbound = (ends - starts).astype(jnp.int32)
         rep_possible2 = has_change2 & (inbound > 0)[:, None]
-        rep_issuable = rep_possible2 & (st.d_pb + jnp.int8(1) <= maxpb[:, None])
-        within_rep = _rotating_window(rep_issuable, w, st.tick)
+        rep_issuable = rep_possible2 & (d_pb + jnp.int8(1) <= maxpb[:, None])
+        within_rep = _rotating_window(rep_issuable, w, state.tick)
         # receiver pb bookkeeping: advance by pings served, evict past
         # budget; windowed-out entries untouched (dense phase-4a + the
         # sparse-path window rule)
         inb8 = jnp.minimum(inbound, 127).astype(jnp.int8)[:, None]
         served = rep_possible2 & ~(rep_issuable & ~within_rep)
-        evict = served & (st.d_pb > maxpb[:, None] - inb8)
+        evict = served & (d_pb > maxpb[:, None] - inb8)
         pb_after = jnp.where(
-            evict, jnp.int8(-1), jnp.where(served, st.d_pb + inb8, st.d_pb)
+            evict, jnp.int8(-1), jnp.where(served, d_pb + inb8, d_pb)
         )
-        return st._replace(d_pb=pb_after), within_rep
+        return pb_after, within_rep
 
-    def p4_quiet(st: DeltaState) -> tuple[DeltaState, jax.Array]:
-        return st, jnp.zeros(st.d_pb.shape, bool)
+    def p4_quiet(d_pb: jax.Array) -> tuple[jax.Array, jax.Array]:
+        return d_pb, jnp.zeros(d_pb.shape, bool)
 
-    state, within_rep = jax.lax.cond(
-        jnp.any(has_change2) & jnp.any(fwd_ok), p4_issue, p4_quiet, state
+    d_pb4, within_rep = jax.lax.cond(
+        jnp.any(has_change2) & jnp.any(fwd_ok), p4_issue, p4_quiet, state.d_pb
     )
+    state = state._replace(d_pb=d_pb4)
 
-    # receiver digests after merge — only the phase-3 merge can move a
-    # digest (p2/p4 touch budgets, not values), so a no-claims tick
-    # reuses h_pre instead of paying the second [N, C] hash pass
-    h_post = jax.lax.cond(
-        any_claims, lambda st: _phase0_stats(st).digest, lambda st: h_pre, state
-    )
+    # receiver digests after merge: the rolling digest IS the post-merge
+    # value — the phase-3 merge maintained it per claim, p2/p4 touch
+    # budgets only (no hash pass at all; the dense step recomputes its
+    # [N, N] view hash here)
+    h_post = state.digest
 
     rep_subj, rep_key = _windowed_changes(state, within_rep, w)
 
@@ -1373,6 +1564,11 @@ def delta_step_impl(
                 )
                 st4 = out2.state
                 applied_b = applied_b + out2.applied_points
+            # The flip/absorb compaction and the direct base-claim
+            # writes above bypass _merge_claims' rolling-digest
+            # accounting — recompute wholesale (this branch only runs
+            # when a full sync fired somewhere, already the heavy path)
+            st4 = _refresh_in_step(st4)
             return st4, applied_b
 
         return jax.lax.cond(any_fs, with_fs, normal, st)
@@ -1677,29 +1873,42 @@ def delta_step_impl(
     # -- phase 6: suspicion countdowns fire -> faulty -----------------------
     # (gated: with no live countdown anywhere — the converged common
     # case — decrement, expiry test, and rewrites are all no-ops)
-    def p6_countdown(st: DeltaState) -> tuple[DeltaState, jax.Array]:
-        sl = st.d_sl
-        sl1 = jnp.where(sl > 0, sl - 1, sl)
+    # narrow carry: this phase can only change (d_key, d_pb, d_sl,
+    # digest) — the tables/snapshots pass AROUND the cond uncopied
+    def p6_countdown(args):
+        key0, pb0, sl0, dg0 = args
+        sl1 = jnp.where(sl0 > 0, sl0 - 1, sl0)
         expired = (
             (sl1 == 0)
-            & ((st.d_key & 7) == SUSPECT)
+            & ((key0 & 7) == SUSPECT)
             & gossiping[:, None]
-            & (st.d_subj < SENTINEL)
+            & (state.d_subj < SENTINEL)
         )
-        d_key = jnp.where(expired, (st.d_key >> 3) * 8 + FAULTY, st.d_key)
-        d_pb = jnp.where(expired, jnp.int8(0), st.d_pb)
+        d_key = jnp.where(expired, (key0 >> 3) * 8 + FAULTY, key0)
+        d_pb = jnp.where(expired, jnp.int8(0), pb0)
         sl1 = jnp.where(expired, jnp.int8(-1), sl1)
-        return (
-            st._replace(d_key=d_key, d_pb=d_pb, d_sl=sl1),
-            jnp.sum(expired, dtype=jnp.int32),
+        subj_e = jnp.where(expired, state.d_subj, 0)
+        digest = dg0 + jnp.sum(
+            jnp.where(
+                expired,
+                _hash1(d_key, subj_e) - _hash1(key0, subj_e),
+                jnp.uint32(0),
+            ),
+            axis=1,
+            dtype=jnp.uint32,
         )
+        return (d_key, d_pb, sl1, digest), jnp.sum(expired, dtype=jnp.int32)
 
-    def p6_quiet(st: DeltaState) -> tuple[DeltaState, jax.Array]:
-        return st, jnp.int32(0)
+    def p6_quiet(args):
+        return args, jnp.int32(0)
 
-    state, n_expired = jax.lax.cond(
-        jnp.any(state.d_sl >= 0), p6_countdown, p6_quiet, state
+    (key6, pb6, sl6, dg6), n_expired = jax.lax.cond(
+        jnp.any(state.d_sl >= 0),
+        p6_countdown,
+        p6_quiet,
+        (state.d_key, state.d_pb, state.d_sl, state.digest),
     )
+    state = state._replace(d_key=key6, d_pb=pb6, d_sl=sl6, digest=dg6)
     state = state._replace(tick=state.tick + 1)
 
     metrics = {
@@ -1893,6 +2102,18 @@ def compact(state: DeltaState) -> DeltaState:
         d_sl=jnp.take_along_axis(
             jnp.where(needed, state.d_sl, jnp.int8(-1)), order, axis=1
         ),
+        # dropped slots matched the base, so the digest is invariant;
+        # the carried slot-base snapshots just ride the reorder
+        d_bpmask=None
+        if state.d_bpmask is None
+        else jnp.take_along_axis(
+            jnp.where(needed, state.d_bpmask, False), order, axis=1
+        ),
+        d_bprank=None
+        if state.d_bprank is None
+        else jnp.take_along_axis(
+            jnp.where(needed, state.d_bprank, 0), order, axis=1
+        ),
     )
 
 
@@ -1956,7 +2177,7 @@ def rebase(state: DeltaState, anti_entropy: bool = False) -> DeltaState:
     )
 
     bp_mask, bp_rank, bp_list = _base_rank_structs(jnp.asarray(base))
-    return state._replace(
+    state = state._replace(
         base_key=jnp.asarray(base),
         bp_mask=bp_mask,
         bp_rank=bp_rank,
@@ -1966,6 +2187,10 @@ def rebase(state: DeltaState, anti_entropy: bool = False) -> DeltaState:
         d_pb=jnp.asarray(d_pb),
         d_sl=jnp.asarray(d_sl),
     )
+    # plain folds preserve every view (digest invariant), but the
+    # anti-entropy fold advances views to the side's lattice-max —
+    # refresh the rolling digest either way (host-side, rare)
+    return refresh_carried(state)
 
 
 def make_sides(state: DeltaState, gid: np.ndarray | jax.Array) -> DeltaState:
@@ -2034,7 +2259,9 @@ def make_sides(state: DeltaState, gid: np.ndarray | jax.Array) -> DeltaState:
             d_pb=jnp.asarray(d_pb),
             d_sl=jnp.asarray(d_sl),
         )
-    return state
+    # views are preserved (self slots adopt base values) but the base
+    # decomposition changed shape — refresh the rolling digest
+    return refresh_carried(state)
 
 
 def fold_to_single(state: DeltaState) -> DeltaState:
@@ -2081,7 +2308,7 @@ def fold_to_single(state: DeltaState) -> DeltaState:
         d_pb[i] = np.where(d_subj[i] < int(SENTINEL), d_pb[i][order], -1)
         d_sl[i] = np.where(d_subj[i] < int(SENTINEL), d_sl[i][order], -1)
     bp_mask, bp_rank, bp_list = _base_rank_structs(jnp.asarray(merged))
-    return state._replace(
+    state = state._replace(
         base_key=jnp.asarray(merged),
         bp_mask=bp_mask,
         bp_rank=bp_rank,
@@ -2093,6 +2320,7 @@ def fold_to_single(state: DeltaState) -> DeltaState:
         side=None,
         merge_to=None,
     )
+    return refresh_carried(state)
 
 
 def _lmerge_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -2468,13 +2696,17 @@ def admin_join(state: DeltaState, joiner: int, seed: int) -> DeltaState:
         if j_g != s_g:
             side[joiner] = int(np.asarray(state.merge_to)[j_g, s_g])
             state = state._replace(side=jnp.asarray(side))
-    return _write_row(state, joiner, jvk, jpb, jsl, elide_redundant=True)
+    state = _write_row(state, joiner, jvk, jpb, jsl, elide_redundant=True)
+    # admin ops are rare host-side O(N) paths — refresh the rolling
+    # digest wholesale rather than threading per-entry deltas
+    return refresh_carried(state)
 
 
 def admin_leave(state: DeltaState, node: int) -> DeltaState:
     """makeLeave(self) (admin-leave-handler.js:48-52)."""
     inc = view_of(state, node, node) >> 3
-    return _set_entry(state, node, node, inc * 8 + LEAVE, 0, -1)
+    state = _set_entry(state, node, node, inc * 8 + LEAVE, 0, -1)
+    return refresh_carried(state)
 
 
 def _wipe_row(state: DeltaState, node: int) -> DeltaState:
@@ -2494,7 +2726,8 @@ def revive(state: DeltaState, node: int, inc: int) -> DeltaState:
     own aliveness — the seed records it during the join."""
     _check_inc(inc)
     state = _wipe_row(state, node)
-    return _set_entry(state, node, node, int(inc) * 8 + ALIVE, -1, -1)
+    state = _set_entry(state, node, node, int(inc) * 8 + ALIVE, -1, -1)
+    return refresh_carried(state)
 
 
 def revive_and_join(state: DeltaState, node: int, inc: int, seed: int) -> DeltaState:

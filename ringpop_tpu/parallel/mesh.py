@@ -183,7 +183,9 @@ def sharded_run(
 # ---------------------------------------------------------------------------
 
 
-def delta_state_sharding(mesh: Mesh, sided: bool = False) -> DeltaState:
+def delta_state_sharding(
+    mesh: Mesh, sided: bool = False, slotbase: bool = False
+) -> DeltaState:
     """Shardings for ``DeltaState``: the [N, C] divergence tables are
     viewer-row sharded like the dense views; the shared base and its
     O(N) rank structures are replicated — every viewer's selection and
@@ -195,6 +197,7 @@ def delta_state_sharding(mesh: Mesh, sided: bool = False) -> DeltaState:
     the routing)."""
     row = NamedSharding(mesh, P(AXIS, None))
     rep = NamedSharding(mesh, P())
+    row1 = NamedSharding(mesh, P(AXIS))
     return DeltaState(
         base_key=rep,
         bp_mask=rep,
@@ -208,6 +211,14 @@ def delta_state_sharding(mesh: Mesh, sided: bool = False) -> DeltaState:
         overflow_drops=rep,
         side=rep if sided else None,
         merge_to=rep if sided else None,
+        # the rolling digest is per-viewer state like the tables; the
+        # full-sync compare gathers h_post[t_safe] cross-shard exactly
+        # like the dense step's digest row gather
+        digest=row1,
+        # per-slot base snapshots (RINGPOP_CARRY_SLOTBASE) ride with
+        # their [N, C] tables when the state carries them
+        d_bpmask=row if slotbase else None,
+        d_bprank=row if slotbase else None,
     )
 
 
@@ -215,7 +226,12 @@ def shard_delta(state: DeltaState, mesh: Mesh) -> DeltaState:
     """Place an (unsharded) delta state onto the mesh."""
     _check_divisible(state.n, mesh)
     return jax.device_put(
-        state, delta_state_sharding(mesh, sided=state.side is not None)
+        state,
+        delta_state_sharding(
+            mesh,
+            sided=state.side is not None,
+            slotbase=state.d_bpmask is not None,
+        ),
     )
 
 
@@ -244,18 +260,14 @@ def sharded_delta_step(
     ``net_like=net`` when the net carries a group-id adjacency vector
     (replicated; the only delta partition form)."""
     rep = NamedSharding(mesh, P())
+    st_sh = delta_state_sharding(
+        mesh, sided=_sided(state_like), slotbase=_slotbase(state_like)
+    )
     jitted = jax.jit(
         delta_step_impl,
         static_argnames=("params", "upto"),
-        in_shardings=(
-            delta_state_sharding(mesh, sided=_sided(state_like)),
-            net_sharding(mesh, like=net_like),
-            rep,
-        ),
-        out_shardings=(
-            delta_state_sharding(mesh, sided=_sided(state_like)),
-            rep,
-        ),
+        in_shardings=(st_sh, net_sharding(mesh, like=net_like), rep),
+        out_shardings=(st_sh, rep),
         donate_argnums=(0,),
     )
 
@@ -276,15 +288,14 @@ def sharded_delta_run(
 ) -> Callable:
     """``delta_run`` (lax.scan over ticks) compiled for the mesh."""
     rep = NamedSharding(mesh, P())
+    st_sh = delta_state_sharding(
+        mesh, sided=_sided(state_like), slotbase=_slotbase(state_like)
+    )
     jitted = jax.jit(
         delta_run_impl,
         static_argnames=("params", "ticks"),
-        in_shardings=(
-            delta_state_sharding(mesh, sided=_sided(state_like)),
-            net_sharding(mesh, like=net_like),
-            rep,
-        ),
-        out_shardings=(delta_state_sharding(mesh, sided=_sided(state_like)), rep),
+        in_shardings=(st_sh, net_sharding(mesh, like=net_like), rep),
+        out_shardings=(st_sh, rep),
         donate_argnums=(0,),
     )
 
@@ -300,6 +311,10 @@ def sharded_delta_run(
 
 def _sided(state_like: DeltaState | None) -> bool:
     return state_like is not None and state_like.side is not None
+
+
+def _slotbase(state_like: DeltaState | None) -> bool:
+    return state_like is not None and state_like.d_bpmask is not None
 
 
 def _adj_layout(net_like: NetState | None) -> int | None:

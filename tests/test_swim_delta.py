@@ -713,3 +713,74 @@ def test_simcluster_sided_scenario():
     c.fold_sides()
     assert c.state.side is None
     assert len(set(c.checksums().values())) == 1
+
+
+def _assert_carried_fresh(st, where):
+    got = np.asarray(st.digest)
+    want = np.asarray(sd.compute_digest(st))
+    assert (got == want).all(), f"digest drift at {where}"
+    if st.d_bpmask is not None:
+        bpm, bpr = sd.compute_slot_base(st)
+        assert (np.asarray(st.d_bpmask) == np.asarray(bpm)).all(), where
+        assert (np.asarray(st.d_bprank) == np.asarray(bpr)).all(), where
+
+
+def test_rolling_digest_invariant_unsided():
+    """The carried digest (DeltaState.digest) must equal the
+    compute_digest oracle after every mutation path: merges with
+    insertions at a tiny capacity (drops), self refutations, phase-6
+    expiry, the exchange, and the admin ops.  tools/smoke_digest.py is
+    the longer soak; this is the suite pin."""
+    n = 32
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.05, suspicion_ticks=4),
+        wire_cap=4,
+        claim_grid=16,
+    )
+    st = sd.init_delta(n, capacity=8)
+    _assert_carried_fresh(st, "init")
+    net = sim.make_net(n)._replace()
+    net = net._replace(up=net.up.at[5].set(False))
+    key = jax.random.PRNGKey(3)
+    for t in range(16):
+        key, sub = jax.random.split(key)
+        st, _ = sd.delta_step(st, net, sub, params)
+        _assert_carried_fresh(st, f"tick {t}")
+    st = sd.revive_and_join(st, 5, inc=9, seed=2)
+    _assert_carried_fresh(st, "revive_and_join")
+    st = sd.rebase(st)
+    _assert_carried_fresh(st, "rebase")
+
+
+def test_rolling_digest_invariant_sided_flips():
+    """Sided netsplit: flips + anti-entropy folds + heal exercise the
+    wholesale in-step recompute (_refresh_in_step) and the host
+    refreshes; the invariant must hold under both carry configurations
+    of the slot-base snapshots (the state's, not the env's)."""
+    n = 32
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.0, suspicion_ticks=4),
+        wire_cap=8,
+        claim_grid=32,
+    )
+    st = sd.init_delta(n, capacity=16)
+    # force the slot-base carry on regardless of env: the step must key
+    # the in-cond refresh on the state (review round-5 finding)
+    bpm, bpr = sd.compute_slot_base(st)
+    st = st._replace(d_bpmask=bpm, d_bprank=bpr)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(5)
+    gid = (np.arange(n) >= n // 2).astype(np.int32)
+    st = sd.make_sides(st, gid)
+    assert st.d_bpmask is not None  # refresh_carried preserves the carry
+    net = net._replace(adj=jnp.asarray(gid))
+    for t in range(8):
+        key, sub = jax.random.split(key)
+        st, _ = sd.delta_step(st, net, sub, params)
+        _assert_carried_fresh(st, f"split tick {t}")
+    st = sd.rebase(st, anti_entropy=True)
+    net = net._replace(adj=jnp.zeros((n,), jnp.int32))
+    for t in range(12):
+        key, sub = jax.random.split(key)
+        st, _ = sd.delta_step(st, net, sub, params)
+        _assert_carried_fresh(st, f"heal tick {t}")

@@ -1,0 +1,55 @@
+"""Separate the delta scan's fixed cost from steady-state activity:
+time delta_run(100 ticks) at loss=0 (no failed probes, no claims,
+every gate closed forever) vs loss=0.01 (the bench's steady state).
+
+Run: JAX_PLATFORMS=cpu python tools/probe_scan_cost.py [n]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+import jax
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+
+def run_case(n: int, loss: float, ticks: int = 100, reps: int = 3) -> float:
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=loss), wire_cap=16, claim_grid=64
+    )
+    st = sd.init_delta(n, capacity=64)
+    net = sim.make_net(n)
+    keys = jax.random.split(jax.random.PRNGKey(0), reps + 1)
+    st, m = sd.delta_run(st, net, keys[0], params, ticks)  # compile+warm
+    int(m["pings_sent"])
+    best = 0.0
+    for r in range(reps):
+        t0 = time.perf_counter()
+        st, m = sd.delta_run(st, net, keys[r + 1], params, ticks)
+        int(m["pings_sent"])
+        dt = time.perf_counter() - t0
+        best = max(best, ticks * n / dt)
+    return best
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    for loss in (0.0, 0.01):
+        v = run_case(n, loss)
+        print(
+            f"n={n} loss={loss}: {v:,.0f} node-rounds/s "
+            f"({n / v * 1e3:.2f} ms/tick)",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Invariant smoke for the rolling digest (DeltaState.digest): after
+every tick of an event-heavy run, the carried value must equal the
+from-scratch oracle (compute_digest).  Exercises matched updates,
+insertions + capacity drops, self refutations, full syncs, phase-6
+expiry, declarations, the ping-req exchange, admin join/revive, and
+(second scenario) the sided netsplit flips + anti-entropy rebase.
+
+Run: JAX_PLATFORMS=cpu python tools/smoke_digest.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+
+
+def check(st, where):
+    got = np.asarray(st.digest)
+    want = np.asarray(sd.compute_digest(st))
+    assert (got == want).all(), (
+        f"digest drift at {where}: {np.flatnonzero(got != want)[:8]} "
+        f"(of {got.shape[0]})"
+    )
+    if st.d_bpmask is not None:  # RINGPOP_CARRY_SLOTBASE=1 states
+        bpm_want, bpr_want = sd.compute_slot_base(st)
+        assert (np.asarray(st.d_bpmask) == np.asarray(bpm_want)).all(), (
+            f"d_bpmask drift at {where}"
+        )
+        assert (np.asarray(st.d_bprank) == np.asarray(bpr_want)).all(), (
+            f"d_bprank drift at {where}"
+        )
+
+
+def scenario_unsided() -> None:
+    n = 48
+    # tiny wire + capacity force drops, full syncs, and window churn
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.05, suspicion_ticks=4),
+        wire_cap=4,
+        claim_grid=16,
+    )
+    st = sd.init_delta(n, capacity=12)
+    check(st, "init")
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(3)
+    net = net._replace(up=net.up.at[5].set(False))  # a death to detect
+    for t in range(40):
+        key, sub = jax.random.split(key)
+        st, m = sd.delta_step(st, net, sub, params)
+        check(st, f"unsided tick {t}")
+    st = sd.admin_join(st, joiner=7, seed=1)
+    check(st, "admin_join")
+    st = sd.revive_and_join(st, 5, inc=9, seed=2)
+    check(st, "revive_and_join")
+    st = sd.admin_leave(st, 11)
+    check(st, "admin_leave")
+    st = sd.rebase(st)
+    check(st, "rebase")
+    print(
+        "unsided ok: drops",
+        int(st.overflow_drops),
+        "occupancy",
+        int(jnp.max(jnp.sum(st.d_subj < sd.SENTINEL, axis=1))),
+    )
+
+
+def scenario_sided() -> None:
+    n = 64
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.01, suspicion_ticks=4),
+        wire_cap=8,
+        claim_grid=32,
+    )
+    st = sd.init_delta(n, capacity=24)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(5)
+    for t in range(2):
+        key, sub = jax.random.split(key)
+        st, _ = sd.delta_step(st, net, sub, params)
+    gid = (np.arange(n) >= n // 2).astype(np.int32)
+    st = sd.make_sides(st, gid)
+    check(st, "make_sides")
+    net = net._replace(adj=jnp.asarray(gid))
+    for t in range(10):
+        key, sub = jax.random.split(key)
+        st, _ = sd.delta_step(st, net, sub, params)
+        check(st, f"split tick {t}")
+        if t % 5 == 4:
+            st = sd.rebase(st, anti_entropy=True)
+            check(st, f"anti-entropy rebase @ {t}")
+    net = net._replace(adj=jnp.zeros((n,), jnp.int32))  # heal
+    for t in range(25):
+        key, sub = jax.random.split(key)
+        st, _ = sd.delta_step(st, net, sub, params)
+        check(st, f"heal tick {t}")
+        if t % 5 == 4:
+            st = sd.rebase(st, anti_entropy=True)
+            check(st, f"post-heal rebase @ {t}")
+    st = sd.fold_to_single(sd.rebase(st))
+    check(st, "fold_to_single")
+    print("sided ok: drops", int(st.overflow_drops))
+
+
+if __name__ == "__main__":
+    scenario_unsided()
+    scenario_sided()
+    print("rolling digest invariant: OK")

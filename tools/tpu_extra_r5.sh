@@ -1,0 +1,38 @@
+#!/bin/bash
+# Post-triage TPU stages for round 5 — run manually once
+# tools/tpu_triage_r5.sh has established which ladder rungs work.
+# Order reflects the round's lessons: the 65536 delta program crashed
+# the tunneled worker (15+ min recovery per crash), so risky stages sit
+# last and everything has its own timeout.
+# Usage: tools/tpu_extra_r5.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-tools/tpu_extra_r5.log}
+: > "$LOG"
+say() { echo "[$(date +%H:%M:%S)] $*" >> "$LOG"; }
+
+say "=== A/B: slot-base carry (RINGPOP_CARRY_SLOTBASE) at 32768"
+timeout 1200 python -u bench.py --child delta@64:32768 >> "$LOG" 2>&1
+say "carry=0 rc=$?"
+RINGPOP_CARRY_SLOTBASE=1 timeout 1200 python -u bench.py --child delta@64:32768 >> "$LOG" 2>&1
+say "carry=1 rc=$?"
+
+say "=== wide-lowering race at 32768 (RINGPOP_WIDE_METHOD)"
+RINGPOP_WIDE_METHOD=pallas timeout 1200 python -u bench.py --child delta@64:32768 >> "$LOG" 2>&1
+say "pallas rc=$?"
+RINGPOP_WIDE_METHOD=sort timeout 1200 python -u bench.py --child delta@64:32768 >> "$LOG" 2>&1
+say "sort rc=$?"
+
+say "=== delta scale: 262144 and 1M existence (VERDICT item 5)"
+timeout 2400 python -u benchmarks/bench_delta_scale.py 262144 20 >> "$LOG" 2>&1
+say "scale 262144 rc=$?"
+timeout 3600 python -u benchmarks/bench_delta_scale.py 1048576 5 >> "$LOG" 2>&1
+say "scale 1M rc=$?"
+
+say "=== config-4 heals on chip"
+timeout 3600 python -u benchmarks/bench_partition_heal_delta.py 8192 --sided >> "$LOG" 2>&1
+say "heal 8192 sided rc=$?"
+timeout 5400 python -u benchmarks/bench_partition_heal_delta.py 65536 --sided >> "$LOG" 2>&1
+say "heal 65536 sided (config-4 north star) rc=$?"
+
+say "done"
