@@ -116,6 +116,7 @@ def test_sharded_delta_step_bit_parity():
     assert shard_shapes == {(8, 32)}
 
 
+@pytest.mark.slow
 def test_sharded_delta_run_scan():
     from ringpop_tpu.models import swim_delta as sd
 
@@ -140,6 +141,7 @@ def test_sharded_delta_rejects_dense_adjacency():
         step(state, net, jax.random.PRNGKey(0), sd.DeltaParams())
 
 
+@pytest.mark.slow
 def test_sharded_delta_partition_bit_parity():
     """Group-id netsplit on the 8-way mesh == the single-device delta
     trajectory (which test_bit_identical_partition_split_and_heal pins
@@ -169,6 +171,7 @@ def test_sharded_delta_partition_bit_parity():
     )
 
 
+@pytest.mark.slow
 def test_sharded_sided_delta_bit_parity():
     """The sided (structured-netsplit) state shards too: [G, N] base
     rows / flip table / side vector replicate, tables row-shard — and

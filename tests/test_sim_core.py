@@ -273,6 +273,7 @@ def test_gid_partition_matches_mask_partition():
         )
 
 
+@pytest.mark.slow
 def test_receiver_merge_forms_trace_identical_trajectories(monkeypatch):
     """The sorted (sort + run-max doubling) and scatter receiver-merge
     lowerings produce bit-identical trajectories through kill + loss.

@@ -96,6 +96,7 @@ def test_bit_identical_steady_state_with_loss():
         assert int(me["overflow_drops"]) == 0
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 1])
 def test_bit_identical_kill_under_loss(seed):
     """Kill + 5% loss: the full suspect -> refute-race -> faulty chain
@@ -113,6 +114,7 @@ def test_bit_identical_kill_under_loss(seed):
     assert all(vs[i, 3] == sim.FAULTY for i in live)
 
 
+@pytest.mark.slow
 def test_bit_identical_suspend_resume():
     """SIGSTOP analog: a suspended node neither probes nor answers; its
     timers fire on resume (tick-cluster.js:432-446 semantics)."""
@@ -124,6 +126,7 @@ def test_bit_identical_suspend_resume():
         assert_matches_dense(delta, dense, t)
 
 
+@pytest.mark.slow
 def test_bit_identical_leave():
     n = 16
     params = sim.SwimParams(loss=0.02)
@@ -131,6 +134,7 @@ def test_bit_identical_leave():
         assert_matches_dense(delta, dense, t)
 
 
+@pytest.mark.slow
 def test_admin_join_and_revive_match_dense():
     """revive_and_join == dense revive + admin_join, then parity holds
     through the re-dissemination of the fresh incarnation."""
@@ -162,6 +166,7 @@ def test_admin_join_and_revive_match_dense():
     assert all(vs[i, 4] == sim.ALIVE for i in range(n))
 
 
+@pytest.mark.slow
 def test_compact_and_rebase_preserve_views():
     """compact/rebase change the representation, never the views — and
     the post-maintenance trajectory stays on the dense trajectory."""
@@ -271,6 +276,7 @@ def test_wire_cap_window_ships_later():
     pytest.fail("wire_cap=1 failed to disseminate both faults")
 
 
+@pytest.mark.slow
 def test_delta_run_scan_matches_steps():
     """delta_run (lax.scan) == the same ticks stepped individually."""
     n = 16
@@ -357,6 +363,7 @@ def test_delta_rejects_sparse_cap():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_simcluster_delta_matches_dense_checksums():
     """Same seed, same scenario: the two SimCluster backends must report
     identical reference-format checksums every step of the way."""
@@ -439,6 +446,7 @@ def test_bit_identical_self_bootstrap():
     assert int(jnp.sum(delta.d_subj < sd.SENTINEL)) == 0  # folded to base
 
 
+@pytest.mark.slow
 def test_simcluster_delta_self_bootstrap_checksums():
     from ringpop_tpu.models.cluster import SimCluster
 
@@ -459,6 +467,7 @@ def test_simcluster_delta_self_bootstrap_checksums():
     assert dense.converged() and delta.converged()
 
 
+@pytest.mark.slow
 def test_simcluster_delta_partition_matches_dense_checksums():
     """SimCluster group-id netsplit on both backends: identical
     reference-format checksums through split, heal, and remerge."""
@@ -599,6 +608,7 @@ def test_long_horizon_occupancy_stays_bounded():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_sided_trivial_matches_unsided():
     """All viewers on side 0 (G=1 + merge row): every trajectory must be
     bit-identical to the unsided single-base state — the sided machinery
@@ -689,6 +699,7 @@ def test_sided_split_consensus_folds_to_side_bases():
     assert int(jnp.max(jnp.sum((st.d_subj < sd.SENTINEL).astype(jnp.int32), axis=1))) <= 4
 
 
+@pytest.mark.slow
 def test_simcluster_sided_scenario():
     from ringpop_tpu.models.cluster import SimCluster
 
