@@ -29,6 +29,16 @@ say "scale 262144 rc=$?"
 timeout 3600 python -u benchmarks/bench_delta_scale.py 1048576 5 >> "$LOG" 2>&1
 say "scale 1M rc=$?"
 
+say "=== crash hypothesis: 65536 under the ALTERNATE wide lowerings"
+# the default scan_unrolled does log2(C) data-dependent batched gathers;
+# if the 65k worker crash is a codegen fault in that lowering, sort or
+# compare_all at the same size should run (each risks one ~15 min
+# worker recovery — run only after the safe rungs are banked)
+RINGPOP_WIDE_METHOD=sort timeout 1800 python -u bench.py --child delta@64:65536 >> "$LOG" 2>&1
+say "65536 wide=sort rc=$?"
+RINGPOP_WIDE_METHOD=pallas timeout 1800 python -u bench.py --child delta@64:65536 >> "$LOG" 2>&1
+say "65536 wide=pallas rc=$?"
+
 say "=== config-4 heals on chip"
 timeout 3600 python -u benchmarks/bench_partition_heal_delta.py 8192 --sided >> "$LOG" 2>&1
 say "heal 8192 sided rc=$?"
