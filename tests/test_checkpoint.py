@@ -46,6 +46,7 @@ def test_resume_is_bit_deterministic(tmp_path):
     assert cluster.checksums() == resumed.checksums()
 
 
+@pytest.mark.slow
 def test_checkpoint_then_fault_injection(tmp_path):
     cluster = SimCluster(16, sim.SwimParams(), seed=2)
     cluster.tick(3)

@@ -385,6 +385,7 @@ def test_simcluster_delta_matches_dense_checksums():
         assert dense.converged() == delta.converged()
 
 
+@pytest.mark.slow
 def test_simcluster_delta_kill_revive_cycle():
     from ringpop_tpu.models.cluster import SimCluster
 
@@ -674,6 +675,7 @@ def test_sided_netsplit_bounded_capacity_heals():
     assert set((np.asarray(st.base_key) & 7).tolist()) == {sim.ALIVE}
 
 
+@pytest.mark.slow
 def test_sided_split_consensus_folds_to_side_bases():
     """During the split each side converges on other-side-faulty INSIDE
     its base row with bounded tables (the whole point of sided mode)."""
