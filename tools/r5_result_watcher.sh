@@ -30,7 +30,7 @@ for i in $(seq 1 200); do  # up to ~5.5 h of 100 s polls
     say "heal65k row recorded"
     heal_done=1
   fi
-  if [ $pingreq_done -eq 0 ] && grep -q 'pingreq_piggyback_deviation_loss0.05' /tmp/r5_pingreq1024.log 2>/dev/null; then
+  if [ $pingreq_done -eq 0 ] && grep -q 'pingreq_piggyback_deviation_ratio' /tmp/r5_pingreq1024.log 2>/dev/null; then
     {
       echo ""
       echo '## Round 5: ping-req deviation regression at n=1,024 (VERDICT item 7)'
