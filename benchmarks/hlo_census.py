@@ -1,60 +1,69 @@
-"""Census of expensive ops in the delta step's TPU StableHLO.
+"""Census of expensive ops in the SWIM step's TPU StableHLO.
 
-Lowers delta_step_impl for the TPU platform (no hardware needed —
+Lowers a step for the TPU platform (no hardware needed —
 ``jax.export`` cross-platform lowering) and tallies every sort /
-scatter / gather / while by operand shape, with a rough element count.
-The per-tick fixed cost of the delta backend is sort-dominated; this
-shows exactly which call sites pay for what before a chip is available
-to time them (usage: python -m benchmarks.hlo_census [n] [capacity]).
+gather / scatter / while / Mosaic kernel by operand shape, with a
+rough element count.  Two backends:
+
+* ``--backend delta`` (default; the original census): the delta step's
+  per-tick fixed cost is sort-dominated — this shows which call sites
+  pay for what before a chip is available to time them.
+* ``--backend dense``: the dense step's cost is the [N, N] HBM passes
+  of the receiver merge — this makes the pass-count claim of
+  ``RINGPOP_RECV_MERGE`` checkable without a chip.  With ``sorted``
+  the census shows the full-tensor row permutation (an [N, N]-operand
+  gather per merge call site) and the Hillis–Steele combine loop (a
+  while per call site); with ``pallas`` both disappear into one
+  ``tpu_custom_call`` per call site (ops/recv_merge_pallas.py), and
+  the only remaining [N]-class sorts are the flat sender orderings.
+
+Usage: python -m benchmarks.hlo_census [--backend dense|delta]
+       [--recv-merge sorted|scatter|pallas] [n] [capacity]
+
+``tests/test_hlo_census.py`` pins the dense tallies as a regression
+guard (future PRs must not silently re-materialize the permuted claim
+matrix).
 """
 
 from __future__ import annotations
 
+import argparse
 import collections
+import os
 import re
-import sys
 
 import jax
+import jax.export
 
 from ringpop_tpu.utils import pin_cpu_if_requested
 
 pin_cpu_if_requested()
 
-import jax.numpy as jnp
 
-from ringpop_tpu.models import swim_delta as sd
-from ringpop_tpu.models import swim_sim as sim
+_TENSOR_RE = re.compile(r"tensor<([0-9x]+)x[a-z0-9]+>")
 
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
-    cap = int(sys.argv[2]) if len(sys.argv) > 2 else 256
-    params = sd.DeltaParams(swim=sim.SwimParams(loss=0.01), wire_cap=16,
-                            claim_grid=64)
-    state = sd.init_delta(n, capacity=cap)
-    net = sim.make_net(n)
-    key = jax.random.PRNGKey(0)
+def _dims_elems(dims: str) -> int:
+    total = 1
+    for d in dims.split("x"):
+        total *= int(d)
+    return total
 
-    exported = jax.export.export(
-        jax.jit(sd.delta_step_impl, static_argnames=("params",)),
-        platforms=["tpu"],
-    )(state, net, key, params)
-    txt = exported.mlir_module()
 
-    tallies = collections.Counter()
-    elems = collections.Counter()
+def census_text(txt: str) -> tuple[collections.Counter, collections.Counter]:
+    """Tally (op-kind [shape] -> count, -> element count) over one
+    StableHLO module's text."""
+    tallies: collections.Counter = collections.Counter()
+    elems: collections.Counter = collections.Counter()
 
     def _tally_sort(dims: str, nops: int) -> None:
         key_ = f"sort [{dims}] x{nops}ops"
         tallies[key_] += 1
-        total = 1
-        for d in dims.split("x"):
-            total *= int(d)
-        elems[key_] += total * nops
+        elems[key_] += _dims_elems(dims) * nops
 
     # older jax: inline "stablehlo.sort"(...) ops
     for m in re.finditer(r'"stablehlo\.sort"\((.*?)\)', txt):
-        shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", m.group(1))
+        shapes = _TENSOR_RE.findall(m.group(1))
         if shapes:
             _tally_sort(shapes[0], len(shapes))
 
@@ -77,21 +86,128 @@ def main():
         for cm in call_re.finditer(ch):
             if cm.group(1) not in sort_funcs:
                 continue
-            shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", cm.group(2))
+            shapes = _TENSOR_RE.findall(cm.group(2))
             if shapes:
                 _tally_sort(shapes[0], len(shapes))
-    for opname in ("scatter", "while", "dynamic_gather"):
+
+    # gathers print generic-form on one line with the full operand type
+    # signature — shape = the gathered operand (the census's whole
+    # point: a [N, N] first operand is a full-tensor row permutation)
+    for m in re.finditer(r'"stablehlo\.gather"\([^\n]*?:\s*\(([^)]*)\)', txt):
+        shapes = _TENSOR_RE.findall(m.group(1))
+        dims = shapes[0] if shapes else "?"
+        key_ = f"gather [{dims}]"
+        tallies[key_] += 1
+        if shapes:
+            elems[key_] += _dims_elems(dims)
+
+    # region-holding ops (scatter's update fn spans lines; while prints
+    # pretty-form): count call sites, shapes best-effort
+    for opname in ("scatter", "dynamic_gather"):
         for m in re.finditer(rf'"stablehlo\.{opname}"\((.*?)\)', txt):
-            shapes = re.findall(r"tensor<([0-9x]+)x[a-z0-9]+>", m.group(1))
+            shapes = _TENSOR_RE.findall(m.group(1))
             dims = shapes[0] if shapes else "?"
             tallies[f"{opname} [{dims}]"] += 1
+    n_while = len(re.findall(r"= stablehlo\.while\(", txt)) + len(
+        re.findall(r'"stablehlo\.while"\(', txt)
+    )
+    if n_while:
+        tallies["while [?]"] += n_while
 
-    print(f"n={n} capacity={cap}  module: {len(txt) / 1e6:.1f} MB text")
+    # Mosaic kernels (Pallas lowerings) arrive as tpu_custom_call
+    n_mosaic = len(re.findall(r'custom_call[^\n]*@tpu_custom_call', txt)) + len(
+        re.findall(r'call_target_name\s*=\s*"tpu_custom_call"', txt)
+    )
+    if n_mosaic:
+        tallies["tpu_custom_call [mosaic]"] += n_mosaic
+
+    return tallies, elems
+
+
+def lower_delta(n: int, cap: int) -> str:
+    """The delta step's TPU StableHLO module text."""
+    from ringpop_tpu.models import swim_delta as sd
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.01), wire_cap=16, claim_grid=64
+    )
+    state = sd.init_delta(n, capacity=cap)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+    exported = jax.export.export(
+        jax.jit(sd.delta_step_impl, static_argnames=("params",)),
+        platforms=["tpu"],
+    )(state, net, key, params)
+    return exported.mlir_module()
+
+
+def lower_dense(n: int, recv_merge: str | None = None) -> str:
+    """The dense step's TPU StableHLO module text.
+
+    ``recv_merge`` overrides the RINGPOP_RECV_MERGE lowering for this
+    trace.  The Pallas form is lowered compiled (not interpret) so the
+    census sees the real Mosaic kernel even on a CPU host."""
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sim.SwimParams(loss=0.01)
+    state = sim.init_state(n)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(0)
+
+    def _export():
+        exported = jax.export.export(
+            jax.jit(sim.swim_step_impl, static_argnames=("params",)),
+            platforms=["tpu"],
+        )(state, net, key, params)
+        return exported.mlir_module()
+
+    prev = os.environ.get("RINGPOP_PALLAS_INTERPRET")
+    os.environ["RINGPOP_PALLAS_INTERPRET"] = "0"
+    try:
+        jax.clear_caches()  # the lowering depends on the env knobs
+        if recv_merge is None:
+            return _export()
+        with sim._force_recv_merge(recv_merge):
+            return _export()
+    finally:
+        if prev is None:
+            del os.environ["RINGPOP_PALLAS_INTERPRET"]
+        else:
+            os.environ["RINGPOP_PALLAS_INTERPRET"] = prev
+        jax.clear_caches()
+
+
+def report(txt: str, header: str) -> None:
+    tallies, elems = census_text(txt)
+    print(f"{header}  module: {len(txt) / 1e6:.1f} MB text")
     print(f"{'op [shape]':45s} {'count':>5s} {'Melems':>9s}")
     for key_, cnt in sorted(tallies.items(), key=lambda kv: -elems.get(kv[0], 0)):
         print(f"{key_:45s} {cnt:5d} {elems.get(key_, 0) / 1e6:9.1f}")
     total_sort = sum(v for k, v in elems.items() if k.startswith("sort"))
     print(f"total sorted elements/tick: {total_sort / 1e6:.1f} M")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--backend", choices=("delta", "dense"), default="delta")
+    ap.add_argument(
+        "--recv-merge",
+        choices=("sorted", "scatter", "pallas"),
+        default=None,
+        help="dense only: override the RINGPOP_RECV_MERGE lowering",
+    )
+    ap.add_argument("n", nargs="?", type=int, default=None)
+    ap.add_argument("capacity", nargs="?", type=int, default=256)
+    args = ap.parse_args()
+
+    if args.backend == "delta":
+        n = args.n if args.n is not None else 65536
+        report(lower_delta(n, args.capacity), f"delta n={n} capacity={args.capacity}")
+    else:
+        n = args.n if args.n is not None else 8192
+        form = args.recv_merge or "env default"
+        report(lower_dense(n, args.recv_merge), f"dense n={n} recv_merge={form}")
 
 
 if __name__ == "__main__":

@@ -101,6 +101,31 @@ def main(n: int) -> None:
 
     timed_scan(scatter_body, jnp.ones((n, n), jnp.int32), "row-scatter (x1)")
 
+    # The RINGPOP_RECV_MERGE candidates, raced on identical inputs: a
+    # realistic colliding receiver assignment with 90% delivery.  Off
+    # TPU the pallas form would run in interpret mode (orders of
+    # magnitude slow; it exists there for parity, not speed), so the
+    # race covers it only on the live backend.
+    t_rand = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, n)
+    fwd = jax.random.uniform(jax.random.PRNGKey(3), (n,)) < 0.9
+    forms = ["sorted", "scatter"]
+    if jax.default_backend() == "tpu":
+        forms.append("pallas")
+    else:
+        print("  recv_merge[pallas]       skipped (interpret mode off-TPU)")
+    for form in forms:
+        with sim._force_recv_merge(form):
+
+            def merge_form_body(ko, k):
+                in_key, _ = sim._receiver_merge(t_rand, fwd, ko)
+                return ko ^ (in_key & 1)
+
+            timed_scan(
+                merge_form_body,
+                jnp.ones((n, n), jnp.int32),
+                f"recv_merge[{form}]",
+            )
+
     def gather_body(vk, k):
         g = vk[target]
         return vk + (g & 1)

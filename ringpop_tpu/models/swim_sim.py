@@ -100,6 +100,8 @@ int32, so relative incarnations must stay below 2**27 (~37 hours of ms) —
 
 from __future__ import annotations
 
+import contextlib
+import math
 import os
 from typing import Any, NamedTuple
 
@@ -686,8 +688,6 @@ def _phase01_select(
         ids = jnp.arange(n, dtype=jnp.int32)
         # static stagger: the multiplier must be coprime to n or whole
         # residue classes share a start and probe the same slot forever
-        import math
-
         mult = 0x9E37
         while math.gcd(mult, n) != 1:
             mult += 1
@@ -1013,20 +1013,67 @@ def _phase6_expiry(
 
 
 
-# Receiver-merge lowering for dense phase 3.  The scatter form
-# (.at[t_safe].max) is the direct expression, but the receiver indices
-# collide (several senders ping one receiver) so the TPU lowering
-# cannot vectorize it.  The sorted form is exact and scatter-free:
-# sort senders by receiver (a flat [N] argsort), permute the claim
-# rows once, then run a Hillis-Steele max-doubling within equal-
-# receiver runs — the number of [N, N] combine passes is
+# Receiver-merge lowering for the dense step (phase 3 plus every
+# ping-req slot of stages 5a-5c, all routed through _receiver_merge;
+# 5d's response returns to its own source, so it needs no routing).  The
+# scatter form (.at[t_safe].max) is the direct expression, but the
+# receiver indices collide (several senders ping one receiver) so the
+# TPU lowering cannot vectorize it.  The sorted form is exact and
+# scatter-free: sort senders by receiver (a flat [N] argsort), permute
+# the claim rows once, then run a Hillis-Steele max-doubling within
+# equal-receiver runs — the number of [N, N] combine passes is
 # ceil(log2(max inbound pings)) (~4 at 32k), bounded dynamically by a
 # while_loop, and each receiver's merged row is a final row gather at
-# its run start.  RINGPOP_RECV_MERGE picks the form at import; the
-# trajectory-parity grid in tests/test_sim_core.py pins equality.
+# its run start.  The pallas form (ops/recv_merge_pallas.py) keeps the
+# flat sort but streams the merge in ONE pass: each claim row is read
+# from HBM exactly once and each merged row written once, versus the
+# sorted form's permute + log combine passes + gather (4-6 full [N, N]
+# HBM passes at 32k).  RINGPOP_RECV_MERGE picks the form at import
+# (read again at every trace, so tests can monkeypatch); the
+# trajectory-parity grid in tests/test_sim_core.py pins all three
+# bit-identical, and benchmarks/hlo_census.py --backend dense shows
+# the per-form op budget without a chip.
 _RECV_MERGE = os.environ.get("RINGPOP_RECV_MERGE", "sorted")
-if _RECV_MERGE not in ("sorted", "scatter"):
-    raise ValueError(f"RINGPOP_RECV_MERGE={_RECV_MERGE!r}: sorted|scatter")
+if _RECV_MERGE not in ("sorted", "scatter", "pallas"):
+    raise ValueError(
+        f"RINGPOP_RECV_MERGE={_RECV_MERGE!r}: sorted|scatter|pallas"
+    )
+
+# Trace-time override stack for program builders that cannot host the
+# Pallas kernel: tpu_custom_call has no SPMD partitioning rule, so the
+# sharded mesh path (parallel/mesh.py) wraps its jitted calls in
+# _force_recv_merge("sorted") — bit-identical semantics, sharding-aware
+# lowering.  A stack (not a flag) so nested builders compose.
+_RECV_MERGE_FORCE: list[str] = []
+
+
+def _recv_merge_form() -> str:
+    return _RECV_MERGE_FORCE[-1] if _RECV_MERGE_FORCE else _RECV_MERGE
+
+
+@contextlib.contextmanager
+def _force_recv_merge(form: str):
+    """Force a receiver-merge lowering for programs traced in scope."""
+    _RECV_MERGE_FORCE.append(form)
+    try:
+        yield
+    finally:
+        _RECV_MERGE_FORCE.pop()
+
+
+def _pallas_interpret() -> bool:
+    """Trace-time interpret-mode decision for the Pallas lowering:
+    off-TPU backends degrade to interpret mode, like swim_delta's
+    pallas routing, so the env knob (and tier-1 CI) exercise the
+    kernel everywhere.  RINGPOP_PALLAS_INTERPRET=0|1 overrides — the
+    HLO census forces 0 to lower the real Mosaic kernel for the TPU
+    platform from a CPU host (benchmarks/hlo_census.py)."""
+    mode = os.environ.get("RINGPOP_PALLAS_INTERPRET", "auto")
+    if mode in ("0", "false"):
+        return False
+    if mode in ("1", "true"):
+        return True
+    return jax.default_backend() != "tpu"
 
 
 def _inbound_counts(t_safe: jax.Array, fwd_ok: jax.Array) -> jax.Array:
@@ -1044,12 +1091,19 @@ def _receiver_merge(
     """(in_key int32[N, N], inbound int32[N]): per-receiver lattice max
     of the delivered claim rows, and the delivered-ping count."""
     n = t_safe.shape[0]
-    if _RECV_MERGE == "scatter":
+    form = _recv_merge_form()
+    if form == "scatter":
         in_key = jnp.zeros((n, n), dtype=jnp.int32).at[t_safe].max(claim_rows)
         inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(
             fwd_ok.astype(jnp.int32)
         )
         return in_key, inbound
+    if form == "pallas":
+        from ringpop_tpu.ops.recv_merge_pallas import recv_merge_pallas
+
+        return recv_merge_pallas(
+            t_safe, fwd_ok, claim_rows, interpret=_pallas_interpret()
+        )
 
     recv = jnp.where(fwd_ok, t_safe, n)  # n sorts silent senders last
     order = jnp.argsort(recv)

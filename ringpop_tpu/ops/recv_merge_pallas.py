@@ -1,0 +1,153 @@
+"""Pallas TPU kernel: one-pass per-receiver lattice-max merge.
+
+The dense ``swim_step``'s hottest primitive is the receiver merge
+(``swim_sim._receiver_merge``): every delivering sender contributes its
+[N]-wide claim row, and each receiver folds its inbound rows with an
+elementwise int32 max.  The primitive runs many times per tick (dense
+phase 3 plus every ping-req slot of stages 5a-5c), so its HBM traffic
+is the step's bandwidth bill.  The XLA lowerings each over-materialize:
+
+* ``scatter``: ``zeros.at[t_safe].max(rows)`` — colliding receiver
+  indices, so the TPU scatter serializes;
+* ``sorted``: a flat [N] argsort (cheap), then a full [N, N] row
+  permutation of the claim matrix, ~log2(max inbound) Hillis–Steele
+  combine passes each touching the whole [N, N] tensor, and a final
+  [N, N] row gather — 4–6 full HBM passes over a ~4 GB tensor at 32k.
+
+This kernel keeps the cheap flat sort (senders ordered by receiver, so
+each receiver's senders form one contiguous run) and replaces every
+[N, N] pass with a single stream: the grid walks sorted sender
+positions with the claim row for position ``p`` fetched by a
+scalar-prefetch index map (``order[p]``), and max-accumulates into the
+receiver's output block, which Pallas keeps resident in VMEM while
+consecutive positions share a receiver (the matmul-K revisiting
+contract — the output flushes only when the block index changes, and
+``recv_sorted`` is non-decreasing, so every receiver's row is written
+back exactly once per column block).  Every claim row is read from HBM
+exactly once and every merged row written exactly once — the
+information-theoretic floor.
+
+Mechanics and caveats:
+
+* The three index vectors (``recv_sorted``, ``starts``, ``order``) ride
+  in SMEM as scalar-prefetch operands: 3N+1 int32, ~384 KB at n=32k —
+  fine for the single-chip dense regime this kernel serves; the sharded
+  mesh path falls back to the sorted lowering (parallel/mesh.py).
+* Senders with nothing delivered sort to the tail (key ``n``); their
+  steps clamp to row n-1 but are guarded off, so at most one dead row's
+  buffer is flushed with garbage — receivers with no inbound ping are
+  masked to 0 outside the kernel, same contract as the other forms.
+* Block shapes are (1, 1, cb) over a [N, 1, padded] view: Mosaic
+  requires the sublane dim of the last two block dims be 8-divisible or
+  the full array dim, and the middle singleton satisfies that while
+  keeping single-row fetches (the row stream is a permutation, so rows
+  cannot be block-fetched).  ``cb`` prefers a divisor of N (no padding
+  copy); the lane tile keeps it a multiple of 128.
+* ``interpret=True`` runs the same program on CPU for tier-1 parity
+  (tests/test_recv_merge_pallas.py and the trajectory grid in
+  tests/test_sim_core.py); benchmarks/profile_step.py races the
+  compiled form against sorted/scatter on a live backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Lane width of one grid step's fetch/accumulate tile (int32 lanes; a
+# multiple of 128).  Larger blocks amortize per-step overhead and grow
+# DMA granularity at 4 bytes/lane; VMEM cost is ~4 tiles of cb int32.
+COL_BLOCK = 2048
+
+
+def _kernel(n, recv_ref, starts_ref, order_ref, claims_ref, out_ref):
+    p = pl.program_id(1)
+    r = recv_ref[p]
+    valid = r < n  # delivered senders sort before the key-n tail
+    r_c = jnp.minimum(r, n - 1)
+    # the first sorted position of receiver r initializes its block
+    first = valid & (p == starts_ref[r_c])
+
+    @pl.when(first)
+    def _():
+        out_ref[...] = claims_ref[...]
+
+    @pl.when(valid & jnp.logical_not(first))
+    def _():
+        out_ref[...] = jnp.maximum(out_ref[...], claims_ref[...])
+
+
+def _pick_col_block(n: int) -> tuple[int, int]:
+    """(cb, padded): prefer a 128-multiple divisor of n (no pad copy)."""
+    for c in range(min(COL_BLOCK, n) // 128, 0, -1):
+        if n % (c * 128) == 0:
+            return c * 128, n
+    cb = min(COL_BLOCK, -(-n // 128) * 128)
+    return cb, -(-n // cb) * cb
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def recv_merge_pallas(
+    t_safe: jax.Array,
+    fwd_ok: jax.Array,
+    claim_rows: jax.Array,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """(in_key int32[N, N], inbound int32[N]): per-receiver lattice max
+    of the delivered claim rows and the delivered-ping count —
+    bit-identical to swim_sim._receiver_merge's sorted/scatter forms.
+
+    ``t_safe[s]`` is sender s's receiver, ``fwd_ok[s]`` whether its ping
+    was delivered, ``claim_rows[s]`` its (already masked, >= 0) claims.
+    """
+    n = t_safe.shape[0]
+    recv = jnp.where(fwd_ok, t_safe, n).astype(jnp.int32)
+    order = jnp.argsort(recv).astype(jnp.int32)  # flat [N]: cheap
+    recv_s = recv[order]
+    starts = jnp.searchsorted(
+        recv_s, jnp.arange(n + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    inbound = starts[1:] - starts[:-1]
+
+    cb, padded = _pick_col_block(n)
+    claims = claim_rows
+    if padded != n:
+        claims = jnp.pad(claims, ((0, 0), (0, padded - n)))
+    claims = claims.reshape(n, 1, padded)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        # sender position innermost: consecutive steps share a receiver,
+        # so the output block accumulates in VMEM between flushes
+        grid=(padded // cb, n),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, cb),
+                lambda j, p, recv_ref, starts_ref, order_ref: (
+                    order_ref[p],
+                    0,
+                    j,
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, cb),
+            lambda j, p, recv_ref, starts_ref, order_ref: (
+                jnp.minimum(recv_ref[p], n - 1),
+                0,
+                j,
+            ),
+        ),
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, n),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, 1, padded), jnp.int32),
+        interpret=interpret,
+    )(recv_s, starts, order, claims)
+    in_key = jnp.where((inbound > 0)[:, None], out[:, 0, :n], 0)
+    return in_key, inbound

@@ -27,11 +27,14 @@ slice.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ringpop_tpu.models import swim_sim as _sim
 
 from ringpop_tpu.models.swim_delta import (
     DeltaState,
@@ -92,6 +95,19 @@ def net_sharding(mesh: Mesh, like: NetState | None = None) -> NetState:
     return NetState(up=rep, responsive=rep, adj=adj)
 
 
+def _mesh_recv_merge():
+    """Trace-time guard for the dense sharded programs: the Pallas
+    receiver-merge lowers to a tpu_custom_call with no SPMD
+    partitioning rule, so under RINGPOP_RECV_MERGE="pallas" the mesh
+    path falls back to the bit-identical sorted lowering (whose sorts,
+    gathers and scatters XLA partitions into collectives).  Applied
+    around every jitted call because retraces happen on new input
+    signatures, not only the first call."""
+    if _sim._recv_merge_form() == "pallas":
+        return _sim._force_recv_merge("sorted")
+    return contextlib.nullcontext()
+
+
 def _check_divisible(n: int, mesh: Mesh) -> None:
     d = mesh.devices.size
     if n % d != 0:
@@ -141,7 +157,8 @@ def sharded_step(
 
     def step(state, net, key, params):
         _check_adj_layout(net, expect_adj)
-        return jitted(state, net, key, params)
+        with _mesh_recv_merge():
+            return jitted(state, net, key, params)
 
     return step
 
@@ -173,7 +190,8 @@ def sharded_run(
 
     def run(state, net, key, params, ticks):
         _check_adj_layout(net, expect_adj)
-        return jitted(state, net, key, params, ticks)
+        with _mesh_recv_merge():
+            return jitted(state, net, key, params, ticks)
 
     return run
 

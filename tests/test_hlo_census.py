@@ -1,0 +1,77 @@
+"""Dense-step HLO census regression: the op budget of the receiver
+merge, pinned without a chip.
+
+Lowers the dense ``swim_step`` for the TPU platform (jax.export cross-
+platform lowering) and asserts the expensive-op tallies stay within
+the measured budget — the guard that keeps future PRs from silently
+re-materializing the permuted claim matrix, and the checkable form of
+the pallas lowering's pass-count claim (ops/recv_merge_pallas.py):
+under ``pallas`` the [N, N] row permutation (full-tensor gathers) and
+the Hillis-Steele combine loops (whiles) attributable to
+``_receiver_merge`` disappear into Mosaic custom calls.
+
+Slow-marked: each lowering is a full trace + export of the step.
+Ceilings were measured at n=256 on jax 0.4.37; they are upper bounds
+(a jax upgrade may lower them — tighten, don't loosen).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from benchmarks import hlo_census as hc
+
+N = 256
+
+# measured budget at n=256 (see module docstring)
+SORTED_NN_GATHERS = 53  # incl. 30 attributable to the 10 merge call sites
+SORTED_WHILES = 11  # 10 merge combine loops + 1
+PALLAS_NN_GATHERS = 23  # the non-merge call sites (reply/relay gathers)
+PALLAS_WHILES = 1
+
+
+def _counts(tallies):
+    nn = f"{N}x{N}"
+    full_gathers = sum(c for k, c in tallies.items() if k == f"gather [{nn}]")
+    full_sorts = sum(c for k, c in tallies.items() if k.startswith(f"sort [{nn}"))
+    whiles = tallies.get("while [?]", 0)
+    mosaic = tallies.get("tpu_custom_call [mosaic]", 0)
+    return full_gathers, full_sorts, whiles, mosaic
+
+
+@pytest.mark.slow
+def test_dense_census_sorted_budget():
+    tallies, _ = hc.census_text(hc.lower_dense(N, "sorted"))
+    full_gathers, full_sorts, whiles, _ = _counts(tallies)
+    # no [N, N]-operand sort may ever appear (the [N] sender orderings
+    # are the only sorts the dense step is allowed)
+    assert full_sorts == 0
+    assert full_gathers <= SORTED_NN_GATHERS
+    assert whiles <= SORTED_WHILES
+    # floors: the sorted form MUST show the permutation gathers and
+    # combine loops — zero means census_text's regexes rotted against a
+    # new StableHLO print form and the ceilings above are vacuous
+    assert full_gathers > PALLAS_NN_GATHERS
+    assert whiles > PALLAS_WHILES
+
+
+@pytest.mark.slow
+def test_dense_census_pallas_eliminates_merge_passes():
+    tallies, _ = hc.census_text(hc.lower_dense(N, "pallas"))
+    full_gathers, full_sorts, whiles, mosaic = _counts(tallies)
+    assert mosaic >= 1, "expected the Mosaic receiver-merge custom call"
+    assert full_sorts == 0
+    # the merge-attributable [N, N] permutation gathers and combine
+    # loops are gone; what remains are the reply/relay call sites
+    assert full_gathers <= PALLAS_NN_GATHERS
+    assert whiles <= PALLAS_WHILES
+    assert full_gathers < SORTED_NN_GATHERS
+    assert whiles < SORTED_WHILES
+
+
+@pytest.mark.slow
+def test_delta_census_still_lowers():
+    # the --backend refactor must not break the original delta census
+    tallies, elems = hc.census_text(hc.lower_delta(1024, 64))
+    assert any(k.startswith("sort") for k in tallies)
+    assert sum(elems.values()) > 0

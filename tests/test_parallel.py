@@ -70,6 +70,35 @@ def test_sharded_run_converges():
     assert (np.diagonal(vs) == sim.ALIVE).all()
 
 
+def test_sharded_step_pallas_env_falls_back(monkeypatch):
+    """RINGPOP_RECV_MERGE="pallas" must not break the mesh path: the
+    Pallas kernel has no SPMD partitioning rule, so the dense sharded
+    step falls back to the (bit-identical) sorted lowering at trace
+    time (parallel.mesh._mesh_recv_merge)."""
+    n = 64
+    params = sim.SwimParams(loss=0.0)
+    key = jax.random.PRNGKey(7)
+    ref_state, _ = sim.swim_step(
+        sim.init_state(n, mode="self"), sim.make_net(n), key, params
+    )
+    ref_vk = np.asarray(ref_state.view_key)
+
+    monkeypatch.setattr(sim, "_RECV_MERGE", "pallas")
+    jax.clear_caches()
+    try:
+        mesh = parallel.make_mesh(8)
+        state, net = parallel.shard_cluster(
+            sim.init_state(n, mode="self"), sim.make_net(n), mesh
+        )
+        step = parallel.sharded_step(mesh)
+        sh_state, _ = step(state, net, key, params)
+        sh_vk = np.asarray(sh_state.view_key)
+    finally:
+        # executables traced under the patched global must not outlive it
+        jax.clear_caches()
+    np.testing.assert_array_equal(ref_vk, sh_vk)
+
+
 def test_uneven_shard_rejected():
     mesh = parallel.make_mesh(8)
     with pytest.raises(ValueError):

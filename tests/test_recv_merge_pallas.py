@@ -1,0 +1,84 @@
+"""Bit-parity of the Pallas receiver merge against the XLA lowerings.
+
+Runs the kernel in interpret mode so CPU CI covers it, same contract
+as tests/test_searchsorted_pallas.py (on-hardware execution is raced
+by benchmarks/profile_step.py).  The full-trajectory grid through the
+dense step's five call sites is in tests/test_sim_core.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.ops.recv_merge_pallas import recv_merge_pallas
+
+
+def _scatter_oracle(t_safe, fwd_ok, claim_rows):
+    n = t_safe.shape[0]
+    in_key = jnp.zeros((n, n), dtype=jnp.int32).at[t_safe].max(claim_rows)
+    inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(fwd_ok.astype(jnp.int32))
+    return in_key, inbound
+
+
+def _case(n: int, seed: int, deliver: float):
+    """A phase-3-shaped input: colliding receivers, masked claim rows."""
+    rng = np.random.default_rng(seed)
+    fwd_ok = rng.random((n,)) < deliver
+    t_safe = np.where(fwd_ok, rng.integers(0, n, (n,)), 0).astype(np.int32)
+    claims = (rng.integers(0, 1 << 20, (n, n)) * (rng.random((n, n)) < 0.4)).astype(
+        np.int32
+    )
+    claims = np.where(fwd_ok[:, None], claims, 0)
+    return jnp.asarray(t_safe), jnp.asarray(fwd_ok), jnp.asarray(claims)
+
+
+# n values straddle the column-block divisibility paths: 7/130 pad to a
+# 128 multiple, 48 pads, 128/256 hit the no-pad divisor path.
+@pytest.mark.parametrize("n", [7, 48, 128, 130, 256])
+@pytest.mark.parametrize("deliver", [0.15, 0.9])
+def test_matches_scatter_form(n, deliver):
+    t_safe, fwd_ok, claims = _case(n, 1000 * n + int(deliver * 10), deliver)
+    got_k, got_i = recv_merge_pallas(t_safe, fwd_ok, claims, interpret=True)
+    want_k, want_i = _scatter_oracle(t_safe, fwd_ok, claims)
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+
+
+def test_matches_sorted_form():
+    from ringpop_tpu.models import swim_sim as sim
+
+    t_safe, fwd_ok, claims = _case(96, 7, 0.8)
+    with sim._force_recv_merge("sorted"):
+        want_k, want_i = sim._receiver_merge(t_safe, fwd_ok, claims)
+    got_k, got_i = recv_merge_pallas(t_safe, fwd_ok, claims, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+
+
+def test_no_deliveries_all_zero():
+    n = 16
+    got_k, got_i = recv_merge_pallas(
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), bool),
+        jnp.zeros((n, n), jnp.int32),
+        interpret=True,
+    )
+    assert (np.asarray(got_k) == 0).all()
+    assert (np.asarray(got_i) == 0).all()
+
+
+def test_single_receiver_max_run():
+    # every sender pings receiver 3: one run of length n (the longest
+    # possible VMEM-resident accumulation), plus the garbage-flush path
+    # for the untouched tail receiver n-1
+    n = 24
+    rng = np.random.default_rng(5)
+    t_safe = jnp.full((n,), 3, jnp.int32)
+    fwd_ok = jnp.ones((n,), bool)
+    claims = jnp.asarray(rng.integers(0, 1 << 20, (n, n)).astype(np.int32))
+    got_k, got_i = recv_merge_pallas(t_safe, fwd_ok, claims, interpret=True)
+    want_k, want_i = _scatter_oracle(t_safe, fwd_ok, claims)
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))

@@ -274,16 +274,24 @@ def test_gid_partition_matches_mask_partition():
 
 
 @pytest.mark.slow
-def test_receiver_merge_forms_trace_identical_trajectories(monkeypatch):
-    """The sorted (sort + run-max doubling) and scatter receiver-merge
-    lowerings produce bit-identical trajectories through kill + loss.
-    _RECV_MERGE is read at trace time, so each form is retraced from a
-    cleared jit cache."""
+@pytest.mark.parametrize("small_n", [None, 16])
+def test_receiver_merge_forms_trace_identical_trajectories(monkeypatch, small_n):
+    """The sorted (sort + run-max doubling), scatter, and pallas
+    (ops/recv_merge_pallas.py, interpret mode on CPU) receiver-merge
+    lowerings produce bit-identical trajectories through kill + loss —
+    covering the phase-3 merge and the phase-5a-5c stage merges (the
+    kill forces failed probes into the ping-req exchange).  The
+    ``small_n=16`` leg lowers _SPARSE_SMALL_N below n so the
+    large-row block-prefix selection path runs under every form too.
+    _RECV_MERGE / _SPARSE_SMALL_N are read at trace time, so each form
+    is retraced from a cleared jit cache."""
     n = 48
     params = sim.SwimParams(loss=0.05, suspicion_ticks=8)
+    if small_n is not None:
+        monkeypatch.setattr(sim, "_SPARSE_SMALL_N", small_n)
     finals = []
     try:
-        for form in ("sorted", "scatter"):
+        for form in ("sorted", "scatter", "pallas"):
             monkeypatch.setattr(sim, "_RECV_MERGE", form)
             jax.clear_caches()
             state = sim.init_state(n)
@@ -295,6 +303,30 @@ def test_receiver_merge_forms_trace_identical_trajectories(monkeypatch):
             finals.append(np.asarray(state.view_key))
     finally:
         # the last form's executables must not outlive the restored
-        # module global (later tests would silently run it)
+        # module globals (later tests would silently run them)
+        jax.clear_caches()
+    np.testing.assert_array_equal(finals[0], finals[1])
+    np.testing.assert_array_equal(finals[0], finals[2])
+
+
+def test_pallas_recv_merge_short_trajectory_parity(monkeypatch):
+    """Fast tier-1 representative of the slow grid above: the pallas
+    lowering stays bit-identical to sorted through a kill + loss run
+    long enough to exercise the ping-req stage merges."""
+    n = 24
+    params = sim.SwimParams(loss=0.05, suspicion_ticks=6)
+    finals = []
+    try:
+        for form in ("sorted", "pallas"):
+            monkeypatch.setattr(sim, "_RECV_MERGE", form)
+            jax.clear_caches()
+            state = sim.init_state(n)
+            net = sim.make_net(n)
+            net = net._replace(up=net.up.at[3].set(False))
+            keys = jax.random.split(jax.random.PRNGKey(2), 10)
+            for t in range(10):
+                state, _ = sim.swim_step(state, net, keys[t], params)
+            finals.append(np.asarray(state.view_key))
+    finally:
         jax.clear_caches()
     np.testing.assert_array_equal(finals[0], finals[1])

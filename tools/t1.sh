@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Tier-1 verify: the CPU test suite minus slow soaks, exactly as
+# ROADMAP.md specifies it (this script IS the roadmap command; keep the
+# two in sync).  Extra args pass through to pytest, e.g.:
+#   tools/t1.sh -k recv_merge
+#   tools/t1.sh -m slow        # opt in to the slow parity soaks
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
