@@ -1,0 +1,102 @@
+"""Scenario engine: one compiled dispatch vs the host-driven loop.
+
+The scenario subsystem's reason to exist, measured: a chaos experiment
+(kill + partition + heal + loss-ramp) whose every fault boundary used
+to force the host loop to end the jitted run, mutate ``NetState`` and
+re-dispatch, now runs as ONE ``lax.scan`` — and stacks the per-tick
+telemetry the host loop never had.  Both arms replay the identical
+fault sequence from the same seed (segment-exact key schedule), so the
+final states are bit-identical and the delta is pure dispatch/compile
+overhead.  Warm wall time is the headline; the cold (compile-included)
+times are reported for context.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.scenarios import runner
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+
+def _spec(n: int, ticks: int) -> ScenarioSpec:
+    half = n // 2
+    return ScenarioSpec.from_dict(
+        {
+            "ticks": ticks,
+            "events": [
+                {"at": ticks // 8, "op": "kill", "node": n - 1},
+                {"at": ticks // 4, "op": "partition",
+                 "groups": [list(range(half)), list(range(half, n))]},
+                {"at": ticks // 4, "op": "loss", "p": 0.05},
+                {"at": ticks // 2, "op": "heal"},
+                {"at": ticks // 2 + 5, "op": "loss_ramp",
+                 "until": ticks // 2 + 15, "to": 0.0},
+            ],
+        }
+    )
+
+
+def run(n: int = 2048, ticks: int = 120) -> list[dict]:
+    spec = _spec(n, ticks)
+    params = sim.SwimParams()
+
+    def one_call():
+        c = SimCluster(n, params, seed=11)
+        before = runner.dispatch_count()
+        t0 = time.perf_counter()
+        trace = c.run_scenario(spec)
+        wall = time.perf_counter() - t0
+        return c, wall, runner.dispatch_count() - before, trace
+
+    # cold (compile) then warm (executable cached)
+    _, cold_one, dispatches, _ = one_call()
+    c1, warm_one, _, trace = one_call()
+
+    def host_loop():
+        c = SimCluster(n, params, seed=11)
+        t0 = time.perf_counter()
+        runner.run_host_loop(c, spec)
+        return c, time.perf_counter() - t0
+
+    _, cold_host = host_loop()
+    c2, warm_host = host_loop()
+
+    match = c1.checksums() == c2.checksums()
+    return [
+        {
+            "metric": f"scenario_one_call_n{n}_t{ticks}",
+            "value": round(warm_one, 4),
+            "unit": "s_warm",
+            "cold_s": round(cold_one, 3),
+            "dispatches": dispatches,
+            "converged": bool(trace.converged[-1]),
+        },
+        {
+            "metric": f"scenario_host_loop_n{n}_t{ticks}",
+            "value": round(warm_host, 4),
+            "unit": "s_warm",
+            "cold_s": round(cold_host, 3),
+            "segments": len({0, *spec_boundaries(spec)}),
+            "speedup_one_call": round(warm_host / max(warm_one, 1e-9), 2),
+            "checksums_match": match,
+        },
+    ]
+
+
+def spec_boundaries(spec: ScenarioSpec) -> list[int]:
+    from ringpop_tpu.scenarios.compile import compile_spec
+
+    # n is only used for validation/gid rows; the boundary set is n-free
+    flat = [m for e in spec.events if e.groups for g in e.groups for m in g]
+    n = (max(flat) + 1) if flat else 2
+    return list(compile_spec(spec, n).boundaries)
+
+
+if __name__ == "__main__":
+    import json
+
+    for row in run(n=512, ticks=80):
+        print(json.dumps(row))

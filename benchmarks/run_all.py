@@ -26,6 +26,7 @@ SIM_BENCHES = [
     "bench_sim_convergence",
     "bench_partition_heal",
     "bench_pingreq_deviation",
+    "bench_scenario",  # one-call compiled scenario vs the host loop
 ]
 
 
@@ -50,7 +51,9 @@ def main(argv=None) -> int:
     for name in names:
         module = importlib.import_module(f"benchmarks.{name}")
         kwargs = {}
-        if args.sim_n and name in ("bench_sim_convergence", "bench_partition_heal"):
+        if args.sim_n and name in (
+            "bench_sim_convergence", "bench_partition_heal", "bench_scenario"
+        ):
             kwargs["n"] = args.sim_n
         try:
             for result in module.run(**kwargs):

@@ -33,8 +33,9 @@ from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 
 # v2: packed view_key/pb/suspect_left state layout
 # v3: + delta backend (DeltaState leaves, resource caps in meta)
-FORMAT_VERSION = 3
-_READABLE_VERSIONS = (2, 3)
+# v4: + telemetry (metrics_log in meta, scenario traces as trace{i}.*)
+FORMAT_VERSION = 4
+_READABLE_VERSIONS = (2, 3, 4)
 
 
 def save(cluster: SimCluster, path: str) -> None:
@@ -52,12 +53,18 @@ def save(cluster: SimCluster, path: str) -> None:
             "wire_cap": cluster.dparams.wire_cap,
             "claim_grid": cluster.dparams.claim_grid,
         },
+        # telemetry rides along (v4): a resumed run keeps its time
+        # series instead of restarting blind
+        "metrics_log": cluster.metrics_log,
+        "traces": [t.meta() for t in cluster.traces],
     }
     arrays: dict[str, np.ndarray] = {
         "meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
         "key": np.asarray(cluster.key),
         "addresses": np.asarray(cluster.book.addresses, dtype=np.str_),
     }
+    for i, trace in enumerate(cluster.traces):
+        arrays.update(trace.to_arrays(prefix=f"trace{i}."))
     for name, leaf in cluster.state._asdict().items():
         if leaf is None:  # optional extension tensors (damping)
             continue
@@ -143,6 +150,18 @@ def load(path: str, device: Any | None = None) -> SimCluster:
             cluster.state = cluster.state._replace(d_bpmask=bpm, d_bprank=bpr)
         cluster.net = load_tuple(NetState, "net")
         cluster.key = jax.numpy.asarray(data["key"])
+        # telemetry (v4); older checkpoints backfill empty — same
+        # optional-field pattern as the delta carried derivatives above
+        cluster.metrics_log = [
+            {k: int(v) for k, v in entry.items()}
+            for entry in meta.get("metrics_log", [])
+        ]
+        from ringpop_tpu.scenarios.trace import Trace
+
+        cluster.traces = [
+            Trace.from_arrays(data, tmeta, prefix=f"trace{i}.")
+            for i, tmeta in enumerate(meta.get("traces", []))
+        ]
     if device is not None:
         cluster.state = jax.device_put(cluster.state, device)
         cluster.net = jax.device_put(cluster.net, device)

@@ -474,6 +474,30 @@ class TpuSimCluster(ClusterDriver):
     def shutdown(self) -> None:
         pass
 
+    def run_scenario(self, path: str, trace_out: str | None = None) -> None:
+        """Run a JSON scenario spec as ONE jitted call (scenarios/)."""
+        from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+        spec = ScenarioSpec.load(path)
+        t0 = time.perf_counter()
+        trace = self.cluster.run_scenario(spec)
+        wall_ms = (time.perf_counter() - t0) * 1000
+        state = (
+            "CONVERGED" if trace.converged[-1]
+            else f"NOT converged ({int(trace.live[-1])} live)"
+        )
+        print(
+            f"scenario: {trace.ticks} ticks, {len(spec.events)} events, "
+            f"one dispatch in {wall_ms:.0f}ms — {state}, first converged "
+            f"tick {trace.first_converged_tick()}, "
+            f"live {int(trace.live[-1])}/{self.cluster.n}"
+        )
+        print(format_groups(self.cluster.checksum_groups(), wall_ms))
+        if trace_out:
+            trace.save(trace_out)
+            print(f"trace ({trace.ticks} ticks x "
+                  f"{len(trace.metrics) + 3} series) -> {trace_out}")
+
 
 MENU = """commands:
   j join-all    g gossip-all   t tick (convergence)   s stats by checksum
@@ -545,6 +569,16 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                         help="tpu-sim: enable the flap-damping extension")
     parser.add_argument("--script", default=None,
                         help='non-interactive command list, e.g. "j,w3000,t,q"')
+    parser.add_argument("--scenario", default=None, metavar="FILE",
+                        help="tpu-sim: run a JSON scenario spec (compiled "
+                             "fault timeline, one jitted dispatch; see "
+                             "docs/simulation.md) instead of --script")
+    parser.add_argument("--trace-out", default=None, metavar="FILE",
+                        help="with --scenario: write the per-tick telemetry "
+                             "trace (.npz) here")
+    parser.add_argument("--script-to-scenario", default=None, metavar="FILE",
+                        help="compile --script into a scenario spec JSON at "
+                             "FILE and exit (no cluster is started)")
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--log-level", default="warn")
     parser.add_argument("--startup-timeout-s", type=float, default=60,
@@ -556,7 +590,23 @@ def main(argv: list[str] | None = None) -> None:
     add_args(parser)
     args = parser.parse_args(argv)
 
+    if args.script_to_scenario:
+        if not args.script:
+            parser.error("--script-to-scenario needs --script")
+        from ringpop_tpu.scenarios.spec import script_to_spec
+
+        spec = script_to_spec(args.script, args.size)
+        spec.save(args.script_to_scenario)
+        print(
+            f"compiled {len(spec.events)} events over {spec.ticks} ticks "
+            f"-> {args.script_to_scenario}"
+        )
+        return
+
     backend = args.backend or ("host-sim" if args.sim else "proc")
+    if args.scenario and backend != "tpu-sim":
+        parser.error("--scenario needs --backend tpu-sim (the compiled "
+                     "scenario engine is a tensor-simulation feature)")
     if backend == "host-sim":
         driver: ClusterDriver = SimCluster(args.size, args.base_port,
                                            seed=args.seed)
@@ -572,7 +622,9 @@ def main(argv: list[str] | None = None) -> None:
         driver = cluster
 
     try:
-        if args.script:
+        if args.scenario:
+            driver.run_scenario(args.scenario, args.trace_out)
+        elif args.script:
             run_script(driver, args.script)
         else:
             run_interactive(driver)

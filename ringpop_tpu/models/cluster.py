@@ -30,14 +30,18 @@ from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 DEFAULT_BASE_INC = 1_400_000_000_000  # host clock epoch (clock.SimScheduler)
 
 
-@jax.jit
-def _converged_impl(state: ClusterState, net: NetState) -> jax.Array:
-    own = jnp.diagonal(state.view_key) & 7
-    live = net.up & net.responsive & ((own == sim.ALIVE) | (own == sim.SUSPECT))
-    ref = jnp.argmax(live)  # first live node's view is the reference view
-    # (status, inc) equal iff the packed lattice key is equal.
-    row_same = jnp.all(state.view_key == state.view_key[ref][None, :], axis=1)
-    return jnp.all(jnp.where(live, row_same, True)) | (jnp.sum(live) <= 1)
+# the predicate itself lives in swim_sim (shared with the scenario scan)
+_converged_impl = jax.jit(sim.converged_impl)
+
+
+def groups_to_gid(groups: Sequence[Sequence[int]], n: int) -> np.ndarray:
+    """int32[N] group-id vector (-1 = ungrouped) from member lists —
+    the single gid constructor shared by ``partition``/``split_sides``
+    and the scenario compiler (scenarios/compile.py)."""
+    gid = np.full(n, -1, dtype=np.int32)
+    for g, members in enumerate(groups):
+        gid[np.asarray(list(members), dtype=np.int32)] = g
+    return gid
 
 
 class SimCluster:
@@ -99,6 +103,7 @@ class SimCluster:
         self.net: NetState = sim.make_net(n)
         self.key = jax.random.PRNGKey(seed)
         self.metrics_log: list[dict[str, int]] = []
+        self.traces: list[Any] = []  # scenarios.Trace per run_scenario
         self._device_book = None  # lazy ckdev.DeviceBook (device checksums)
         if device is not None:
             self.state = jax.device_put(self.state, device)
@@ -134,8 +139,71 @@ class SimCluster:
                 self.state, self.net, self._split(), self.params, ticks
             )
         out = {k: int(v) for k, v in metrics.items()}
+        # multi-tick entries report only the LAST tick's counters (the
+        # scan discards the rest); record how many ticks the entry
+        # spans so the log is unambiguous (use run_scenario for a full
+        # per-tick time series)
+        out["ticks"] = int(ticks)
         self.metrics_log.append(out)
         return out
+
+    def run_scenario(self, spec) -> Any:
+        """Run a declarative fault timeline as ONE jitted call.
+
+        ``spec`` is a ``scenarios.ScenarioSpec`` (or its dict form, or
+        a path to its JSON file): kill/revive/suspend/resume at tick
+        t, group-partitions and heals, stepwise loss schedules — all
+        compiled to device-resident event tensors applied inside the
+        scan (scenarios/), with per-tick telemetry stacked into the
+        returned ``Trace`` (also appended to ``self.traces`` and
+        checkpointed).  The PRNG key schedule is segment-exact, so the
+        trajectory is bit-identical to the equivalent host sequence of
+        ``kill()``/``partition()``/``tick()`` calls — minus the
+        per-fault dispatch round-trips.
+        """
+        from ringpop_tpu.scenarios import compile as scompile
+        from ringpop_tpu.scenarios import runner as srunner
+        from ringpop_tpu.scenarios.spec import ScenarioSpec
+        from ringpop_tpu.scenarios.trace import Trace
+
+        if isinstance(spec, str):
+            spec = ScenarioSpec.load(spec)
+        elif isinstance(spec, dict):
+            spec = ScenarioSpec.from_dict(spec)
+        spec.validate(self.n)
+        compiled = scompile.compile_spec(
+            spec, self.n, base_loss=self.params.loss
+        )
+        # static rejections BEFORE drawing keys: a failed call must not
+        # advance self.key (it would silently desynchronize reruns)
+        srunner.precheck(self.state, self.net, compiled)
+        keys = scompile.key_schedule(self._split, compiled)
+        params = self.dparams if self.backend == "delta" else self.params
+        start_tick = int(self.state.tick)
+        self.state, self.net, ys = srunner.run_compiled(
+            self.state, self.net, keys, compiled, params
+        )
+        self.set_loss(float(compiled.loss[-1]))  # host mirror of the schedule
+        stacks = {k: np.asarray(v) for k, v in ys.items()}
+        trace = Trace(
+            metrics={
+                k: v
+                for k, v in stacks.items()
+                if k not in ("converged", "live", "loss")
+            },
+            converged=stacks["converged"],
+            live=stacks["live"],
+            loss=stacks["loss"],
+            n=self.n,
+            backend=self.backend,
+            start_tick=start_tick,
+            spec=spec.to_dict(),
+        ).validate()
+        self.traces.append(trace)
+        entry = {k: int(v[-1]) for k, v in trace.metrics.items()}
+        entry["ticks"] = spec.ticks
+        self.metrics_log.append(entry)
+        return trace
 
     def run_until_converged(self, max_ticks: int = 1000, check_every: int = 5) -> int:
         """Ticks until convergence (or -1); the tick-cluster 't' loop."""
@@ -324,9 +392,7 @@ class SimCluster:
         partitions keep the mask form — a step compiled against the
         mask layout (sharded_step's in_shardings, or any traced jit)
         must never see the adj flip to a different ndim mid-run."""
-        gid = np.full(self.n, -1, dtype=np.int32)
-        for g, members in enumerate(groups):
-            gid[np.asarray(members, dtype=np.int32)] = g
+        gid = groups_to_gid(groups, self.n)
         keep_mask = self.net.adj is not None and self.net.adj.ndim == 2
         if (gid >= 0).all() and not keep_mask:
             self.net = self.net._replace(adj=jnp.asarray(gid))
@@ -379,9 +445,7 @@ class SimCluster:
         into its own base row via the periodic ``rebase``."""
         if self.backend != "delta":
             raise ValueError("split_sides is a delta-backend operation")
-        gid = np.full(self.n, -1, dtype=np.int32)
-        for g, members in enumerate(groups):
-            gid[np.asarray(members, dtype=np.int32)] = g
+        gid = groups_to_gid(groups, self.n)
         if (gid < 0).any():
             raise ValueError("split_sides groups must cover every node")
         self.state = sdelta.make_sides(self.state, gid)

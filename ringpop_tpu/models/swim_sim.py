@@ -486,8 +486,15 @@ def _choose_targets_and_witnesses(
     return target, valid[:, 0], picks_all[:, 1:], valid[:, 1:]
 
 
-def _drop(key: jax.Array, shape: tuple, loss: float) -> jax.Array:
-    """Per-message Bernoulli loss draw (True = dropped)."""
+def _drop(key: jax.Array, shape: tuple, loss: float | jax.Array) -> jax.Array:
+    """Per-message Bernoulli loss draw (True = dropped).
+
+    ``loss`` is normally a static python float (zero compiles away the
+    draw entirely); a traced scalar (the scenario engine's per-tick
+    loss schedule, scenarios/runner.py) always draws — value-equal at
+    every loss, since ``uniform < 0.0`` is identically False."""
+    if isinstance(loss, jax.Array):
+        return jax.random.uniform(key, shape) < loss
     if loss <= 0.0:
         return jnp.zeros(shape, dtype=bool)
     return jax.random.uniform(key, shape) < loss
@@ -1133,6 +1140,20 @@ def _receiver_merge(
     start_c = jnp.minimum(starts[:-1], n - 1)
     in_key = jnp.where((inbound > 0)[:, None], rows_s[start_c], 0)
     return in_key, inbound
+
+
+def converged_impl(state: ClusterState, net: NetState) -> jax.Array:
+    """Exact view agreement among live (gossiping) nodes — the
+    convergence predicate ``SimCluster.converged`` jits, shared with
+    the scenario scan's per-tick telemetry (scenarios/runner.py).
+    Fixed-shape masked compare: no live-set gather, no recompiles as
+    the live count changes."""
+    own = jnp.diagonal(state.view_key) & 7
+    live = net.up & net.responsive & ((own == ALIVE) | (own == SUSPECT))
+    ref = jnp.argmax(live)  # first live node's view is the reference view
+    # (status, inc) equal iff the packed lattice key is equal.
+    row_same = jnp.all(state.view_key == state.view_key[ref][None, :], axis=1)
+    return jnp.all(jnp.where(live, row_same, True)) | (jnp.sum(live) <= 1)
 
 
 def swim_step_impl(

@@ -1,0 +1,42 @@
+"""Scenario engine: compiled fault timelines + per-tick telemetry.
+
+The subsystem that finishes what the reference stubbed out
+(test/lib/partition-cluster.js:59-61 — scripted netsplits) and goes
+past it: a declarative fault timeline (kill / revive / suspend /
+resume at tick t, partition / heal, stepwise loss schedules) compiles
+into device-resident event tensors applied *inside* the
+``swim_run``/``delta_run`` scan, so an entire chaos experiment runs as
+ONE jitted call per backend — no host round-trips at fault boundaries
+— while the same scan stacks a per-tick telemetry time series
+(protocol metrics, converged flag, live count) into a ``Trace``.
+
+Layers:
+
+* ``spec``    — the declarative ``ScenarioSpec`` (JSON-loadable) and
+  the ``--script`` mini-DSL compiler into it.
+* ``compile`` — ``ScenarioSpec -> CompiledScenario`` event tensors +
+  the segment-exact PRNG key schedule.
+* ``runner``  — the single-dispatch jitted scan over both backends,
+  plus the host-loop equivalent (the parity/benchmark baseline).
+* ``trace``   — the stacked telemetry, npz export, and the
+  ``stats.py``-key-compatible summary.
+
+Entry points: ``SimCluster.run_scenario(spec)`` and
+``tick-cluster --backend tpu-sim --scenario FILE``.
+"""
+
+from ringpop_tpu.scenarios.spec import Event, ScenarioSpec, script_to_spec
+from ringpop_tpu.scenarios.compile import CompiledScenario, compile_spec
+from ringpop_tpu.scenarios.trace import Trace
+from ringpop_tpu.scenarios.runner import run_compiled, run_host_loop
+
+__all__ = [
+    "Event",
+    "ScenarioSpec",
+    "script_to_spec",
+    "CompiledScenario",
+    "compile_spec",
+    "Trace",
+    "run_compiled",
+    "run_host_loop",
+]

@@ -1,0 +1,144 @@
+"""ScenarioSpec -> device-resident event tensors + the key schedule.
+
+The compiled form is what the one-dispatch runner scans over:
+
+* node events as flat ``(tick, kind, node)`` arrays — applied per tick
+  by masked out-of-bounds-dropped scatters (O(E) per tick, no [T, N]
+  timeline tensor);
+* partition/heal events as ``(tick, gid_row)`` — each row an int32[N]
+  group-id adjacency (``swim_sim._adj``; heal = all-one-group zeros);
+* the loss schedule as a dense float32[ticks] (stepwise events and
+  ramps are both just per-tick values here);
+* the segment boundaries: every tick at which any event fires.  The
+  PRNG **key schedule** derives from them so the compiled run is
+  bit-identical to the equivalent host-side sequence of
+  ``apply-faults; tick(segment)`` calls — ``SimCluster.tick(k)`` draws
+  one split of the cluster key per call and fans it into k per-tick
+  keys, so the schedule replays exactly that (``key_schedule``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models.cluster import groups_to_gid
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+# node-event kinds (ev_kind values)
+EV_KILL = 0
+EV_SUSPEND = 1
+EV_RESUME = 2
+EV_REVIVE = 3
+_KIND = {"kill": EV_KILL, "suspend": EV_SUSPEND, "resume": EV_RESUME,
+         "revive": EV_REVIVE}
+
+
+class CompiledScenario(NamedTuple):
+    """Device tensors + static shape facts for one scenario."""
+
+    ticks: int
+    n: int
+    ev_tick: jax.Array  # int32[E] node-event ticks
+    ev_kind: jax.Array  # int32[E] EV_* codes
+    ev_node: jax.Array  # int32[E] target node
+    p_tick: jax.Array  # int32[P] partition/heal ticks
+    p_gid: jax.Array  # int32[P, N] group-id rows (heal = zeros)
+    loss: jax.Array  # float32[ticks] per-tick loss in force
+    has_revive: bool  # static: trace the in-scan revive path at all?
+    boundaries: tuple[int, ...]  # distinct event ticks in (0, ticks)
+
+
+def expand_events(
+    spec: ScenarioSpec, base_loss: float
+) -> list[tuple[int, str, Any]]:
+    """The spec as concrete per-tick ops, ramps unrolled to stepwise
+    ``loss`` ops — the single source of truth shared by the tensor
+    compiler and the host-loop equivalent (``runner.run_host_loop``)."""
+    out: list[tuple[int, str, Any]] = []
+    loss = float(base_loss)
+    for e in sorted(spec.events, key=lambda e: e.at):
+        if e.op == "loss":
+            loss = float(e.p)
+            out.append((e.at, "loss", loss))
+        elif e.op == "loss_ramp":
+            start, span = loss, e.until - e.at
+            for tau in range(e.at, e.until):
+                loss = start + (float(e.p) - start) * (tau - e.at + 1) / span
+                out.append((tau, "loss", loss))
+        elif e.op == "partition":
+            out.append((e.at, "partition", e.groups))
+        elif e.op == "heal":
+            out.append((e.at, "heal", None))
+        else:
+            out.append((e.at, e.op, e.node))
+    return out
+
+
+def compile_spec(
+    spec: ScenarioSpec, n: int, *, base_loss: float = 0.0
+) -> CompiledScenario:
+    """Lower a validated spec to the tensors the jitted runner scans."""
+    spec.validate(n)
+    ops = expand_events(spec, base_loss)
+
+    ev_tick, ev_kind, ev_node = [], [], []
+    p_tick, p_gid = [], []
+    loss_tl = np.full(spec.ticks, float(base_loss), dtype=np.float32)
+    # tick order, NOT event order: a ramp's unrolled ops interleave
+    # with later loss events, and each loss write covers [at:] — the
+    # host loop applies them per tick, so the timeline must too
+    # (stable, so same-tick ops keep their expand order, like the
+    # host loop's sequential set_loss calls)
+    for at, op, arg in sorted(ops, key=lambda x: x[0]):
+        if op == "loss":
+            loss_tl[at:] = arg
+        elif op == "partition":
+            p_tick.append(at)
+            p_gid.append(groups_to_gid(arg, n))
+        elif op == "heal":
+            p_tick.append(at)
+            p_gid.append(np.zeros(n, dtype=np.int32))
+        else:
+            ev_tick.append(at)
+            ev_kind.append(_KIND[op])
+            ev_node.append(arg)
+    boundaries = tuple(sorted({at for at, _, _ in ops if 0 < at < spec.ticks}))
+    return CompiledScenario(
+        ticks=spec.ticks,
+        n=n,
+        ev_tick=jnp.asarray(ev_tick, dtype=jnp.int32),
+        ev_kind=jnp.asarray(ev_kind, dtype=jnp.int32),
+        ev_node=jnp.asarray(ev_node, dtype=jnp.int32),
+        p_tick=jnp.asarray(p_tick, dtype=jnp.int32),
+        p_gid=jnp.asarray(
+            np.stack(p_gid) if p_gid else np.zeros((0, n), np.int32)
+        ),
+        loss=jnp.asarray(loss_tl),
+        has_revive=any(k == EV_REVIVE for k in ev_kind),
+        boundaries=boundaries,
+    )
+
+
+def key_schedule(
+    split: Callable[[], jax.Array], compiled: CompiledScenario
+) -> jax.Array:
+    """uint32[ticks, 2] per-tick step keys, segment-exact.
+
+    ``split`` is the cluster's key draw (``SimCluster._split``).  One
+    draw per segment between event boundaries; a length-1 segment uses
+    the draw directly and a length-k segment fans it with
+    ``jax.random.split(sub, k)`` — exactly what the host-side
+    ``tick(1)`` / ``tick(k)`` calls of the equivalent fault sequence
+    would consume, which is what makes the compiled run bit-identical
+    to the host loop (tested in tests/test_scenario.py).
+    """
+    pts = [0, *compiled.boundaries, compiled.ticks]
+    parts = []
+    for a, b in zip(pts, pts[1:]):
+        sub = split()
+        parts.append(sub[None] if b - a == 1 else jax.random.split(sub, b - a))
+    return jnp.concatenate(parts, axis=0)

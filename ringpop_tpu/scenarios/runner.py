@@ -1,0 +1,269 @@
+"""The one-dispatch scenario scan, and its host-loop twin.
+
+``run_compiled`` executes an entire compiled fault timeline —
+kill / revive / suspend / resume, partitions, loss schedule — plus the
+per-tick telemetry inside ONE jitted ``lax.scan`` per backend: the
+event tensors ride in HBM and each tick applies its events as masked
+out-of-bounds-dropped scatters before the protocol step, so a
+1000-tick chaos experiment costs one dispatch instead of a host
+round-trip per fault boundary (``cluster.py``'s tick/kill/partition
+sequence, which remains available as ``run_host_loop`` — the parity
+baseline and the benchmark's comparison arm).
+
+Event-application convention (shared with the host loop): all events
+of tick t apply before tick t's protocol period; node-bit edits first,
+then revives, then partition rows.  Conflicting same-tick events are
+rejected at spec validation.
+
+``dispatch_count()`` counts jitted scenario invocations — the CPU
+test asserts a whole kill+partition+heal+loss-ramp run increments it
+exactly once while dispatching no ``swim_step``/``swim_run`` at all.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.swim_delta import DeltaParams, DeltaState
+from ringpop_tpu.models.swim_sim import NetState, SwimParams
+from ringpop_tpu.scenarios.compile import (
+    EV_KILL,
+    EV_RESUME,
+    EV_REVIVE,
+    EV_SUSPEND,
+    CompiledScenario,
+    expand_events,
+)
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+_dispatches = 0
+
+
+def dispatch_count() -> int:
+    """Jitted scenario-scan invocations so far (test instrumentation)."""
+    return _dispatches
+
+
+def _normalize_adj(net: NetState, n: int) -> jax.Array:
+    """The scan carries the int32[N] group-id adjacency form (the only
+    form both backends compile).  ``adj=None`` and an all-True mask (a
+    healed mask-form partition — ``heal_partition`` keeps the mask
+    layout on purpose) are both fully connected and lower to the
+    all-one-group zeros; a genuine partial mask partition has no
+    group-id equivalent and is rejected."""
+    if net.adj is None:
+        return jnp.zeros((n,), jnp.int32)
+    if net.adj.ndim == 1:
+        return net.adj
+    if bool(np.asarray(net.adj).all()):
+        return jnp.zeros((n,), jnp.int32)
+    raise ValueError(
+        "scenario runs take the group-id adjacency form shared by both "
+        "backends; heal the dense bool[N, N] mask partition first"
+    )
+
+
+def precheck(state: Any, net: NetState, compiled: CompiledScenario) -> None:
+    """Every static rejection of ``run_compiled``, callable before any
+    PRNG key is drawn — a failed run must not advance the cluster key
+    (``SimCluster.run_scenario`` builds the key schedule only after
+    this passes)."""
+    if compiled.has_revive and isinstance(state, DeltaState):
+        raise NotImplementedError(
+            "in-scan revive is dense-backend-only (the delta backend's "
+            "revive/join are host-side row ops); use run_host_loop or "
+            "backend='dense'"
+        )
+    _normalize_adj(net, compiled.n)
+
+
+def _apply_revives(state, up, resp, m, ev_kind, ev_node):
+    """Dense-backend in-scan revive: the scan twin of
+    ``SimCluster.revive(i)`` — fresh incarnation past the cluster
+    maximum, row wipe, net bits up, bootstrap join against the first
+    live node (none live -> stays unjoined, like the host path).
+    Sequential over the (few) events: each revive's join reads the
+    state the previous one wrote."""
+    ids = jnp.arange(state.n, dtype=jnp.int32)
+
+    def one(i, carry):
+        def do(args):
+            st, u, r = args
+            node = ev_node[i]
+            inc = (jnp.max(st.view_key) >> 3) + 1000
+            st = sim.revive(st, node, inc)
+            u = u.at[node].set(True)
+            r = r.at[node].set(True)
+            own = jnp.diagonal(st.view_key) & 7
+            cand = (
+                u & r & ((own == sim.ALIVE) | (own == sim.SUSPECT)) & (ids != node)
+            )
+            joined = sim.admin_join(st, node, jnp.argmax(cand))
+            has_seed = jnp.any(cand)
+            st = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(has_seed, b, a), st, joined
+            )
+            return st, u, r
+
+        return jax.lax.cond(
+            m[i] & (ev_kind[i] == EV_REVIVE), do, lambda args: args, carry
+        )
+
+    return jax.lax.fori_loop(0, ev_node.shape[0], one, (state, up, resp))
+
+
+def _scenario_scan_impl(
+    state,
+    up,
+    responsive,
+    adj,
+    ev_tick,
+    ev_kind,
+    ev_node,
+    p_tick,
+    p_gid,
+    loss,
+    keys,
+    *,
+    params,
+    has_revive: bool,
+):
+    n = up.shape[0]
+    ticks = keys.shape[0]
+    is_delta = isinstance(state, DeltaState)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    oob = jnp.int32(n)  # masked events scatter out of bounds -> dropped
+
+    def body(carry, xs):
+        st, u, r, gid = carry
+        t, key, loss_t = xs
+        if ev_tick.shape[0]:
+            m = ev_tick == t
+            u = u.at[jnp.where(m & (ev_kind == EV_KILL), ev_node, oob)].set(
+                False, mode="drop"
+            )
+            r = r.at[jnp.where(m & (ev_kind == EV_SUSPEND), ev_node, oob)].set(
+                False, mode="drop"
+            )
+            r = r.at[jnp.where(m & (ev_kind == EV_RESUME), ev_node, oob)].set(
+                True, mode="drop"
+            )
+            if has_revive:
+                st, u, r = _apply_revives(st, u, r, m, ev_kind, ev_node)
+        if p_tick.shape[0]:
+            pm = p_tick == t
+            gid = jnp.where(jnp.any(pm), p_gid[jnp.argmax(pm)], gid)
+        net = NetState(up=u, responsive=r, adj=gid)
+        if is_delta:
+            sp = params._replace(swim=params.swim._replace(loss=loss_t))
+            st, metrics = sdelta.delta_step_impl(st, net, key, sp)
+            conv = sdelta._converged_impl(st, u, r)
+            own = sdelta.view_lookup(st, ids) & 7
+        else:
+            sp = params._replace(loss=loss_t)
+            st, metrics = sim.swim_step_impl(st, net, key, sp)
+            conv = sim.converged_impl(st, net)
+            own = jnp.diagonal(st.view_key) & 7
+        live = jnp.sum(
+            u & r & ((own == sim.ALIVE) | (own == sim.SUSPECT)),
+            dtype=jnp.int32,
+        )
+        y = dict(metrics)
+        y["converged"] = conv
+        y["live"] = live
+        y["loss"] = loss_t
+        return (st, u, r, gid), y
+
+    xs = (jnp.arange(ticks, dtype=jnp.int32), keys, loss)
+    (state, up, responsive, adj), ys = jax.lax.scan(
+        body, (state, up, responsive, adj), xs
+    )
+    return state, up, responsive, adj, ys
+
+
+_scenario_scan = jax.jit(
+    _scenario_scan_impl,
+    static_argnames=("params", "has_revive"),
+    donate_argnums=(0, 1, 2, 3),
+)
+
+
+def run_compiled(
+    state: Any,
+    net: NetState,
+    keys: jax.Array,
+    compiled: CompiledScenario,
+    params: SwimParams | DeltaParams,
+) -> tuple[Any, NetState, dict[str, jax.Array]]:
+    """One jitted call: (state, net, per-tick telemetry stacks [ticks]).
+
+    ``params`` is ``SwimParams`` for a dense ``ClusterState`` and
+    ``DeltaParams`` for a ``DeltaState``; its ``loss`` is overridden
+    per tick by the compiled schedule.  ``keys`` is the segment-exact
+    uint32[ticks, 2] schedule from ``compile.key_schedule``.
+    """
+    global _dispatches
+    if keys.shape[0] != compiled.ticks:
+        raise ValueError(
+            f"key schedule has {keys.shape[0]} rows for {compiled.ticks} ticks"
+        )
+    precheck(state, net, compiled)
+    adj = _normalize_adj(net, compiled.n)
+    _dispatches += 1
+    state, up, resp, adj, ys = _scenario_scan(
+        state,
+        net.up,
+        net.responsive,
+        adj,
+        compiled.ev_tick,
+        compiled.ev_kind,
+        compiled.ev_node,
+        compiled.p_tick,
+        compiled.p_gid,
+        compiled.loss,
+        keys,
+        params=params,
+        has_revive=compiled.has_revive,
+    )
+    return state, NetState(up=up, responsive=resp, adj=adj), ys
+
+
+def run_host_loop(cluster, spec: ScenarioSpec):
+    """The equivalent host-driven fault sequence, via the public
+    ``SimCluster`` surface: apply each tick's events, ``tick()`` the
+    segment to the next boundary.  Consumes the cluster key exactly as
+    ``compile.key_schedule`` does, so from equal starting state and
+    key the trajectory is bit-identical to ``run_compiled`` — the
+    parity oracle (tests/test_scenario.py) and the many-dispatch arm
+    of ``benchmarks/bench_scenario.py``."""
+    spec.validate(cluster.n)
+    by_tick: dict[int, list[tuple[str, Any]]] = defaultdict(list)
+    for at, op, arg in expand_events(spec, cluster.params.loss):
+        by_tick[at].append((op, arg))
+    boundaries = sorted(t for t in by_tick if 0 < t < spec.ticks)
+    pts = [0, *boundaries, spec.ticks]
+    for a, b in zip(pts, pts[1:]):
+        for op, arg in by_tick.get(a, ()):
+            if op == "kill":
+                cluster.kill(arg)
+            elif op == "suspend":
+                cluster.suspend(arg)
+            elif op == "resume":
+                cluster.resume(arg)
+            elif op == "revive":
+                cluster.revive(arg)
+            elif op == "partition":
+                cluster.partition([list(g) for g in arg])
+            elif op == "heal":
+                cluster.heal_partition()
+            elif op == "loss":
+                cluster.set_loss(arg)
+        cluster.tick(b - a)
+    return cluster

@@ -1,0 +1,262 @@
+"""Declarative scenario specs (JSON) + the ``--script`` DSL compiler.
+
+A scenario is a tick count plus a list of timed fault events.  Events
+apply at the START of their tick, before that tick's protocol period —
+the same convention as the host sequence ``apply fault; tick()``.
+
+JSON shape (``ScenarioSpec.from_json`` / ``to_json``)::
+
+    {
+      "ticks": 120,
+      "events": [
+        {"at": 10, "op": "kill",      "node": 3},
+        {"at": 12, "op": "suspend",   "node": 4},
+        {"at": 30, "op": "resume",    "node": 4},
+        {"at": 20, "op": "partition", "groups": [[0,1,2,3], [4,5,6,7]]},
+        {"at": 60, "op": "heal"},
+        {"at": 40, "op": "loss",      "p": 0.2},
+        {"at": 70, "op": "loss_ramp", "until": 90, "to": 0.0},
+        {"at": 95, "op": "revive",    "node": 3}
+      ]
+    }
+
+Ops:
+
+* ``kill`` / ``suspend`` / ``resume`` — the ``NetState.up`` /
+  ``responsive`` bit edits (tick-cluster.js:432-462 signal surface).
+* ``revive`` — a killed process restarts fresh with a higher
+  incarnation and re-joins against the first live node
+  (tick-cluster.js:418-430); dense backend only inside the scan (the
+  delta backend's join is a host-side row op — use the host loop).
+* ``partition`` — block netsplit in the group-id adjacency form;
+  ``groups`` must cover every node exactly once (the only form both
+  backends accept inside one compiled program).  ``heal`` restores
+  full connectivity.
+* ``loss`` — set the iid packet-loss probability from this tick on.
+* ``loss_ramp`` — stepwise-linear ramp from the loss in force at
+  ``at`` to ``to``, reaching ``to`` at tick ``until - 1`` (compiled
+  into one per-tick ``loss`` step per tick of the ramp).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, NamedTuple
+
+_NODE_OPS = ("kill", "revive", "suspend", "resume")
+_OPS = _NODE_OPS + ("partition", "heal", "loss", "loss_ramp")
+
+
+class Event(NamedTuple):
+    at: int
+    op: str
+    node: int | None = None
+    groups: tuple[tuple[int, ...], ...] | None = None
+    p: float | None = None
+    until: int | None = None  # loss_ramp end tick (exclusive)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"at": self.at, "op": self.op}
+        if self.node is not None:
+            d["node"] = self.node
+        if self.groups is not None:
+            d["groups"] = [list(g) for g in self.groups]
+        if self.p is not None:
+            d["p" if self.op == "loss" else "to"] = self.p
+        if self.until is not None:
+            d["until"] = self.until
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Event":
+        op = d.get("op")
+        if op not in _OPS:
+            raise ValueError(f"unknown scenario op {op!r} (one of {_OPS})")
+        groups = d.get("groups")
+        return cls(
+            at=int(d["at"]),
+            op=op,
+            node=int(d["node"]) if "node" in d else None,
+            groups=tuple(tuple(int(m) for m in g) for g in groups)
+            if groups is not None
+            else None,
+            p=float(d["p"]) if "p" in d else (
+                float(d["to"]) if "to" in d else None
+            ),
+            until=int(d["until"]) if "until" in d else None,
+        )
+
+
+class ScenarioSpec(NamedTuple):
+    ticks: int
+    events: tuple[Event, ...] = ()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"ticks": self.ticks, "events": [e.to_dict() for e in self.events]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ScenarioSpec":
+        return cls(
+            ticks=int(d["ticks"]),
+            events=tuple(Event.from_dict(e) for e in d.get("events", [])),
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "ScenarioSpec":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def load(cls, path: str) -> "ScenarioSpec":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")
+
+    def validate(self, n: int) -> "ScenarioSpec":
+        """Static validation against a cluster size; raises ValueError."""
+        if self.ticks < 1:
+            raise ValueError(f"ticks must be >= 1 (got {self.ticks})")
+        seen_node_tick: set[tuple[int, int]] = set()
+        seen_part_tick: set[int] = set()
+        node_event_ticks: set[int] = set()
+        revive_ticks: set[int] = set()
+        for e in self.events:
+            if not 0 <= e.at < self.ticks:
+                raise ValueError(
+                    f"event {e.op!r} at tick {e.at} outside [0, {self.ticks})"
+                )
+            if e.op in _NODE_OPS:
+                if e.node is None or not 0 <= e.node < n:
+                    raise ValueError(
+                        f"event {e.op!r} needs a node in [0, {n}) (got {e.node})"
+                    )
+                if (e.at, e.node) in seen_node_tick:
+                    raise ValueError(
+                        f"conflicting node events at tick {e.at} on node "
+                        f"{e.node}: apply order inside one tick is undefined"
+                    )
+                seen_node_tick.add((e.at, e.node))
+                if e.op == "revive":
+                    revive_ticks.add(e.at)
+                else:
+                    node_event_ticks.add(e.at)
+        # a revive's bootstrap join reads the live set, so same-tick
+        # kill/suspend/resume (any node) would make the outcome depend
+        # on intra-tick apply order — the scan applies bit edits before
+        # revives while the host oracle applies spec order; reject the
+        # ambiguity instead of silently breaking the parity contract
+        clash = revive_ticks & node_event_ticks
+        if clash:
+            raise ValueError(
+                f"revive shares tick {min(clash)} with another node event: "
+                "a revive's join reads the live set, so same-tick apply "
+                "order would be ambiguous — put the revive on its own tick"
+            )
+        for e in self.events:
+            if e.op == "partition":
+                if not e.groups:
+                    raise ValueError("partition event needs non-empty groups")
+                flat = [m for g in e.groups for m in g]
+                if sorted(flat) != list(range(n)):
+                    raise ValueError(
+                        "partition groups must cover every node exactly once "
+                        "(the group-id adjacency form both backends compile)"
+                    )
+            if e.op in ("partition", "heal"):
+                if e.at in seen_part_tick:
+                    raise ValueError(
+                        f"two partition/heal events at tick {e.at}: apply "
+                        "order inside one tick is undefined"
+                    )
+                seen_part_tick.add(e.at)
+            if e.op == "loss" and not (e.p is not None and 0.0 <= e.p < 1.0):
+                raise ValueError(f"loss event needs p in [0, 1) (got {e.p})")
+            if e.op == "loss_ramp":
+                if e.p is None or not 0.0 <= e.p < 1.0:
+                    raise ValueError(f"loss_ramp needs 'to' in [0, 1) (got {e.p})")
+                if e.until is None or not e.at < e.until <= self.ticks:
+                    raise ValueError(
+                        f"loss_ramp needs at < until <= ticks "
+                        f"(got at={e.at}, until={e.until})"
+                    )
+        return self
+
+
+def script_to_spec(
+    script: str, n: int, *, period_ms: int = 200
+) -> ScenarioSpec:
+    """Compile a ``tick-cluster --script`` command list into a spec.
+
+    The mini-DSL is linear in wall/virtual time; the compiler replays it
+    against a host-side liveness model to resolve the relative targets
+    (``k`` kills the highest-indexed not-yet-killed node, ``K`` revives
+    the oldest kill, ``l``/``L`` suspend/resume — the TpuSimCluster
+    driver's selection rule, minus protocol-state gating the compiler
+    cannot know).  ``t`` is one tick; ``wN`` is ``max(1, N // period_ms)``
+    ticks; reporting commands (``j g s p d D``) carry no protocol effect
+    and compile to nothing; ``q`` ends the scenario.
+
+    The live driver applies back-to-back commands instantly; the
+    compiled form needs a defined per-tick order, so a command that
+    would collide with an earlier same-tick event (same node twice, or
+    a revive mixing with other node events — the combinations
+    ``ScenarioSpec.validate`` rejects) is placed one tick later,
+    advancing the clock for everything after it (``k,K`` compiles to
+    kill at t, revive at t+1).
+    """
+    events: list[Event] = []
+    tick = 0
+    killed: list[int] = []
+    suspended: list[int] = []
+    node_ticks: set[tuple[int, int]] = set()
+    tick_kinds: dict[int, set[str]] = {}
+
+    def place(op: str, node: int) -> None:
+        nonlocal tick
+        kind = "revive" if op == "revive" else "other"
+        other = "other" if kind == "revive" else "revive"
+        while (tick, node) in node_ticks or other in tick_kinds.get(tick, ()):
+            tick += 1
+        events.append(Event(at=tick, op=op, node=node))
+        node_ticks.add((tick, node))
+        tick_kinds.setdefault(tick, set()).add(kind)
+
+    for op in script.split(","):
+        op = op.strip()
+        if not op:
+            continue
+        if op == "q":
+            break
+        if op[0] == "w":
+            tick += max(1, int(float(op[1:]) / period_ms))
+        elif op == "t":
+            tick += 1
+        elif op == "k":
+            live = [i for i in range(n) if i not in killed and i not in suspended]
+            if live:
+                place("kill", live[-1])
+                killed.append(live[-1])
+        elif op == "K":
+            if killed:
+                place("revive", killed.pop(0))
+        elif op == "l":
+            live = [i for i in range(n) if i not in killed and i not in suspended]
+            if live:
+                place("suspend", live[-1])
+                suspended.append(live[-1])
+        elif op == "L":
+            for node in suspended:
+                place("resume", node)
+            suspended.clear()
+        elif op in ("j", "g", "s", "p", "d", "D"):
+            pass  # reporting / no protocol effect in the compiled form
+        else:
+            raise ValueError(f"unknown script command {op!r}")
+    # trailing events need a tick to act in; a bare fault list gets one
+    ticks = max(tick, max((e.at for e in events), default=0) + 1, 1)
+    return ScenarioSpec(ticks=ticks, events=tuple(events)).validate(n)

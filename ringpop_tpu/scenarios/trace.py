@@ -1,0 +1,157 @@
+"""Per-tick telemetry time series stacked by the scenario scan.
+
+A ``Trace`` is the cure for ``swim_run`` discarding everything but the
+last tick's metrics: one row per tick of every protocol counter, plus
+the converged flag, the live-node count, and the loss in force.  It
+round-trips through ``.npz`` (self-describing: the spec rides along)
+and summarizes in the same key shape as ``stats.Histogram.print_obj``
+(count/min/max/sum/mean/median/p75/p95/p99), so existing stat
+consumers can read a scenario the way they read a meter dump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from ringpop_tpu.stats import Histogram
+
+FORMAT_VERSION = 1
+
+# arrays every trace must carry (schema_valid contract)
+_REQUIRED = ("converged", "live", "loss")
+
+
+class Trace:
+    """Stacked per-tick telemetry of one scenario run."""
+
+    def __init__(
+        self,
+        *,
+        metrics: dict[str, np.ndarray],
+        converged: np.ndarray,
+        live: np.ndarray,
+        loss: np.ndarray,
+        n: int,
+        backend: str,
+        start_tick: int = 0,
+        spec: dict[str, Any] | None = None,
+    ):
+        self.metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        self.converged = np.asarray(converged, dtype=bool)
+        self.live = np.asarray(live, dtype=np.int32)
+        self.loss = np.asarray(loss, dtype=np.float32)
+        self.n = int(n)
+        self.backend = str(backend)
+        self.start_tick = int(start_tick)
+        self.spec = spec
+
+    @property
+    def ticks(self) -> int:
+        return int(self.converged.shape[0])
+
+    def first_converged_tick(self) -> int:
+        """0-based tick index of the first converged sample, or -1."""
+        hits = np.flatnonzero(self.converged)
+        return int(hits[0]) if hits.size else -1
+
+    def validate(self) -> "Trace":
+        """Schema check: every series is 1-D with one row per tick."""
+        t = self.ticks
+        if t < 1:
+            raise ValueError("trace has no ticks")
+        for name in _REQUIRED:
+            arr = getattr(self, name)
+            if arr.ndim != 1 or arr.shape[0] != t:
+                raise ValueError(f"trace series {name!r} is not [{t}]-shaped")
+        for name, arr in self.metrics.items():
+            if arr.ndim != 1 or arr.shape[0] != t:
+                raise ValueError(f"trace metric {name!r} is not [{t}]-shaped")
+        if not np.all((self.live >= 0) & (self.live <= self.n)):
+            raise ValueError("trace live counts outside [0, n]")
+        return self
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """Per-series stats in ``stats.Histogram.print_obj`` key shape."""
+        out: dict[str, dict[str, float]] = {}
+        series: dict[str, np.ndarray] = {
+            **self.metrics,
+            "live": self.live,
+            "loss": self.loss,
+        }
+        for name, arr in series.items():
+            # sample_size >= ticks: the reservoir holds every value, so
+            # the percentiles are exact, not sampled
+            hist = Histogram(sample_size=max(len(arr), 1))
+            for v in arr:
+                hist.update(float(v))
+            out[name] = hist.print_obj()
+        out["converged"] = {
+            "count": self.ticks,
+            "sum": int(self.converged.sum()),
+            "final": bool(self.converged[-1]),
+            "first_tick": self.first_converged_tick(),
+        }
+        return out
+
+    # -- npz round trip (shared with checkpoint.py via the dict forms) ------
+
+    def to_arrays(self, prefix: str = "") -> dict[str, np.ndarray]:
+        arrays = {
+            f"{prefix}converged": self.converged,
+            f"{prefix}live": self.live,
+            f"{prefix}loss": self.loss,
+        }
+        for name, arr in self.metrics.items():
+            arrays[f"{prefix}m.{name}"] = arr
+        return arrays
+
+    def meta(self) -> dict[str, Any]:
+        return {
+            "version": FORMAT_VERSION,
+            "n": self.n,
+            "backend": self.backend,
+            "start_tick": self.start_tick,
+            "spec": self.spec,
+        }
+
+    @classmethod
+    def from_arrays(
+        cls, data: Any, meta: dict[str, Any], prefix: str = ""
+    ) -> "Trace":
+        metrics = {
+            key[len(prefix) + 2:]: np.asarray(data[key])
+            for key in getattr(data, "files", data.keys())
+            if key.startswith(f"{prefix}m.")
+        }
+        return cls(
+            metrics=metrics,
+            converged=np.asarray(data[f"{prefix}converged"]),
+            live=np.asarray(data[f"{prefix}live"]),
+            loss=np.asarray(data[f"{prefix}loss"]),
+            n=meta["n"],
+            backend=meta["backend"],
+            start_tick=meta.get("start_tick", 0),
+            spec=meta.get("spec"),
+        )
+
+    def save(self, path: str) -> None:
+        arrays = self.to_arrays()
+        arrays["meta"] = np.frombuffer(
+            json.dumps(self.meta()).encode(), dtype=np.uint8
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)  # atomic, like checkpoint.save
+
+    @classmethod
+    def load(cls, path: str) -> "Trace":
+        with np.load(path, allow_pickle=False) as data:
+            meta = json.loads(bytes(data["meta"]).decode())
+            if meta["version"] != FORMAT_VERSION:
+                raise ValueError(f"unsupported trace version {meta['version']}")
+            return cls.from_arrays(data, meta)

@@ -89,6 +89,68 @@ def test_delta_backend_roundtrip_and_resume(tmp_path):
     assert cluster.checksums() == resumed.checksums()
 
 
+def test_roundtrip_telemetry(tmp_path):
+    """v4 checkpoints carry the telemetry: metrics_log entries (with
+    their tick spans) and scenario traces resume with the run instead
+    of restarting blind."""
+    from ringpop_tpu.scenarios.trace import Trace
+
+    cluster = SimCluster(8, sim.SwimParams(), seed=5)
+    cluster.tick(2)
+    cluster.tick()
+    cluster.traces.append(
+        Trace(
+            metrics={"pings_sent": np.arange(4, dtype=np.int32)},
+            converged=np.array([True, False, False, True]),
+            live=np.array([8, 7, 7, 7], np.int32),
+            loss=np.zeros(4, np.float32),
+            n=8,
+            backend="dense",
+            start_tick=3,
+            spec={"ticks": 4, "events": []},
+        )
+    )
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(cluster, path)
+    restored = checkpoint.load(path)
+    assert restored.metrics_log == cluster.metrics_log
+    assert restored.metrics_log[0]["ticks"] == 2
+    assert restored.metrics_log[1]["ticks"] == 1
+    assert len(restored.traces) == 1
+    back = restored.traces[0].validate()
+    assert back.backend == "dense" and back.start_tick == 3
+    assert back.spec == {"ticks": 4, "events": []}
+    np.testing.assert_array_equal(
+        back.metrics["pings_sent"], cluster.traces[0].metrics["pings_sent"]
+    )
+    np.testing.assert_array_equal(back.converged, cluster.traces[0].converged)
+
+
+def test_load_backfills_pretelemetry_checkpoint(tmp_path):
+    """Checkpoints written before v4 (no metrics_log/traces in meta)
+    must load with empty telemetry — the backfill default, mirroring
+    the delta carried-derivative pattern below."""
+    import json
+
+    cluster = SimCluster(8, sim.SwimParams(), seed=5)
+    cluster.tick(2)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(cluster, path)
+
+    data = dict(np.load(path, allow_pickle=False))
+    meta = json.loads(bytes(data["meta"]).decode())
+    del meta["metrics_log"], meta["traces"]
+    meta["version"] = 3
+    data["meta"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    old_path = str(tmp_path / "old.npz")
+    np.savez_compressed(old_path, **data)
+
+    restored = checkpoint.load(old_path)
+    assert restored.metrics_log == []
+    assert restored.traces == []
+    restored.tick(2)  # still resumes
+
+
 def test_load_backfills_predigest_delta_checkpoint(tmp_path):
     """A v3 delta checkpoint written BEFORE the carried derivatives
     existed (no state.digest / state.d_bpmask keys in the .npz) must

@@ -1,0 +1,497 @@
+"""Scenario engine: compiled fault timelines, one dispatch, per-tick
+telemetry, and bit-parity with the host-driven fault sequence
+(the netsplit scripting the reference stubbed out,
+test/lib/partition-cluster.js:59-61, finished and exceeded).
+
+Fast lane: the spec/compiler/trace host logic plus ONE minimal
+compiled run asserting the single-dispatch contract.  The full
+acceptance grid — kill+partition+heal+loss-ramp parity against the
+host loop, dense-vs-delta backend parity, the seeded golden trace,
+in-scan revive — compiles several full-step scan programs on CPU and
+rides the slow lane with the other parity soaks (module-scoped
+fixtures pay each compile once).  tools/scenario.sh drives the CLI
+end-to-end as the CI smoke.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios import runner
+from ringpop_tpu.scenarios.spec import Event, ScenarioSpec, script_to_spec
+from ringpop_tpu.scenarios.trace import Trace
+from ringpop_tpu.stats import Histogram
+
+FAST = sim.SwimParams(suspicion_ticks=8)
+N = 12
+TICKS = 40
+# The acceptance scenario: kill + partition + heal + loss step/ramp.
+SPEC = ScenarioSpec.from_dict(
+    {
+        "ticks": TICKS,
+        "events": [
+            {"at": 5, "op": "kill", "node": 3},
+            {"at": 10, "op": "partition",
+             "groups": [list(range(6)), list(range(6, 12))]},
+            {"at": 10, "op": "loss", "p": 0.08},
+            {"at": 20, "op": "heal"},
+            {"at": 25, "op": "loss_ramp", "until": 30, "to": 0.0},
+        ],
+    }
+)
+
+
+def _states_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(a, b)
+        if x is not None
+    )
+
+
+# -- fast: the single-dispatch contract on a minimal compiled run -----------
+
+
+def test_single_dispatch_smoke(monkeypatch):
+    """A compiled scenario is ONE jitted call: no swim_step / swim_run /
+    delta_step dispatch at all, the scenario counter advances once, and
+    the trace carries every tick (the per-tick series swim_run drops)."""
+
+    def boom(*a, **k):  # pragma: no cover - would mean a host round-trip
+        raise AssertionError("host-loop dispatch inside run_scenario")
+
+    monkeypatch.setattr(sim, "swim_step", boom)
+    monkeypatch.setattr(sim, "swim_run", boom)
+    monkeypatch.setattr(sdelta, "delta_step", boom)
+    monkeypatch.setattr(sdelta, "delta_run", boom)
+    before = runner.dispatch_count()
+    c = SimCluster(6, sim.SwimParams(suspicion_ticks=5), seed=1)
+    trace = c.run_scenario(
+        {"ticks": 4, "events": [{"at": 1, "op": "kill", "node": 5}]}
+    )
+    assert runner.dispatch_count() - before == 1
+    assert trace.ticks == 4
+    assert trace.live.tolist() == [6, 5, 5, 5]  # kill lands at tick 1
+    assert all(arr.shape == (4,) for arr in trace.metrics.values())
+    # run_scenario logs one aggregated entry spanning the whole run
+    assert c.metrics_log[-1]["ticks"] == 4
+    assert c.traces == [trace]
+
+
+def test_metrics_log_records_tick_span():
+    # same (n, params) as test_sim_core's metrics test: cache-warm
+    c = SimCluster(6, sim.SwimParams(suspicion_ticks=5), seed=10)
+    m = c.tick()
+    assert m["ticks"] == 1
+    assert c.metrics_log[0]["ticks"] == 1
+
+
+# -- fast: spec + compiler (host-only) --------------------------------------
+
+
+def test_spec_json_roundtrip(tmp_path):
+    path = str(tmp_path / "spec.json")
+    SPEC.save(path)
+    assert ScenarioSpec.load(path) == SPEC
+
+
+def test_spec_validation_errors():
+    with pytest.raises(ValueError, match="unknown scenario op"):
+        Event.from_dict({"at": 0, "op": "explode"})
+    with pytest.raises(ValueError, match="outside"):
+        ScenarioSpec(ticks=5, events=(Event(at=5, op="kill", node=0),)).validate(4)
+    with pytest.raises(ValueError, match="cover every node"):
+        ScenarioSpec(
+            ticks=5, events=(Event(at=0, op="partition", groups=((0, 1),)),)
+        ).validate(4)
+    with pytest.raises(ValueError, match="conflicting node events"):
+        ScenarioSpec(
+            ticks=5,
+            events=(
+                Event(at=1, op="kill", node=2),
+                Event(at=1, op="revive", node=2),
+            ),
+        ).validate(4)
+    with pytest.raises(ValueError, match="loss_ramp needs at < until"):
+        ScenarioSpec(
+            ticks=5, events=(Event(at=3, op="loss_ramp", p=0.1, until=2),)
+        ).validate(4)
+    # a revive's join reads the live set: same-tick bit edits on OTHER
+    # nodes would make the seed choice order-dependent (scan applies
+    # bit edits first, the host oracle applies spec order)
+    with pytest.raises(ValueError, match="revive shares tick"):
+        ScenarioSpec(
+            ticks=5,
+            events=(
+                Event(at=1, op="revive", node=2),
+                Event(at=1, op="kill", node=0),
+            ),
+        ).validate(4)
+
+
+def test_compile_loss_schedule_and_boundaries():
+    compiled = scompile.compile_spec(SPEC, N, base_loss=0.0)
+    loss = np.asarray(compiled.loss)
+    assert loss.shape == (TICKS,)
+    assert loss[9] == 0.0 and loss[10] == np.float32(0.08)
+    # stepwise-linear ramp reaches the target at until-1 and holds
+    assert loss[29] == 0.0 and loss[39] == 0.0
+    assert 0.0 < loss[26] < 0.08
+    # every event tick is a key-schedule segment boundary (ramp ticks too)
+    assert compiled.boundaries == (5, 10, 20, 25, 26, 27, 28, 29)
+    assert not compiled.has_revive
+    assert compiled.p_gid.shape == (2, N)  # partition + heal rows
+    assert np.asarray(compiled.p_gid[1]).max() == 0  # heal = one group
+
+
+def test_compile_ramp_interleaved_with_loss_event():
+    """A loss event INSIDE a ramp's span must override only its own
+    tick onward until the next ramp step — the timeline is written in
+    tick order (matching the host loop's per-tick set_loss calls),
+    not event order."""
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": 10,
+            "events": [
+                {"at": 2, "op": "loss_ramp", "until": 8, "to": 0.6},
+                {"at": 5, "op": "loss", "p": 0.1},
+            ],
+        }
+    )
+    loss = np.asarray(scompile.compile_spec(spec, 4).loss)
+    assert loss[5] == np.float32(0.1)  # the event wins its own tick
+    assert loss[6] == np.float32(0.5)  # ...but the ramp resumes after
+    assert loss[7] == np.float32(0.6)
+    assert loss[9] == np.float32(0.6)
+
+
+def test_key_schedule_is_segment_exact():
+    """One cluster-key draw per segment, fanned per tick — byte-equal
+    to what the host tick(1)/tick(k) calls of the same fault sequence
+    consume (the basis of the scan/host-loop bit parity)."""
+    import jax
+
+    compiled = scompile.compile_spec(SPEC, N, base_loss=0.0)
+    key = jax.random.PRNGKey(9)
+
+    class Split:
+        def __init__(self, key):
+            self.key = key
+
+        def __call__(self):
+            self.key, sub = jax.random.split(self.key)
+            return sub
+
+    keys = scompile.key_schedule(Split(key), compiled)
+    assert keys.shape == (TICKS, 2)
+    # replay by hand: segment [0, 5) is one draw fanned into 5
+    k2, sub = jax.random.split(key)
+    np.testing.assert_array_equal(
+        np.asarray(keys[:5]), np.asarray(jax.random.split(sub, 5))
+    )
+    # ...and the length-1 ramp segment [25, 26) is a bare draw
+    s = Split(key)
+    for _ in range(4):
+        s()
+    np.testing.assert_array_equal(np.asarray(keys[25]), np.asarray(s()))
+
+
+def test_script_to_spec():
+    spec = script_to_spec("j,w1000,t,k,t,l,t,L,K,w2000,t,q", 5)
+    kills = [e for e in spec.events if e.op == "kill"]
+    revives = [e for e in spec.events if e.op == "revive"]
+    suspends = [e for e in spec.events if e.op == "suspend"]
+    resumes = [e for e in spec.events if e.op == "resume"]
+    assert [e.node for e in kills] == [4]  # highest live index
+    assert [e.node for e in suspends] == [3]  # next-highest after the kill
+    assert [e.node for e in resumes] == [3]
+    assert [e.node for e in revives] == [4]
+    assert kills[0].at == 6  # after w1000 (5 ticks @200ms) + t
+    # the revive follows the same-tick resume, so it bumps one tick
+    assert resumes[0].at == 8 and revives[0].at == 9
+    assert spec.ticks == 9 + 10 + 1  # ...then w2000,t from the bumped clock
+    spec.validate(5)
+
+
+def test_script_to_spec_bumps_sametick_conflicts():
+    """'k,K' with no intervening tick is legal in the live driver
+    (instant apply) but needs an order in the compiled form: the
+    revive lands one tick after the kill."""
+    spec = script_to_spec("k,K,t,q", 4)
+    assert spec.events == (
+        Event(at=0, op="kill", node=3),
+        Event(at=1, op="revive", node=3),
+    )
+    spec.validate(4)
+    assert script_to_spec("l,L,t,q", 4).events == (
+        Event(at=0, op="suspend", node=3),
+        Event(at=1, op="resume", node=3),
+    )
+
+
+def test_script_to_spec_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown script command"):
+        script_to_spec("j,x", 4)
+
+
+def test_cli_script_to_scenario(tmp_path, capsys):
+    from ringpop_tpu.cli.tick_cluster import main
+
+    out = str(tmp_path / "spec.json")
+    main(["--script", "t,k,w1000,t,q", "-n", "6", "--script-to-scenario", out])
+    spec = ScenarioSpec.load(out)
+    assert spec.events == (Event(at=1, op="kill", node=5),)
+    assert "compiled 1 events" in capsys.readouterr().out
+
+
+# -- fast: trace object (synthetic series; no compile) ----------------------
+
+
+def _synthetic_trace(t: int = 5) -> Trace:
+    return Trace(
+        metrics={"pings_sent": np.arange(t, dtype=np.int32)},
+        converged=np.array([False] * (t - 1) + [True]),
+        live=np.full(t, 7, np.int32),
+        loss=np.zeros(t, np.float32),
+        n=8,
+        backend="dense",
+        start_tick=3,
+        spec={"ticks": t, "events": []},
+    )
+
+
+def test_trace_npz_roundtrip(tmp_path):
+    trace = _synthetic_trace()
+    path = str(tmp_path / "trace.npz")
+    trace.save(path)
+    back = Trace.load(path).validate()
+    assert back.ticks == trace.ticks
+    assert back.backend == "dense" and back.n == 8 and back.start_tick == 3
+    assert back.spec == trace.spec
+    np.testing.assert_array_equal(back.converged, trace.converged)
+    np.testing.assert_array_equal(back.live, trace.live)
+    np.testing.assert_array_equal(back.loss, trace.loss)
+    np.testing.assert_array_equal(
+        back.metrics["pings_sent"], trace.metrics["pings_sent"]
+    )
+
+
+def test_trace_summary_is_stats_key_compatible():
+    """Trace.summary() speaks the stats.Histogram.print_obj key shape,
+    so stat consumers read a scenario like a meter dump."""
+    trace = _synthetic_trace()
+    summary = trace.summary()
+    hist_keys = set(Histogram().print_obj().keys())
+    for name in ("pings_sent", "live", "loss"):
+        assert set(summary[name].keys()) == hist_keys, name
+    assert summary["pings_sent"]["sum"] == 0 + 1 + 2 + 3 + 4
+    assert summary["live"]["min"] == 7.0
+    assert summary["converged"]["final"] is True
+    assert summary["converged"]["first_tick"] == 4
+
+
+def test_trace_validate_rejects_ragged():
+    trace = _synthetic_trace()
+    trace.metrics["pings_sent"] = np.zeros(3, np.int32)
+    with pytest.raises(ValueError, match="not .*-shaped"):
+        trace.validate()
+
+
+def test_revive_rejected_on_delta_backend_without_key_burn():
+    spec = ScenarioSpec(ticks=4, events=(Event(at=1, op="revive", node=0),))
+    c = SimCluster(8, FAST, seed=0, backend="delta", capacity=8)
+    key_before = np.asarray(c.key).copy()
+    with pytest.raises(NotImplementedError, match="dense-backend-only"):
+        c.run_scenario(spec)
+    # the rejection fires BEFORE the key schedule draws: a failed call
+    # must not silently desynchronize the cluster PRNG
+    np.testing.assert_array_equal(np.asarray(c.key), key_before)
+
+
+def test_scenario_accepts_healed_mask_partition():
+    """A partial (mask-form) partition that was healed leaves an
+    all-True bool[N, N] adj — semantically fully connected, so the
+    scenario path lowers it to the group-id form instead of refusing;
+    a genuine partial mask still raises."""
+    c = SimCluster(6, sim.SwimParams(suspicion_ticks=5), seed=1)
+    c.partition([[0, 1], [2, 3]])  # partial grouping -> mask form
+    with pytest.raises(ValueError, match="group-id adjacency"):
+        c.run_scenario({"ticks": 4, "events": []})
+    c.heal_partition()  # keeps the mask layout (all ones) on purpose
+    trace = c.run_scenario(
+        {"ticks": 4, "events": [{"at": 1, "op": "kill", "node": 5}]}
+    )
+    assert trace.live.tolist() == [6, 5, 5, 5]
+    assert c.net.adj.ndim == 1  # lowered to the scan's gid form
+
+
+# -- slow: the acceptance grid (full-step scan compiles) --------------------
+
+
+@pytest.fixture(scope="module")
+def dense_run():
+    before = runner.dispatch_count()
+    c = SimCluster(N, FAST, seed=3)
+    trace = c.run_scenario(SPEC)
+    # the acceptance scenario is ONE dispatch on this backend too
+    assert runner.dispatch_count() - before == 1
+    return c, trace
+
+
+@pytest.fixture(scope="module")
+def host_run():
+    c = SimCluster(N, FAST, seed=3)
+    runner.run_host_loop(c, SPEC)
+    return c
+
+
+@pytest.fixture(scope="module")
+def delta_run():
+    # ample caps for a netsplit scenario (test_swim_delta convention:
+    # the post-heal claim burst needs claim_grid = 3 * n * n)
+    before = runner.dispatch_count()
+    c = SimCluster(
+        N, FAST, seed=3, backend="delta",
+        capacity=N, wire_cap=N, claim_grid=3 * N * N,
+    )
+    trace = c.run_scenario(SPEC)
+    assert runner.dispatch_count() - before == 1
+    return c, trace
+
+
+@pytest.mark.slow
+def test_scan_matches_host_sequence(dense_run, host_run):
+    """Bit-parity: the compiled one-call run equals the equivalent
+    host-side kill()/partition()/tick() sequence — state, net, and
+    reference-format checksums (the acceptance criterion)."""
+    c, _ = dense_run
+    h = host_run
+    assert _states_equal(c.state, h.state)
+    assert np.array_equal(np.asarray(c.net.up), np.asarray(h.net.up))
+    assert np.array_equal(
+        np.asarray(c.net.responsive), np.asarray(h.net.responsive)
+    )
+    assert c.checksums() == h.checksums()
+    assert c.params.loss == h.params.loss
+
+
+@pytest.mark.slow
+def test_backend_parity(dense_run, delta_run):
+    """The same spec on dense vs delta: identical per-tick converged /
+    live series and final checksums (ample delta caps => bit parity)."""
+    cd, td = dense_run
+    cl, tl = delta_run
+    np.testing.assert_array_equal(td.converged, tl.converged)
+    np.testing.assert_array_equal(td.live, tl.live)
+    np.testing.assert_array_equal(td.loss, tl.loss)
+    assert cd.checksums() == cl.checksums()
+
+
+@pytest.mark.slow
+def test_scenario_telemetry_content(dense_run):
+    _, trace = dense_run
+    # the kill drops one node from the live count at tick 5
+    assert int(trace.live[4]) == N
+    assert int(trace.live[5]) == N - 1
+    # the loss schedule: base 0 -> step 0.08 -> ramp back to 0
+    assert trace.loss[0] == 0.0
+    assert trace.loss[10] == np.float32(0.08)
+    assert trace.loss[29] == 0.0
+    # the partition + kill disrupt convergence; the run re-converges
+    assert not trace.converged[12]
+    assert trace.converged[-1]
+
+
+@pytest.mark.slow
+def test_golden_trace_stability(dense_run):
+    """Seeded golden trace: the exact telemetry of the canonical spec
+    at seed 3 (CPU, threefry).  A diff here means the protocol step,
+    the event application, or the key schedule changed behavior."""
+    _, trace = dense_run
+    assert int(trace.metrics["pings_sent"].sum()) == 445
+    assert int(trace.metrics["suspects_declared"].sum()) == 54
+    assert int(trace.metrics["faulty_declared"].sum()) == 26
+    assert trace.first_converged_tick() == 0  # starts converged
+    assert int(trace.converged.sum()) == 22
+    assert int(trace.live[-1]) == 11
+
+
+@pytest.mark.slow
+def test_revive_in_scan_matches_host():
+    """kill -> revive inside ONE compiled call equals the host
+    kill()/tick()/revive()/tick() sequence (fresh incarnation, wipe,
+    bootstrap join against the first live node)."""
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": 30,
+            "events": [
+                {"at": 2, "op": "kill", "node": 5},
+                {"at": 15, "op": "revive", "node": 5},
+            ],
+        }
+    )
+    a = SimCluster(10, FAST, seed=7)
+    trace = a.run_scenario(spec)
+    b = SimCluster(10, FAST, seed=7)
+    runner.run_host_loop(b, spec)
+    assert _states_equal(a.state, b.state)
+    assert a.checksums() == b.checksums()
+    assert int(trace.live[-1]) == 10  # the revived node is back
+
+
+@pytest.mark.slow
+def test_suspend_resume_in_scan():
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": 6,
+            "events": [
+                {"at": 1, "op": "suspend", "node": 2},
+                {"at": 4, "op": "resume", "node": 2},
+            ],
+        }
+    )
+    c = SimCluster(6, FAST, seed=2)
+    trace = c.run_scenario(spec)
+    assert int(trace.live[1]) == 5  # suspended drops out of the live set
+    assert int(trace.live[-1]) == 6  # resume restores it
+    assert bool(np.asarray(c.net.responsive)[2])
+
+
+@pytest.mark.slow
+def test_live_trace_npz_roundtrip(dense_run, tmp_path):
+    _, trace = dense_run
+    path = str(tmp_path / "trace.npz")
+    trace.save(path)
+    back = Trace.load(path).validate()
+    assert back.spec == SPEC.to_dict()
+    np.testing.assert_array_equal(back.converged, trace.converged)
+    for k in trace.metrics:
+        np.testing.assert_array_equal(back.metrics[k], trace.metrics[k])
+
+
+@pytest.mark.slow
+def test_cli_scenario_end_to_end(tmp_path, capsys):
+    """tick-cluster --backend tpu-sim --scenario FILE: one-dispatch
+    run + npz trace export (the CI smoke job drives the same path via
+    tools/scenario.sh)."""
+    from ringpop_tpu.cli.tick_cluster import main
+
+    spec_path = str(tmp_path / "spec.json")
+    trace_path = str(tmp_path / "trace.npz")
+    ScenarioSpec.from_dict(
+        {"ticks": 10, "events": [{"at": 2, "op": "kill", "node": 3}]}
+    ).save(spec_path)
+    main([
+        "--backend", "tpu-sim", "-n", "8",
+        "--scenario", spec_path, "--trace-out", trace_path,
+    ])
+    out = capsys.readouterr().out
+    assert "one dispatch" in out
+    trace = Trace.load(trace_path).validate()
+    assert trace.ticks == 10
+    assert int(trace.live[-1]) == 7

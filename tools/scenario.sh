@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# CPU scenario smoke: a small kill+partition+heal+loss-ramp chaos
+# scenario must run as one compiled dispatch via the tick-cluster CLI,
+# converge, and emit a schema-valid per-tick trace.  This is the CI
+# smoke job's body (see .github/workflows/ci.yml); run it locally the
+# same way:  tools/scenario.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/ringpop-scenario.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+spec="$workdir/spec.json"
+trace="$workdir/trace.npz"
+
+cat > "$spec" <<'EOF'
+{
+  "ticks": 60,
+  "events": [
+    {"at": 5,  "op": "kill", "node": 3},
+    {"at": 10, "op": "partition", "groups": [[0,1,2,3,4,5,6,7],
+                                             [8,9,10,11,12,13,14,15]]},
+    {"at": 10, "op": "loss", "p": 0.05},
+    {"at": 25, "op": "heal"},
+    {"at": 30, "op": "loss_ramp", "until": 40, "to": 0.0}
+  ]
+}
+EOF
+
+JAX_PLATFORMS=cpu timeout -k 10 600 python -m ringpop_tpu tick-cluster \
+  --backend tpu-sim -n 16 --scenario "$spec" --trace-out "$trace" \
+  | tee "$workdir/out.log"
+
+grep -q "one dispatch" "$workdir/out.log"
+
+JAX_PLATFORMS=cpu python - "$trace" <<'EOF'
+import sys
+from ringpop_tpu.scenarios.trace import Trace
+
+trace = Trace.load(sys.argv[1]).validate()
+assert trace.ticks == 60, trace.ticks
+assert trace.converged[-1], "scenario did not converge"
+assert int(trace.live[-1]) == 15, int(trace.live[-1])
+assert trace.loss[-1] == 0.0
+assert "pings_sent" in trace.metrics
+print("scenario smoke OK: converged, trace schema valid")
+EOF
