@@ -360,6 +360,17 @@ def _probe_tpu() -> str | None:
     return f"accelerator probe failed (rc={rc}): {tail[0][:300]}"
 
 
+def _is_worker_crash(err: str | None) -> bool:
+    """The round-5 failure signature, anchored to the TPU runtime's own
+    error text ("UNAVAILABLE: TPU worker process crashed or restarted")
+    instead of bare substring matches over all of stderr — an unrelated
+    log line containing "crashed" or an "UNAVAILABLE" from some other
+    RPC must not abandon the delta climb and the dense safety net
+    (ADVICE round 5)."""
+    text = err or ""
+    return "UNAVAILABLE: TPU worker" in text or "worker process crashed" in text
+
+
 def _echo_child_stderr(err: str | None) -> None:
     """Surface the measuring child's diagnostics (occupancy, on-chip
     kernel checks, per-rep rates) in the parent's stderr, uniformly
@@ -434,7 +445,7 @@ def main() -> None:
             tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
             errors.append(f"tpu bench {layout} n={n} {reason}: {tail[0][:160]}")
             print(f"# {errors[-1]}", file=sys.stderr, flush=True)
-            crash = "UNAVAILABLE" in (err or "") or "crashed" in (err or "")
+            crash = _is_worker_crash(err)
             if crash:
                 # The round-5 failure mode: the program killed the TPU
                 # worker; further children would hang on init for the
@@ -470,10 +481,14 @@ def main() -> None:
                     break
                 print("# tunnel re-probe ok; trying the next size",
                       file=sys.stderr, flush=True)
-        if best_pass is None and fallback is None and not tunnel_dead:
-            # no delta rung produced anything but the tunnel still
-            # answers — dense safety net, descending, first green wins,
-            # with the same timeout re-probe discipline as the climb
+        if best_pass is None and not tunnel_dead:
+            # no delta rung cleared 1.0 (a sub-1.0 delta fallback may be
+            # banked) but the tunnel still answers — dense safety net,
+            # descending, first green wins, with the same timeout
+            # re-probe discipline as the climb; a sub-1.0 dense result
+            # only replaces a sub-1.0 delta fallback when it is BETTER
+            # (report the best of the two ladders — the old fall-through
+            # behavior, ADVICE round 5)
             for layout, n in TPU_DENSE_ATTEMPTS:
                 rc, out, err = _run_child(
                     [os.path.abspath(__file__), "--child", f"{layout}:{n}"],
@@ -483,9 +498,12 @@ def main() -> None:
                 result = _extract_json(out)
                 if rc == 0 and result is not None:
                     _echo_child_stderr(err)
-                    if result.get("vs_baseline", 0.0) >= 1.0:
+                    vs = result.get("vs_baseline", 0.0)
+                    if vs >= 1.0:
                         best_pass = result
-                    else:
+                    elif fallback is None or vs > fallback.get(
+                        "vs_baseline", 0.0
+                    ):
                         fallback = result
                     break
                 reason = (
@@ -497,7 +515,7 @@ def main() -> None:
                 errors.append(
                     f"tpu bench {layout} n={n} {reason}: {tail[0][:160]}"
                 )
-                if "UNAVAILABLE" in (err or "") or "crashed" in (err or ""):
+                if _is_worker_crash(err):
                     break
                 if rc is None:
                     timeouts_seen += 1

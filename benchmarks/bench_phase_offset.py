@@ -11,16 +11,25 @@ and lets only one residue class of nodes initiate probes per sub-tick
 the reference's semantics), which is the staggered model at offset
 granularity 1/P.
 
-Scenario per seed: converged n-node cluster at 1% loss, kill one node,
-then measure (in PERIODS, i.e. sub-ticks / P):
+Scenario per seed: converged n-node cluster at 1% loss, kill one node
+after a 2-period warmup, then measure (in PERIODS, i.e. sub-ticks / P):
 
 * detection: periods from the kill until the first faulty declaration;
 * convergence: periods from the kill until every live view is
-  identical again (the kill rumor has fully disseminated).
+  identical again, sampled at period boundaries (the kill rumor has
+  fully disseminated).
 
 Identical wall-clock protocol constants: suspicion_ticks scales by P.
 
+All S seeds run as ONE vmapped sweep dispatch per phase_mod
+(``SimCluster.run_sweep`` — each replica draws its own key, so seeds
+are independent trajectories), replacing the old one-dispatch-per-
+tick-per-seed host loop.  The horizon is fixed (no early exit inside a
+compiled scan); seeds that never detect/converge within it are
+reported in ``undetected``/``unconverged``.
+
 Usage: python benchmarks/bench_phase_offset.py [n] [--seeds S] [--P P]
+       [--horizon PERIODS]
 """
 
 from __future__ import annotations
@@ -33,39 +42,64 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SUSPICION_PERIODS = 8
+WARM_PERIODS = 2
 
 
-def one_run(n: int, phase_mod: int, seed: int, loss: float = 0.01) -> dict:
+def sweep_runs(
+    n: int, phase_mod: int, seeds: int, horizon: int, loss: float = 0.01
+) -> list[dict]:
+    """All ``seeds`` replicas of the kill experiment in one dispatch."""
+    import numpy as np
+
     from ringpop_tpu.models import swim_sim as sim
     from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
 
     params = sim.SwimParams(
         loss=loss,
         suspicion_ticks=SUSPICION_PERIODS * phase_mod,
         phase_mod=phase_mod,
     )
-    cluster = SimCluster(n, params, seed=seed, backend="dense")
-    cluster.tick(2 * phase_mod)  # warm/converge under loss
+    warm = WARM_PERIODS * phase_mod  # warm/converge under loss, in scan
+    kill_tick = warm
+    ticks = warm + horizon * phase_mod
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": ticks,
+            "events": [{"at": kill_tick, "op": "kill", "node": n // 3}],
+        }
+    )
+    cluster = SimCluster(n, params, seed=0, backend="dense")
+    trace = cluster.run_sweep(spec, seeds)
 
-    victim = n // 3
-    cluster.kill(victim)
-    detect = None
-    ticks = 0
-    max_ticks = 400 * phase_mod
-    while ticks < max_ticks:
-        m = cluster.tick(1)
-        ticks += 1
-        if detect is None and int(m.get("faulty_declared", 0)) > 0:
-            detect = ticks
-        if detect is not None and ticks % phase_mod == 0 and cluster.converged():
-            break
-    return {
-        "n": n,
-        "phase_mod": phase_mod,
-        "seed": seed,
-        "detect_periods": None if detect is None else detect / phase_mod,
-        "converge_periods": ticks / phase_mod,
-    }
+    out = []
+    fd = trace.metrics["faulty_declared"]
+    for r in range(seeds):
+        hits = np.flatnonzero(fd[r, kill_tick:] > 0)
+        detect = int(hits[0]) + 1 if hits.size else None
+        converge = None
+        if detect is not None:
+            # the old loop sampled convergence at period boundaries
+            # ((ticks since kill) % P == 0) once detection had fired
+            for t in range(kill_tick + detect - 1, ticks):
+                since = t - kill_tick + 1
+                if since % phase_mod == 0 and trace.converged[r, t]:
+                    converge = since
+                    break
+        out.append(
+            {
+                "n": n,
+                "phase_mod": phase_mod,
+                "seed": r,
+                "detect_periods": (
+                    None if detect is None else detect / phase_mod
+                ),
+                "converge_periods": (
+                    None if converge is None else converge / phase_mod
+                ),
+            }
+        )
+    return out
 
 
 def main() -> None:
@@ -81,24 +115,35 @@ def main() -> None:
     mods = [1, 4]
     if "--P" in sys.argv:
         mods = [1, int(sys.argv[sys.argv.index("--P") + 1])]
+    horizon = 48  # periods after the kill (the old loop capped at 400
+    # with early exit; a compiled scan has no early exit, so the
+    # horizon is a knob — raise it if `unconverged` shows up)
+    if "--horizon" in sys.argv:
+        horizon = int(sys.argv[sys.argv.index("--horizon") + 1])
 
     for phase_mod in mods:
         t0 = time.perf_counter()
-        det, conv = [], []
-        for seed in range(seeds):
-            r = one_run(n, phase_mod, seed)
+        det, conv, unconverged = [], [], 0
+        for r in sweep_runs(n, phase_mod, seeds, horizon):
             print(f"# {r}", file=sys.stderr, flush=True)
             if r["detect_periods"] is not None:
                 det.append(r["detect_periods"])
-            conv.append(r["converge_periods"])
+            if r["converge_periods"] is not None:
+                conv.append(r["converge_periods"])
+            else:
+                unconverged += 1
         print(
             json.dumps(
                 {
                     "metric": f"phase_offset_P{phase_mod}_n{n}",
                     "detect_periods_mean": round(sum(det) / max(len(det), 1), 2),
-                    "converge_periods_mean": round(sum(conv) / len(conv), 2),
+                    "converge_periods_mean": round(
+                        sum(conv) / max(len(conv), 1), 2
+                    ),
                     "seeds": seeds,
                     "detected": len(det),
+                    "unconverged": unconverged,
+                    "dispatches_per_P": 1,
                     "wall_s": round(time.perf_counter() - t0, 1),
                 }
             ),

@@ -1,0 +1,161 @@
+"""Vmapped scenario sweep: ONE dispatch for R replicas vs R dispatches.
+
+The sweep engine's reason to exist, measured: the statistical
+experiment every multi-seed benchmark in this repo runs — R replicas
+of the same chaos scenario, differing only in PRNG seed — used to pay
+the dispatch + host-sync + per-replica bookkeeping tax R times in a
+host loop.  Both arms below run the COMPLETE experiment through the
+public API (cluster construction, the run, and the detection/heal
+statistics), from the same spec:
+
+* sweep arm: one ``SimCluster`` + ``run_sweep(R)`` — one vmapped
+  jitted dispatch (counted via both scan dispatch counters), one
+  ``SweepTrace``; when more than one device is visible the replica
+  axis is sharded across them (replicas are data-parallel by
+  construction, so a multi-chip mesh runs R / n_devices per chip).
+* sequential arm: R x (``SimCluster`` + ``run_scenario``) — R scan
+  dispatches, each fully host-synced (the Trace pull), then the same
+  statistics from the R traces.
+
+The trajectories are NOT pairwise identical across arms (different
+seeds by design — it's a statistical experiment; per-replica
+bit-parity against run_scenario from the same key is pinned in
+tests/test_sweep.py), so the benchmark also cross-checks both arms'
+converged-replica counts as a sanity signal, not a parity claim.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# More than one XLA host device lets the sweep arm shard the replica
+# axis (real thread-level parallelism on CPU; the multi-chip story on
+# TPU).  Only when this module is the entry point AND jax is not yet
+# initialized — under run_all the process-wide device layout belongs
+# to the aggregator, and the bench reports whatever count it got.
+if (
+    __name__ == "__main__"
+    and "jax" not in sys.modules
+    and "--no-devices" not in sys.argv
+):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        count = min(8, os.cpu_count() or 1)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={count}".strip()
+        )
+
+import time  # noqa: E402
+
+import jax  # noqa: E402
+
+
+def _experiment_spec(n: int, ticks: int):
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+    return ScenarioSpec.from_dict(
+        {
+            "ticks": ticks,
+            "events": [
+                {"at": ticks // 8, "op": "kill", "node": n - 1},
+                {"at": ticks // 4, "op": "loss", "p": 0.05},
+                {"at": ticks // 2, "op": "loss_ramp",
+                 "until": ticks // 2 + 10, "to": 0.0},
+            ],
+        }
+    )
+
+
+def run(n: int = 256, ticks: int = 60, replicas: int = 8) -> list[dict]:
+    from ringpop_tpu.models import swim_sim as sim
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.scenarios import runner as srunner
+    from ringpop_tpu.scenarios import sweep as ssweep
+
+    spec = _experiment_spec(n, ticks)
+    params = sim.SwimParams()
+    shard = len(jax.devices()) > 1 and replicas % len(jax.devices()) == 0
+
+    def sweep_arm():
+        before = (ssweep.dispatch_count(), srunner.dispatch_count())
+        t0 = time.perf_counter()
+        cluster = SimCluster(n, params, seed=11)
+        trace = cluster.run_sweep(spec, replicas, shard=shard)
+        stats = trace.summary()
+        wall = time.perf_counter() - t0
+        dispatches = (
+            ssweep.dispatch_count() - before[0],
+            srunner.dispatch_count() - before[1],
+        )
+        return wall, dispatches, stats
+
+    def sequential_arm():
+        before = (ssweep.dispatch_count(), srunner.dispatch_count())
+        t0 = time.perf_counter()
+        detect, converged_final = [], 0
+        for r in range(replicas):
+            cluster = SimCluster(n, params, seed=100 + r)
+            trace = cluster.run_scenario(spec)
+            fd = trace.metrics["faulty_declared"]
+            hits = (fd > 0).nonzero()[0]
+            if hits.size:
+                detect.append(int(hits[0]))
+            converged_final += int(trace.converged[-1])
+        wall = time.perf_counter() - t0
+        dispatches = (
+            ssweep.dispatch_count() - before[0],
+            srunner.dispatch_count() - before[1],
+        )
+        return wall, dispatches, detect, converged_final
+
+    # cold (compile) then warm (executable cached); interleaved so a
+    # machine-load swing hits both arms alike
+    cold_sweep, sweep_disp, _ = sweep_arm()
+    cold_seq, seq_disp, _, _ = sequential_arm()
+    warm_sweep, warm_seq = [], []
+    stats = detect = conv_seq = None
+    for _ in range(3):
+        w, _, d, c = sequential_arm()
+        warm_seq.append(w)
+        detect, conv_seq = d, c
+        w, _, s = sweep_arm()
+        warm_sweep.append(w)
+        stats = s
+    best_sweep, best_seq = min(warm_sweep), min(warm_seq)
+    return [
+        {
+            "metric": f"sweep_vmapped_n{n}_t{ticks}_R{replicas}",
+            "value": round(replicas / best_sweep, 3),
+            "unit": "replicas_per_s_warm",
+            "wall_s": round(best_sweep, 3),
+            "cold_s": round(cold_sweep, 2),
+            "dispatches": sweep_disp[0] + sweep_disp[1],
+            "devices": len(jax.devices()),
+            "sharded": shard,
+            "converged": stats["replicas"]["converged_final"],
+            "detected": stats["replicas"]["detected"],
+        },
+        {
+            "metric": f"sweep_sequential_n{n}_t{ticks}_R{replicas}",
+            "value": round(replicas / best_seq, 3),
+            "unit": "replicas_per_s_warm",
+            "wall_s": round(best_seq, 3),
+            "cold_s": round(cold_seq, 2),
+            "dispatches": seq_disp[0] + seq_disp[1],
+            "converged": conv_seq,
+            "detected": len(detect),
+            "speedup_vmapped": round(best_seq / max(best_sweep, 1e-9), 3),
+        },
+    ]
+
+
+if __name__ == "__main__":
+    import json
+
+    n = 256
+    for a in sys.argv[1:]:
+        if a.isdigit():
+            n = int(a)
+    for row in run(n=n):
+        print(json.dumps(row), flush=True)

@@ -1,0 +1,247 @@
+"""AOT memory-footprint census of the compiled simulation programs.
+
+The round-5 headline failure was a delta program killing the TPU
+worker at n=65,536 with NO footprint instrumentation anywhere in the
+repo — we optimized compiled programs we could not measure the memory
+shape of.  This census is that instrument: it lowers and compiles each
+program ahead of time (``jit(...).lower(...).compile()``) and reads
+XLA's ``memory_analysis()`` — argument / output / temporary / aliased
+bytes, and the peak — WITHOUT running anything, so an oversized
+program is diagnosed on whatever host compiles it instead of
+discovered as a dead worker.
+
+Programs censused (one JSON line per (program, backend, n, R)):
+
+* ``swim_run`` / ``delta_run``   — the plain multi-tick scans;
+* ``run_scenario``               — the scenario engine's event scan;
+* ``run_sweep``                  — the vmapped R-replica sweep, the
+  check on sweep.py's memory model: peak grows ~R x state (the
+  donated carry gains a replica axis), NOT R x program temporaries.
+
+``peak_bytes`` is XLA's own peak when the backend reports one
+(``peak_memory_in_bytes``, TPU) and otherwise the derived
+``argument + output + temp - alias`` (donated buffers counted once) —
+the field to watch when triaging a worker crash: it is the HBM the
+program needs, not the HBM the arrays occupy.
+
+Usage:  python -m benchmarks.mem_census [--backend dense|delta|both]
+            [--n 1024[,4096,...]] [--replicas 8] [--ticks 8]
+            [--capacity 64] [--programs run,scenario,sweep]
+
+``tests/test_mem_census.py`` pins the dense-vs-delta peak ordering at
+a fixed shape as a slow regression test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any
+
+from ringpop_tpu.utils import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+import jax  # noqa: E402  (platform pin must precede backend init)
+import jax.numpy as jnp  # noqa: E402
+
+# the canonical census scenario: one kill + a loss step, so the event
+# tensors and the loss schedule are non-degenerate without changing
+# the program's asymptotic shape
+def _spec_dict(ticks: int) -> dict:
+    return {
+        "ticks": ticks,
+        "events": [
+            {"at": ticks // 4, "op": "kill", "node": 0},
+            {"at": ticks // 2, "op": "loss", "p": 0.05},
+        ],
+    }
+
+
+def _stats_row(compiled: Any) -> dict[str, int]:
+    ma = compiled.memory_analysis()
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    temp = int(ma.temp_size_in_bytes)
+    alias = int(ma.alias_size_in_bytes)
+    explicit_peak = int(getattr(ma, "peak_memory_in_bytes", 0) or 0)
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        "peak_bytes": explicit_peak or (arg + out + temp - alias),
+        "peak_is_derived": not explicit_peak,
+    }
+
+
+def _census(jitted, *args, **kwargs) -> dict[str, int]:
+    return _stats_row(jitted.lower(*args, **kwargs).compile())
+
+
+def _dense_fixture(n: int):
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sim.SwimParams(loss=0.01)
+    return sim.init_state(n), sim.make_net(n), params
+
+
+def _delta_fixture(n: int, capacity: int):
+    from ringpop_tpu.models import swim_delta as sd
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.01), wire_cap=16, claim_grid=64
+    )
+    return sd.init_delta(n, capacity=capacity), sim.make_net(n), params
+
+
+def census_run(backend: str, n: int, ticks: int, capacity: int) -> dict:
+    """swim_run / delta_run: the plain multi-tick scan."""
+    key = jax.random.PRNGKey(0)
+    if backend == "delta":
+        from ringpop_tpu.models import swim_delta as sd
+
+        state, net, params = _delta_fixture(n, capacity)
+        row = _census(sd.delta_run, state, net, key, params, ticks)
+        name = "delta_run"
+    else:
+        from ringpop_tpu.models import swim_sim as sim
+
+        state, net, params = _dense_fixture(n)
+        row = _census(sim.swim_run, state, net, key, params, ticks)
+        name = "swim_run"
+    return {"program": name, "backend": backend, "n": n, "replicas": 1,
+            "ticks": ticks, **row}
+
+
+def _compiled_scenario(n: int, ticks: int, base_loss: float):
+    from ringpop_tpu.scenarios.compile import compile_spec
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+    spec = ScenarioSpec.from_dict(_spec_dict(ticks))
+    return spec, compile_spec(spec, n, base_loss=base_loss)
+
+
+def census_scenario(backend: str, n: int, ticks: int, capacity: int) -> dict:
+    """run_scenario: the event-applying scan (runner._scenario_scan)."""
+    from ringpop_tpu.scenarios import runner
+
+    if backend == "delta":
+        state, net, params = _delta_fixture(n, capacity)
+    else:
+        state, net, params = _dense_fixture(n)
+    swim = params.swim if backend == "delta" else params
+    _, compiled = _compiled_scenario(n, ticks, swim.loss)
+    keys = jax.random.split(jax.random.PRNGKey(0), ticks)
+    row = _census(
+        runner._scenario_scan,
+        state,
+        net.up,
+        net.responsive,
+        jnp.zeros((n,), jnp.int32),
+        compiled.ev_tick,
+        compiled.ev_kind,
+        compiled.ev_node,
+        compiled.p_tick,
+        compiled.p_gid,
+        compiled.loss,
+        keys,
+        params=params,
+        has_revive=compiled.has_revive,
+    )
+    return {"program": "run_scenario", "backend": backend, "n": n,
+            "replicas": 1, "ticks": ticks, **row}
+
+
+def census_sweep(
+    backend: str, n: int, ticks: int, capacity: int, replicas: int
+) -> dict:
+    """run_sweep: the vmapped R-replica scan (sweep._sweep_scan)."""
+    from ringpop_tpu.scenarios import sweep as ssweep
+
+    if backend == "delta":
+        state, net, params = _delta_fixture(n, capacity)
+    else:
+        state, net, params = _dense_fixture(n)
+    swim = params.swim if backend == "delta" else params
+    spec, _ = _compiled_scenario(n, ticks, swim.loss)
+    cs = ssweep.compile_sweep(
+        spec, n, replicas=replicas, base_loss=swim.loss
+    )
+    key = jax.random.PRNGKey(0)
+    rkeys = list(jax.random.split(key, replicas))
+    keys = ssweep.sweep_key_schedule(rkeys, cs)
+    row = _census(
+        ssweep._sweep_scan,
+        ssweep._broadcast_replicas(state, replicas),
+        ssweep._broadcast_replicas(net.up, replicas),
+        ssweep._broadcast_replicas(net.responsive, replicas),
+        ssweep._broadcast_replicas(jnp.zeros((n,), jnp.int32), replicas),
+        cs.ev_tick,
+        cs.ev_kind,
+        cs.ev_node,
+        cs.base.p_tick,
+        cs.base.p_gid,
+        cs.loss,
+        keys,
+        params=params,
+        has_revive=cs.base.has_revive,
+    )
+    return {"program": "run_sweep", "backend": backend, "n": n,
+            "replicas": replicas, "ticks": ticks, **row}
+
+
+def run(
+    *,
+    backends=("dense", "delta"),
+    ns=(1024,),
+    ticks: int = 8,
+    capacity: int = 64,
+    replicas: int = 8,
+    programs=("run", "scenario", "sweep"),
+) -> list[dict]:
+    """Every requested census row (the test entry point)."""
+    rows = []
+    for backend in backends:
+        for n in ns:
+            if "run" in programs:
+                rows.append(census_run(backend, n, ticks, capacity))
+            if "scenario" in programs:
+                rows.append(census_scenario(backend, n, ticks, capacity))
+            if "sweep" in programs:
+                rows.append(
+                    census_sweep(backend, n, ticks, capacity, replicas)
+                )
+    for row in rows:
+        row["platform"] = jax.default_backend()
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--backend", choices=("dense", "delta", "both"),
+                    default="both")
+    ap.add_argument("--n", default="1024",
+                    help="comma-separated cluster sizes")
+    ap.add_argument("--ticks", type=int, default=8)
+    ap.add_argument("--capacity", type=int, default=64,
+                    help="delta divergence slots per viewer")
+    ap.add_argument("--replicas", type=int, default=8,
+                    help="sweep replica count (R)")
+    ap.add_argument("--programs", default="run,scenario,sweep",
+                    help="comma list of run,scenario,sweep")
+    args = ap.parse_args()
+
+    backends = ("dense", "delta") if args.backend == "both" else (args.backend,)
+    ns = tuple(int(x) for x in args.n.split(","))
+    programs = tuple(args.programs.split(","))
+    for row in run(backends=backends, ns=ns, ticks=args.ticks,
+                   capacity=args.capacity, replicas=args.replicas,
+                   programs=programs):
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

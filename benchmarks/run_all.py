@@ -27,6 +27,7 @@ SIM_BENCHES = [
     "bench_partition_heal",
     "bench_pingreq_deviation",
     "bench_scenario",  # one-call compiled scenario vs the host loop
+    "bench_sweep",  # one vmapped R-replica dispatch vs R sequential
 ]
 
 
@@ -52,7 +53,8 @@ def main(argv=None) -> int:
         module = importlib.import_module(f"benchmarks.{name}")
         kwargs = {}
         if args.sim_n and name in (
-            "bench_sim_convergence", "bench_partition_heal", "bench_scenario"
+            "bench_sim_convergence", "bench_partition_heal",
+            "bench_scenario", "bench_sweep",
         ):
             kwargs["n"] = args.sim_n
         try:

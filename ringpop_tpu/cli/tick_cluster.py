@@ -474,11 +474,24 @@ class TpuSimCluster(ClusterDriver):
     def shutdown(self) -> None:
         pass
 
-    def run_scenario(self, path: str, trace_out: str | None = None) -> None:
-        """Run a JSON scenario spec as ONE jitted call (scenarios/)."""
+    def run_scenario(
+        self,
+        path: str,
+        trace_out: str | None = None,
+        sweep: int = 0,
+        sweep_loss_scales: list[float] | None = None,
+        sweep_kill_jitter: list[int] | None = None,
+    ) -> None:
+        """Run a JSON scenario spec as ONE jitted call (scenarios/);
+        with ``sweep=R`` run R replicas in one vmapped dispatch."""
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
         spec = ScenarioSpec.load(path)
+        if sweep:
+            self._run_sweep(
+                spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter
+            )
+            return
         t0 = time.perf_counter()
         trace = self.cluster.run_scenario(spec)
         wall_ms = (time.perf_counter() - t0) * 1000
@@ -497,6 +510,39 @@ class TpuSimCluster(ClusterDriver):
             trace.save(trace_out)
             print(f"trace ({trace.ticks} ticks x "
                   f"{len(trace.metrics) + 3} series) -> {trace_out}")
+
+    def _run_sweep(self, spec, trace_out, replicas, loss_scales, kill_jitter):
+        t0 = time.perf_counter()
+        strace = self.cluster.run_sweep(
+            spec, replicas,
+            loss_scales=loss_scales, kill_jitter=kill_jitter,
+        )
+        wall_ms = (time.perf_counter() - t0) * 1000
+        summary = strace.summary()
+        rep = summary["replicas"]
+        det, heal = summary["detect_tick"], summary["heal_tick"]
+
+        def dist(d, hit):
+            if not hit:
+                return "-"
+            return (f"min={d['min']:.0f} p50={d['median']:.0f} "
+                    f"p95={d['p95']:.0f} max={d['max']:.0f}")
+
+        print(
+            f"sweep: {replicas} replicas x {strace.ticks} ticks, one "
+            f"vmapped dispatch in {wall_ms:.0f}ms — "
+            f"converged {rep['converged_final']}/{replicas}"
+        )
+        print(f"  detect tick ({rep['detected']}/{replicas} detected): "
+              f"{dist(det, rep['detected'])}")
+        print(f"  heal tick ({rep['healed']}/{replicas} healed): "
+              f"{dist(heal, rep['healed'])}")
+        if trace_out:
+            strace.save(trace_out)
+            print(
+                f"sweep trace ({replicas} x {strace.ticks} x "
+                f"{len(strace.metrics) + 3} series) -> {trace_out}"
+            )
 
 
 MENU = """commands:
@@ -576,6 +622,19 @@ def add_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--trace-out", default=None, metavar="FILE",
                         help="with --scenario: write the per-tick telemetry "
                              "trace (.npz) here")
+    parser.add_argument("--sweep", type=int, default=0, metavar="R",
+                        help="with --scenario: run R replicas of the "
+                             "scenario in ONE vmapped jitted dispatch "
+                             "(per-replica PRNG seeds; scenarios/sweep.py), "
+                             "reporting detection/heal-tick distributions")
+    parser.add_argument("--sweep-loss-scales", default=None, metavar="S,S,...",
+                        help="with --sweep: comma list of R per-replica "
+                             "loss multipliers (every loss value of the "
+                             "spec, base included, scales per replica)")
+    parser.add_argument("--sweep-kill-jitter", default=None, metavar="J,J,...",
+                        help="with --sweep: comma list of R per-replica "
+                             "tick offsets applied to the spec's kill "
+                             "events")
     parser.add_argument("--script-to-scenario", default=None, metavar="FILE",
                         help="compile --script into a scenario spec JSON at "
                              "FILE and exit (no cluster is started)")
@@ -607,6 +666,14 @@ def main(argv: list[str] | None = None) -> None:
     if args.scenario and backend != "tpu-sim":
         parser.error("--scenario needs --backend tpu-sim (the compiled "
                      "scenario engine is a tensor-simulation feature)")
+    if args.sweep and not args.scenario:
+        parser.error("--sweep needs --scenario (it replicates a compiled "
+                     "scenario, not an interactive session)")
+    sweep_scales = sweep_jitter = None
+    if args.sweep_loss_scales is not None:
+        sweep_scales = [float(x) for x in args.sweep_loss_scales.split(",")]
+    if args.sweep_kill_jitter is not None:
+        sweep_jitter = [int(x) for x in args.sweep_kill_jitter.split(",")]
     if backend == "host-sim":
         driver: ClusterDriver = SimCluster(args.size, args.base_port,
                                            seed=args.seed)
@@ -623,7 +690,11 @@ def main(argv: list[str] | None = None) -> None:
 
     try:
         if args.scenario:
-            driver.run_scenario(args.scenario, args.trace_out)
+            driver.run_scenario(
+                args.scenario, args.trace_out, sweep=args.sweep,
+                sweep_loss_scales=sweep_scales,
+                sweep_kill_jitter=sweep_jitter,
+            )
         elif args.script:
             run_script(driver, args.script)
         else:

@@ -205,6 +205,82 @@ class SimCluster:
         self.metrics_log.append(entry)
         return trace
 
+    def run_sweep(
+        self,
+        spec,
+        replicas: int,
+        *,
+        loss_scales: Sequence[float] | None = None,
+        kill_jitter: Sequence[int] | None = None,
+        shard: bool = False,
+    ) -> Any:
+        """Run R replicas of a scenario as ONE vmapped jitted call.
+
+        Each replica starts from a fresh broadcast copy of the current
+        state and draws its own replica key from the cluster key, so
+        replica r is bit-identical to a standalone ``run_scenario``
+        from that key (``scenarios/sweep.py`` docstring; the optional
+        per-replica ``loss_scales``/``kill_jitter`` vary the scenario
+        within one compiled program; ``shard=True`` splits the replica
+        axis across the local devices).  Returns a ``SweepTrace`` with
+        [R, ticks] telemetry stacks plus the final per-replica states
+        attached in memory (``final_states``/``final_nets``).
+
+        Unlike ``run_scenario``, the cluster itself does NOT advance:
+        the sweep is a statistical measurement fan-out, not the
+        cluster's own trajectory — only the cluster key moves (R
+        draws), and nothing is appended to ``metrics_log``/``traces``
+        (checkpoints round-trip ``Trace`` objects only).
+        """
+        from ringpop_tpu.scenarios import runner as srunner
+        from ringpop_tpu.scenarios import sweep as ssweep
+        from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+        if isinstance(spec, str):
+            spec = ScenarioSpec.load(spec)
+        elif isinstance(spec, dict):
+            spec = ScenarioSpec.from_dict(spec)
+        spec.validate(self.n)
+        cs = ssweep.compile_sweep(
+            spec,
+            self.n,
+            replicas=replicas,
+            base_loss=self.params.loss,
+            loss_scales=loss_scales,
+            kill_jitter=kill_jitter,
+        )
+        # static rejections BEFORE drawing keys (run_scenario contract)
+        srunner.precheck(self.state, self.net, cs.base)
+        if shard:
+            ssweep.precheck_shard(replicas)
+        replica_keys = [self._split() for _ in range(replicas)]
+        keys = ssweep.sweep_key_schedule(replica_keys, cs)
+        params = self.dparams if self.backend == "delta" else self.params
+        states, nets, ys = ssweep.run_sweep_compiled(
+            self.state, self.net, keys, cs, params, shard=shard
+        )
+        stacks = {k: np.asarray(v) for k, v in ys.items()}
+        trace = ssweep.SweepTrace(
+            metrics={
+                k: v
+                for k, v in stacks.items()
+                if k not in ("converged", "live", "loss")
+            },
+            converged=stacks["converged"],
+            live=stacks["live"],
+            loss=stacks["loss"],
+            n=self.n,
+            backend=self.backend,
+            replica_keys=np.stack([np.asarray(k) for k in replica_keys]),
+            loss_scales=cs.loss_scales,
+            kill_jitter=cs.kill_jitter,
+            start_tick=int(self.state.tick),
+            spec=spec.to_dict(),
+        ).validate()
+        trace.final_states = states
+        trace.final_nets = nets
+        return trace
+
     def run_until_converged(self, max_ticks: int = 1000, check_every: int = 5) -> int:
         """Ticks until convergence (or -1); the tick-cluster 't' loop."""
         done = 0

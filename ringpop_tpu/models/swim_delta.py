@@ -1476,6 +1476,11 @@ def delta_step_impl(
                 # refutation below always has a free slot.  Dropped
                 # slots' pb duty is forfeited (flip semantics); their
                 # suspicion timers are void (status superseded).
+                # STALE FROM HERE: this compaction permutes/drops slots
+                # without maintaining the d_bpmask/d_bprank digest
+                # tensors — they keep their pre-absorb layout until the
+                # wholesale _refresh_in_step at the end of this branch.
+                # Do not read them between those two points.
                 live2 = st2.d_subj < SENTINEL
                 subj2 = jnp.where(live2, st2.d_subj, 0)
                 m_at = st2.base_at(subj2)

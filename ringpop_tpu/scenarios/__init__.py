@@ -20,15 +20,26 @@ Layers:
   plus the host-loop equivalent (the parity/benchmark baseline).
 * ``trace``   — the stacked telemetry, npz export, and the
   ``stats.py``-key-compatible summary.
+* ``sweep``   — R replicas of a compiled scenario vmapped into ONE
+  jitted dispatch (batch axes: PRNG seed, per-replica loss scale,
+  kill-tick jitter), with the stacked ``SweepTrace`` telemetry.
 
-Entry points: ``SimCluster.run_scenario(spec)`` and
-``tick-cluster --backend tpu-sim --scenario FILE``.
+Entry points: ``SimCluster.run_scenario(spec)``,
+``SimCluster.run_sweep(spec, replicas)``, and
+``tick-cluster --backend tpu-sim --scenario FILE [--sweep R]``.
 """
 
 from ringpop_tpu.scenarios.spec import Event, ScenarioSpec, script_to_spec
 from ringpop_tpu.scenarios.compile import CompiledScenario, compile_spec
 from ringpop_tpu.scenarios.trace import Trace
 from ringpop_tpu.scenarios.runner import run_compiled, run_host_loop
+from ringpop_tpu.scenarios.sweep import (
+    CompiledSweep,
+    SweepTrace,
+    compile_sweep,
+    replica_spec,
+    run_sweep_compiled,
+)
 
 __all__ = [
     "Event",
@@ -39,4 +50,9 @@ __all__ = [
     "Trace",
     "run_compiled",
     "run_host_loop",
+    "CompiledSweep",
+    "SweepTrace",
+    "compile_sweep",
+    "replica_spec",
+    "run_sweep_compiled",
 ]

@@ -1,0 +1,653 @@
+"""Vmapped scenario sweeps: R chaos replicas in ONE jitted dispatch.
+
+Every statistical experiment in the repo used to run one compiled
+dispatch per seed in a host loop — R replicas paid the dispatch +
+host-sync tax R times (the SWIM paper's own evaluation method is
+multi-trial distributions of detection/dissemination time, produced
+serially).  This module batches the replicas into the device: the
+single-scenario scan (``runner._scenario_scan_impl``) is ``vmap``-ed
+over a leading replica axis and jitted ONCE, so R replicas of a
+compiled fault timeline cost one dispatch and one compile.
+
+What may vary per replica (the restricted batch axes):
+
+* the PRNG seed — each replica draws its own segment-exact key
+  schedule from its own replica key, so replica r is bit-identical to
+  a standalone ``run_scenario`` started from that key (the parity
+  contract, tests/test_sweep.py);
+* a **loss scale** — replica r's loss schedule is the spec compiled
+  with every loss value (base + events + ramp targets) scaled by
+  ``loss_scales[r]``;
+* a **kill-tick jitter** — replica r's ``kill`` events shift by
+  ``kill_jitter[r]`` ticks.
+
+Everything else (tick count, partitions, suspend/resume/revive
+timing, cluster size, protocol params) is shared: those change tensor
+shapes or static lowering facts and would force one compile per
+variant, which is exactly the tax the sweep exists to amortize.
+
+Memory model: the donated scan carry gains a leading replica axis, so
+peak HBM is R x state (plus per-tick temporaries, also R-wide inside
+one tick) — NOT R separately-resident programs.
+``benchmarks/mem_census.py`` measures this shape.
+
+Per-replica parity is by construction: each replica's event tensors,
+loss schedule, and key schedule are produced by the SAME
+``compile_spec``/``key_schedule`` path a standalone ``run_scenario``
+of ``replica_spec(spec, ...)`` would use, and the vmapped scan body is
+the same ``_scenario_scan_impl``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.scenarios import runner
+from ringpop_tpu.scenarios.compile import (
+    CompiledScenario,
+    compile_spec,
+    key_schedule,
+)
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+from ringpop_tpu.scenarios.trace import Trace
+from ringpop_tpu.stats import Histogram
+
+_dispatches = 0
+
+
+def dispatch_count() -> int:
+    """Jitted sweep-scan invocations so far (test/bench instrumentation)."""
+    return _dispatches
+
+
+def _register_optimization_barrier_batcher() -> None:
+    """jax 0.4.37 ships no vmap rule for ``lax.optimization_barrier``
+    (the dense step's HBM lifetime fence, swim_sim._phase01_select);
+    newer jax added the obvious identity batcher upstream.  Register
+    the same rule here (guarded) so the sweep can vmap the step —
+    the barrier is semantically the identity, so batching it is just
+    binding the primitive on the batched operands."""
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:  # pragma: no cover - future jax moved it: it
+        return  # will only do so once the upstream rule exists
+    if optimization_barrier_p in batching.primitive_batchers:
+        return
+
+    def _batcher(batched_args, batch_dims, **params):
+        return optimization_barrier_p.bind(*batched_args), batch_dims
+
+    batching.primitive_batchers[optimization_barrier_p] = _batcher
+
+
+_register_optimization_barrier_batcher()
+
+
+# ---------------------------------------------------------------------------
+# per-replica spec derivation (the host-side single source of truth)
+# ---------------------------------------------------------------------------
+
+
+def replica_spec(
+    spec: ScenarioSpec, *, kill_jitter: int = 0, loss_scale: float = 1.0
+) -> ScenarioSpec:
+    """Replica r's effective spec: ``kill`` events shifted by
+    ``kill_jitter`` ticks, every loss value scaled by ``loss_scale``.
+
+    This is the spec a standalone ``run_scenario`` must be given to
+    reproduce replica r bit-for-bit (together with the replica key and
+    a base loss of ``params.loss * loss_scale``) — the sweep compiles
+    each replica THROUGH this function, so parity is by construction,
+    not by re-implementation.
+    """
+    if kill_jitter == 0 and loss_scale == 1.0:
+        return spec
+    events = []
+    for e in spec.events:
+        if e.op == "kill" and kill_jitter:
+            at = e.at + kill_jitter
+            if not 0 <= at < spec.ticks:
+                raise ValueError(
+                    f"kill jitter {kill_jitter:+d} pushes the kill at tick "
+                    f"{e.at} outside [0, {spec.ticks})"
+                )
+            e = e._replace(at=at)
+        if e.op in ("loss", "loss_ramp") and loss_scale != 1.0:
+            e = e._replace(p=e.p * loss_scale)
+        events.append(e)
+    return ScenarioSpec(ticks=spec.ticks, events=tuple(events))
+
+
+class CompiledSweep(NamedTuple):
+    """R per-replica compiled scenarios stacked for one vmapped scan.
+
+    ``base`` carries the static facts shared by construction (ticks, n,
+    partition rows, has_revive); the node-event tensors and the loss
+    schedule gain a leading replica axis (jitter reorders the
+    tick-sorted event rows and scaling changes the loss values —
+    everything else is asserted identical at compile time).
+    """
+
+    base: CompiledScenario
+    replicas: int
+    ev_tick: jax.Array  # int32[R, E]
+    ev_kind: jax.Array  # int32[R, E]
+    ev_node: jax.Array  # int32[R, E]
+    loss: jax.Array  # float32[R, ticks]
+    # host-side facts for the key schedule and the trace meta
+    boundaries: tuple[tuple[int, ...], ...]  # per-replica segment ticks
+    loss_scales: tuple[float, ...]
+    kill_jitter: tuple[int, ...]
+
+
+def _norm_axis(
+    name: str, values: Sequence[float] | None, replicas: int, default: Any
+) -> tuple:
+    if values is None:
+        return (default,) * replicas
+    out = tuple(values)
+    if len(out) != replicas:
+        raise ValueError(
+            f"{name} must have one entry per replica "
+            f"(got {len(out)} for {replicas})"
+        )
+    return out
+
+
+def compile_sweep(
+    spec: ScenarioSpec,
+    n: int,
+    *,
+    replicas: int,
+    base_loss: float = 0.0,
+    loss_scales: Sequence[float] | None = None,
+    kill_jitter: Sequence[int] | None = None,
+) -> CompiledSweep:
+    """Lower a spec to R stacked replica timelines (host-side, no keys
+    drawn — like ``compile_spec``, a failed compile must not advance
+    any PRNG)."""
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1 (got {replicas})")
+    scales = _norm_axis("loss_scales", loss_scales, replicas, 1.0)
+    jitters = _norm_axis("kill_jitter", kill_jitter, replicas, 0)
+    for s in scales:
+        if s < 0.0:
+            raise ValueError(f"loss scales must be >= 0 (got {s})")
+    if all(s == 1.0 for s in scales) and not any(jitters):
+        # the common path (seed-only sweep): every replica's tensors are
+        # byte-identical — compile once, broadcast the replica axis
+        base = compile_spec(spec, n, base_loss=base_loss)
+
+        def _b(a: jax.Array) -> jax.Array:
+            return jnp.broadcast_to(a[None], (replicas,) + a.shape)
+
+        return CompiledSweep(
+            base=base,
+            replicas=replicas,
+            ev_tick=_b(base.ev_tick),
+            ev_kind=_b(base.ev_kind),
+            ev_node=_b(base.ev_node),
+            loss=_b(base.loss),
+            boundaries=(base.boundaries,) * replicas,
+            loss_scales=scales,
+            kill_jitter=jitters,
+        )
+    per: list[CompiledScenario] = []
+    for r in range(replicas):
+        try:
+            spec_r = replica_spec(
+                spec, kill_jitter=jitters[r], loss_scale=scales[r]
+            )
+            per.append(compile_spec(spec_r, n, base_loss=base_loss * scales[r]))
+        except ValueError as e:
+            raise ValueError(f"replica {r}: {e}") from e
+    base = per[0]
+    for r, c in enumerate(per[1:], start=1):
+        # jitter/scale may not change shapes or static lowering facts
+        if c.ticks != base.ticks or c.has_revive != base.has_revive:
+            raise ValueError(f"replica {r} diverges in static scenario shape")
+        if not (
+            np.array_equal(np.asarray(c.p_tick), np.asarray(base.p_tick))
+            and np.array_equal(np.asarray(c.p_gid), np.asarray(base.p_gid))
+        ):  # pragma: no cover - jitter/scale cannot touch partitions
+            raise ValueError(f"replica {r} diverges in partition rows")
+    return CompiledSweep(
+        base=base,
+        replicas=replicas,
+        ev_tick=jnp.stack([c.ev_tick for c in per]),
+        ev_kind=jnp.stack([c.ev_kind for c in per]),
+        ev_node=jnp.stack([c.ev_node for c in per]),
+        loss=jnp.stack([c.loss for c in per]),
+        boundaries=tuple(c.boundaries for c in per),
+        loss_scales=scales,
+        kill_jitter=jitters,
+    )
+
+
+def _schedule_from_key(rkey: jax.Array, compiled: CompiledScenario):
+    """One replica's segment-exact schedule as a pure function of its
+    replica key: the ``SimCluster._split`` discipline (chained
+    ``jax.random.split`` draws, one per segment, fanned per tick) that
+    ``compile.key_schedule`` consumes — traceable, so R replicas can
+    derive their schedules in ONE vmapped dispatch instead of R x
+    (segments + 1) host-looped splits.  Bit-identical per replica to
+    ``key_schedule`` over a cluster whose key IS ``rkey`` (threefry is
+    elementwise in the key), which is what per-replica parity needs."""
+    state = {"key": rkey}
+
+    def split():
+        state["key"], sub = jax.random.split(state["key"])
+        return sub
+
+    return key_schedule(split, compiled)
+
+
+@functools.partial(jax.jit, static_argnames=("boundaries", "ticks"))
+def _sweep_schedules(rkeys: jax.Array, *, boundaries, ticks) -> jax.Array:
+    return jax.vmap(
+        lambda k: _schedule_from_key(
+            k,
+            CompiledScenario(
+                ticks=ticks, n=0, ev_tick=None, ev_kind=None, ev_node=None,
+                p_tick=None, p_gid=None, loss=None, has_revive=False,
+                boundaries=boundaries,
+            ),
+        )
+    )(rkeys)
+
+
+def sweep_key_schedule(
+    replica_keys: Sequence[jax.Array], cs: CompiledSweep
+) -> jax.Array:
+    """uint32[R, ticks, 2]: replica r's segment-exact schedule, derived
+    from replica key r exactly as a standalone cluster whose key IS
+    that replica key would derive it (``SimCluster._split`` discipline
+    over ``compile.key_schedule``) — the basis of per-replica parity.
+
+    When every replica shares the segment boundaries (no kill jitter,
+    or jitter that lands on existing boundaries) all R schedules are
+    derived in one vmapped dispatch; per-replica boundaries fall back
+    to one schedule per replica."""
+    if len(replica_keys) != cs.replicas:
+        raise ValueError(
+            f"{len(replica_keys)} replica keys for {cs.replicas} replicas"
+        )
+    if all(b == cs.boundaries[0] for b in cs.boundaries[1:]):
+        return _sweep_schedules(
+            jnp.stack(list(replica_keys)),
+            boundaries=cs.boundaries[0],
+            ticks=cs.base.ticks,
+        )
+    return jnp.stack(
+        [
+            _schedule_from_key(
+                rkey, cs.base._replace(boundaries=cs.boundaries[r])
+            )
+            for r, rkey in enumerate(replica_keys)
+        ]
+    )
+
+
+# ---------------------------------------------------------------------------
+# the vmapped scan (one jitted dispatch for all R replicas)
+# ---------------------------------------------------------------------------
+
+
+def _sweep_scan_impl(
+    state,
+    up,
+    responsive,
+    adj,
+    ev_tick,
+    ev_kind,
+    ev_node,
+    p_tick,
+    p_gid,
+    loss,
+    keys,
+    *,
+    params,
+    has_revive: bool,
+):
+    return jax.vmap(
+        functools.partial(
+            runner._scenario_scan_impl, params=params, has_revive=has_revive
+        ),
+        # batched: state/net (leading replica axis), node events (jitter
+        # reorders rows), loss (scaled), keys.  Shared: partition rows.
+        in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, 0, 0),
+    )(
+        state,
+        up,
+        responsive,
+        adj,
+        ev_tick,
+        ev_kind,
+        ev_node,
+        p_tick,
+        p_gid,
+        loss,
+        keys,
+    )
+
+
+# The donated scan state carries the leading replica axis: peak HBM is
+# R x state plus one tick's R-wide temporaries, measured by
+# benchmarks/mem_census.py.
+_sweep_scan = jax.jit(
+    _sweep_scan_impl,
+    static_argnames=("params", "has_revive"),
+    donate_argnums=(0, 1, 2, 3),
+)
+
+
+def _broadcast_replicas(tree, replicas: int):
+    """R stacked copies of every array leaf (fresh device buffers —
+    eager broadcast_to materializes, so the copies are donatable and
+    the caller's originals stay valid)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (replicas,) + jnp.shape(a)), tree
+    )
+
+
+def _replica_sharding() -> Any | None:
+    """A NamedSharding that splits the leading replica axis across the
+    local devices, or None on a single device."""
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    return NamedSharding(Mesh(devices, ("replicas",)), PartitionSpec("replicas"))
+
+
+def precheck_shard(replicas: int) -> None:
+    """Static shard-mode rejection, callable before any PRNG key is
+    drawn — like ``runner.precheck``, a failed ``run_sweep`` must not
+    advance the cluster key (on a single device shard mode is an
+    accepted no-op, so there is nothing to reject)."""
+    n_dev = len(jax.devices())
+    if n_dev > 1 and replicas % n_dev:
+        raise ValueError(
+            f"shard=True needs replicas ({replicas}) divisible by the "
+            f"device count ({n_dev})"
+        )
+
+
+def run_sweep_compiled(
+    state: Any,
+    net: Any,
+    keys: jax.Array,
+    cs: CompiledSweep,
+    params: Any,
+    *,
+    shard: bool = False,
+) -> tuple[Any, Any, dict[str, jax.Array]]:
+    """One jitted call: R replicas of the compiled scenario.
+
+    Returns (final states [R, ...], final nets [R, ...], telemetry
+    stacks [R, ticks]).  ``state``/``net`` are the UNBATCHED starting
+    point; they are broadcast to R fresh device copies here (the
+    copies are donated to the scan; the caller's state is untouched).
+
+    ``shard=True`` splits the replica axis across the local devices
+    (replicas are data-parallel by construction — no cross-replica
+    communication exists in the scan), so a multi-chip mesh runs
+    R / n_devices replicas per chip; ignored on a single device.
+    Requires R divisible by the device count.
+    """
+    global _dispatches
+    if keys.shape[:2] != (cs.replicas, cs.base.ticks):
+        raise ValueError(
+            f"key schedule is {keys.shape[:2]} for "
+            f"({cs.replicas} replicas, {cs.base.ticks} ticks)"
+        )
+    runner.precheck(state, net, cs.base)
+    adj = runner._normalize_adj(net, cs.base.n)
+    r = cs.replicas
+    batched = [
+        _broadcast_replicas(state, r),
+        _broadcast_replicas(net.up, r),
+        _broadcast_replicas(net.responsive, r),
+        _broadcast_replicas(adj, r),
+    ]
+    if shard:
+        precheck_shard(r)
+        sharding = _replica_sharding()
+        if sharding is not None:
+            batched = [
+                jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, sharding), t
+                )
+                for t in batched
+            ]
+            keys = jax.device_put(keys, sharding)
+    _dispatches += 1
+    states, up, resp, adj, ys = _sweep_scan(
+        *batched,
+        cs.ev_tick,
+        cs.ev_kind,
+        cs.ev_node,
+        cs.base.p_tick,
+        cs.base.p_gid,
+        cs.loss,
+        keys,
+        params=params,
+        has_revive=cs.base.has_revive,
+    )
+    nets = type(net)(up=up, responsive=resp, adj=adj)
+    return states, nets, ys
+
+
+# ---------------------------------------------------------------------------
+# SweepTrace: R stacked per-replica telemetry series
+# ---------------------------------------------------------------------------
+
+SWEEP_FORMAT_VERSION = 1
+
+_REQUIRED = ("converged", "live", "loss")
+
+
+class SweepTrace:
+    """Per-tick telemetry of R replicas: every ``Trace`` series with a
+    leading replica axis, plus the per-replica sweep parameters and
+    replica keys (enough to re-run any replica standalone)."""
+
+    def __init__(
+        self,
+        *,
+        metrics: dict[str, np.ndarray],
+        converged: np.ndarray,
+        live: np.ndarray,
+        loss: np.ndarray,
+        n: int,
+        backend: str,
+        replica_keys: np.ndarray,
+        loss_scales: Sequence[float],
+        kill_jitter: Sequence[int],
+        start_tick: int = 0,
+        spec: dict[str, Any] | None = None,
+    ):
+        self.metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        self.converged = np.asarray(converged, dtype=bool)
+        self.live = np.asarray(live, dtype=np.int32)
+        self.loss = np.asarray(loss, dtype=np.float32)
+        self.n = int(n)
+        self.backend = str(backend)
+        self.replica_keys = np.asarray(replica_keys)
+        self.loss_scales = tuple(float(s) for s in loss_scales)
+        self.kill_jitter = tuple(int(j) for j in kill_jitter)
+        self.start_tick = int(start_tick)
+        self.spec = spec
+        # in-memory only (run_sweep attaches them; not serialized)
+        self.final_states: Any = None
+        self.final_nets: Any = None
+
+    @property
+    def replicas(self) -> int:
+        return int(self.converged.shape[0])
+
+    @property
+    def ticks(self) -> int:
+        return int(self.converged.shape[1])
+
+    def validate(self) -> "SweepTrace":
+        r, t = self.converged.shape if self.converged.ndim == 2 else (0, 0)
+        if r < 1 or t < 1:
+            raise ValueError("sweep trace needs [R, ticks]-shaped series")
+        for name in _REQUIRED:
+            arr = getattr(self, name)
+            if arr.shape != (r, t):
+                raise ValueError(f"sweep series {name!r} is not [{r}, {t}]-shaped")
+        for name, arr in self.metrics.items():
+            if arr.shape != (r, t):
+                raise ValueError(f"sweep metric {name!r} is not [{r}, {t}]-shaped")
+        if self.replica_keys.shape[0] != r:
+            raise ValueError("replica_keys does not cover every replica")
+        if len(self.loss_scales) != r or len(self.kill_jitter) != r:
+            raise ValueError("sweep params do not cover every replica")
+        if not np.all((self.live >= 0) & (self.live <= self.n)):
+            raise ValueError("sweep live counts outside [0, n]")
+        return self
+
+    def replica(self, r: int) -> Trace:
+        """Replica r as a standalone ``Trace`` (same series, spec =
+        that replica's effective spec when derivable)."""
+        spec = self.spec
+        if spec is not None and (
+            self.kill_jitter[r] or self.loss_scales[r] != 1.0
+        ):
+            spec = replica_spec(
+                ScenarioSpec.from_dict(spec),
+                kill_jitter=self.kill_jitter[r],
+                loss_scale=self.loss_scales[r],
+            ).to_dict()
+        return Trace(
+            metrics={k: v[r] for k, v in self.metrics.items()},
+            converged=self.converged[r],
+            live=self.live[r],
+            loss=self.loss[r],
+            n=self.n,
+            backend=self.backend,
+            start_tick=self.start_tick,
+            spec=spec,
+        )
+
+    # -- per-replica outcome ticks (the sweep's headline statistics) --------
+
+    def detect_ticks(self, metric: str = "faulty_declared") -> np.ndarray:
+        """int[R]: first tick with a faulty declaration, or -1."""
+        hits = self.metrics[metric] > 0
+        any_ = hits.any(axis=1)
+        return np.where(any_, hits.argmax(axis=1), -1).astype(np.int64)
+
+    def heal_ticks(self) -> np.ndarray:
+        """int[R]: first tick from which ``converged`` holds through the
+        end of the run (the cluster healed and stayed healed), or -1."""
+        # length of the all-True suffix, per replica
+        rev = self.converged[:, ::-1]
+        suffix = np.where(
+            rev.all(axis=1), self.ticks, (~rev).argmax(axis=1)
+        )
+        return np.where(suffix > 0, self.ticks - suffix, -1).astype(np.int64)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """Sweep-level stats in ``stats.Histogram.print_obj`` key shape:
+        the detection- and heal-tick distributions across replicas
+        (undetected/unhealed replicas are excluded from the histograms
+        and counted separately)."""
+        out: dict[str, dict[str, Any]] = {}
+        for name, ticks in (
+            ("detect_tick", self.detect_ticks()),
+            ("heal_tick", self.heal_ticks()),
+        ):
+            got = ticks[ticks >= 0]
+            hist = Histogram(sample_size=max(len(got), 1))
+            for v in got:
+                hist.update(float(v))
+            out[name] = hist.print_obj()
+        out["replicas"] = {
+            "count": self.replicas,
+            "detected": int((self.detect_ticks() >= 0).sum()),
+            "healed": int((self.heal_ticks() >= 0).sum()),
+            "converged_final": int(self.converged[:, -1].sum()),
+        }
+        return out
+
+    # -- npz round trip ------------------------------------------------------
+
+    def to_arrays(self, prefix: str = "") -> dict[str, np.ndarray]:
+        arrays = {
+            f"{prefix}converged": self.converged,
+            f"{prefix}live": self.live,
+            f"{prefix}loss": self.loss,
+            f"{prefix}replica_keys": self.replica_keys,
+        }
+        for name, arr in self.metrics.items():
+            arrays[f"{prefix}m.{name}"] = arr
+        return arrays
+
+    def meta(self) -> dict[str, Any]:
+        return {
+            "version": SWEEP_FORMAT_VERSION,
+            "kind": "sweep",
+            "n": self.n,
+            "backend": self.backend,
+            "start_tick": self.start_tick,
+            "loss_scales": list(self.loss_scales),
+            "kill_jitter": list(self.kill_jitter),
+            "spec": self.spec,
+        }
+
+    @classmethod
+    def from_arrays(
+        cls, data: Any, meta: dict[str, Any], prefix: str = ""
+    ) -> "SweepTrace":
+        metrics = {
+            key[len(prefix) + 2:]: np.asarray(data[key])
+            for key in getattr(data, "files", data.keys())
+            if key.startswith(f"{prefix}m.")
+        }
+        return cls(
+            metrics=metrics,
+            converged=np.asarray(data[f"{prefix}converged"]),
+            live=np.asarray(data[f"{prefix}live"]),
+            loss=np.asarray(data[f"{prefix}loss"]),
+            n=meta["n"],
+            backend=meta["backend"],
+            replica_keys=np.asarray(data[f"{prefix}replica_keys"]),
+            loss_scales=meta["loss_scales"],
+            kill_jitter=meta["kill_jitter"],
+            start_tick=meta.get("start_tick", 0),
+            spec=meta.get("spec"),
+        )
+
+    def save(self, path: str) -> None:
+        arrays = self.to_arrays()
+        arrays["meta"] = np.frombuffer(
+            json.dumps(self.meta()).encode(), dtype=np.uint8
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)  # atomic, like Trace.save
+
+    @classmethod
+    def load(cls, path: str) -> "SweepTrace":
+        with np.load(path, allow_pickle=False) as data:
+            meta = json.loads(bytes(data["meta"]).decode())
+            if meta.get("kind") != "sweep":
+                raise ValueError("not a sweep trace (use scenarios.Trace.load)")
+            if meta["version"] != SWEEP_FORMAT_VERSION:
+                raise ValueError(
+                    f"unsupported sweep trace version {meta['version']}"
+                )
+            return cls.from_arrays(data, meta)

@@ -1,0 +1,85 @@
+"""Memory-footprint census regression: the program HBM shapes, pinned.
+
+``benchmarks/mem_census.py`` is the instrument the round-5 worker
+crash was missing — AOT ``memory_analysis()`` of the compiled
+programs.  This test pins the two facts the instrument exists to
+state:
+
+* every censused program (swim_run / delta_run / run_scenario /
+  run_sweep) reports positive argument / temp / peak bytes;
+* at a fixed shape the dense backend's peak is STRICTLY larger than
+  the delta backend's (the entire reason swim_delta exists), and the
+  sweep's argument bytes scale ~R x the single-scenario program's
+  (the donated carry gains a replica axis — sweep.py's memory model).
+
+Slow-marked: each row is a full AOT compile.  Ceil-free assertions
+only (orderings and scalings, not absolute byte budgets — XLA's
+allocator is allowed to improve).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from benchmarks import mem_census as mc
+
+N = 1024
+R = 2
+TICKS = 2
+
+
+@pytest.fixture(scope="module")
+def rows():
+    dense = mc.run(
+        backends=("dense",), ns=(N,), ticks=TICKS, capacity=64,
+        replicas=R, programs=("run", "scenario", "sweep"),
+    )
+    delta = mc.run(
+        backends=("delta",), ns=(N,), ticks=TICKS, capacity=64,
+        replicas=R, programs=("run",),
+    )
+    return {(r["program"], r["backend"]): r for r in dense + delta}
+
+
+@pytest.mark.slow
+def test_census_emits_all_programs(rows):
+    expected = [
+        ("swim_run", "dense"),
+        ("run_scenario", "dense"),
+        ("run_sweep", "dense"),
+        ("delta_run", "delta"),
+    ]
+    for key in expected:
+        row = rows[key]
+        for field in ("argument_bytes", "temp_bytes", "peak_bytes"):
+            assert row[field] > 0, (key, field)
+        assert row["n"] == N
+
+
+@pytest.mark.slow
+def test_census_pins_dense_vs_delta_peak_ordering(rows):
+    """At n=1024, C=64 the dense scan's peak must dominate the delta
+    scan's — measured ~4x apart (57 MB vs 13 MB on CPU jax 0.4.37),
+    asserted with margin.  A flip here means one backend's memory
+    shape changed out from under its scaling story."""
+    dense = rows[("swim_run", "dense")]
+    delta = rows[("delta_run", "delta")]
+    assert dense["peak_bytes"] > 2 * delta["peak_bytes"]
+    assert dense["argument_bytes"] > 4 * delta["argument_bytes"]
+
+
+@pytest.mark.slow
+def test_census_sweep_arguments_scale_with_replicas(rows):
+    """The sweep's donated carry is R x the single-scenario state (the
+    broadcast replica axis), so its argument bytes must be ~R x the
+    scenario program's — the 'R x state, not R x programs' claim in a
+    checkable form.  Temporaries are allowed to scale worse (vmap
+    batches the per-tick scratch too); peak must at least cover R x
+    the single program's arguments."""
+    sweep_row = rows[("run_sweep", "dense")]
+    scen = rows[("run_scenario", "dense")]
+    lo = (R - 0.5) * scen["argument_bytes"]
+    hi = (R + 0.5) * scen["argument_bytes"]
+    assert lo < sweep_row["argument_bytes"] < hi
+    assert sweep_row["peak_bytes"] > R * scen["argument_bytes"]
+    assert sweep_row["replicas"] == R

@@ -1,0 +1,420 @@
+"""Vmapped scenario sweeps: R replicas in one dispatch, per-replica
+bit-parity with standalone ``run_scenario``, SweepTrace plumbing.
+
+Fast lane: the host-side sweep compiler (per-replica spec derivation,
+loss scaling, kill jitter), the key-schedule equivalence (the vmapped
+schedule path must equal the per-replica host chain bit for bit), the
+``SweepTrace`` object on synthetic series, and ONE minimal compiled
+sweep asserting the single-dispatch contract plus replica-0 parity at
+tiny n (the scenario-scan side of that parity shares its compile with
+test_scenario's fast smoke).
+
+Slow lane: the acceptance grid — per-replica bit-parity (trace, final
+state, reference checksums) against standalone ``run_scenario`` from
+the same replica key on BOTH backends, the jitter/scale axes with a
+nonzero base loss, replica-axis sharding across the virtual 8-device
+mesh, and the CLI ``--sweep`` end to end.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios import runner, sweep
+from ringpop_tpu.scenarios.spec import Event, ScenarioSpec
+from ringpop_tpu.scenarios.trace import Trace
+from ringpop_tpu.stats import Histogram
+
+FAST = sim.SwimParams(suspicion_ticks=8)
+N = 12
+TICKS = 40
+# the acceptance scenario shared with test_scenario.py
+SPEC = ScenarioSpec.from_dict(
+    {
+        "ticks": TICKS,
+        "events": [
+            {"at": 5, "op": "kill", "node": 3},
+            {"at": 10, "op": "partition",
+             "groups": [list(range(6)), list(range(6, 12))]},
+            {"at": 10, "op": "loss", "p": 0.08},
+            {"at": 20, "op": "heal"},
+            {"at": 25, "op": "loss_ramp", "until": 30, "to": 0.0},
+        ],
+    }
+)
+
+
+def _states_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(a, b)
+        if x is not None
+    )
+
+
+def _replica_state(states, r):
+    return jax.tree_util.tree_map(lambda a: a[r], states)
+
+
+def _assert_replica_parity(strace, r, cluster_factory, spec_r):
+    """Replica r of a sweep == a standalone run_scenario from the same
+    replica key: trace series, final state, reference checksums."""
+    c2 = cluster_factory()
+    c2.key = jax.numpy.asarray(strace.replica_keys[r])
+    trace = c2.run_scenario(spec_r)
+    np.testing.assert_array_equal(strace.converged[r], trace.converged)
+    np.testing.assert_array_equal(strace.live[r], trace.live)
+    np.testing.assert_array_equal(strace.loss[r], trace.loss)
+    for k in trace.metrics:
+        np.testing.assert_array_equal(strace.metrics[k][r], trace.metrics[k])
+    assert _states_equal(_replica_state(strace.final_states, r), c2.state)
+    probe = cluster_factory()
+    probe.state = _replica_state(strace.final_states, r)
+    probe.net = jax.tree_util.tree_map(lambda a: a[r], strace.final_nets)
+    assert probe.checksums() == c2.checksums()
+
+
+# -- fast: per-replica spec derivation (host-only) --------------------------
+
+
+def test_replica_spec_shifts_kills_and_scales_loss():
+    spec_r = sweep.replica_spec(SPEC, kill_jitter=3, loss_scale=0.5)
+    kills = [e for e in spec_r.events if e.op == "kill"]
+    assert [e.at for e in kills] == [8]  # 5 + 3
+    # non-kill node events / partitions keep their ticks
+    assert [e.at for e in spec_r.events if e.op == "partition"] == [10]
+    losses = {e.at: e.p for e in spec_r.events if e.op == "loss"}
+    assert losses[10] == pytest.approx(0.04)
+    ramps = [e for e in spec_r.events if e.op == "loss_ramp"]
+    assert ramps[0].p == pytest.approx(0.0)
+    # identity fast path returns the same object
+    assert sweep.replica_spec(SPEC) is SPEC
+
+
+def test_replica_spec_rejects_out_of_range_jitter():
+    with pytest.raises(ValueError, match="outside"):
+        sweep.replica_spec(SPEC, kill_jitter=TICKS)
+    with pytest.raises(ValueError, match="outside"):
+        sweep.replica_spec(SPEC, kill_jitter=-6)
+
+
+def test_compile_sweep_stacks_and_validates():
+    cs = sweep.compile_sweep(
+        SPEC, N, replicas=3, base_loss=0.0,
+        loss_scales=[1.0, 0.5, 1.0], kill_jitter=[0, 0, 2],
+    )
+    assert cs.replicas == 3
+    assert cs.ev_tick.shape[0] == 3 and cs.loss.shape == (3, TICKS)
+    # scale halves the loss schedule of replica 1 only
+    loss = np.asarray(cs.loss)
+    assert loss[1, 10] == pytest.approx(loss[0, 10] / 2)
+    # jitter moves replica 2's kill (and with it the boundary set)
+    assert 7 in cs.boundaries[2] and 5 not in cs.boundaries[2]
+    assert cs.boundaries[0] == cs.boundaries[1]
+    with pytest.raises(ValueError, match="one entry per replica"):
+        sweep.compile_sweep(SPEC, N, replicas=3, loss_scales=[1.0])
+    with pytest.raises(ValueError, match="replica 1"):
+        sweep.compile_sweep(SPEC, N, replicas=2, kill_jitter=[0, TICKS])
+    with pytest.raises(ValueError, match="replicas must be"):
+        sweep.compile_sweep(SPEC, N, replicas=0)
+
+
+def test_sweep_key_schedule_matches_host_chain():
+    """The vmapped schedule path (equal boundaries) and the per-replica
+    fallback (jittered boundaries) must both equal the host-side
+    key_schedule over a SimCluster._split chain from the replica key —
+    the contract per-replica parity stands on."""
+    rkeys = list(jax.random.split(jax.random.PRNGKey(3), 2))
+
+    def host_schedule(rkey, compiled):
+        state = {"key": rkey}
+
+        def split():
+            state["key"], sub = jax.random.split(state["key"])
+            return sub
+
+        return scompile.key_schedule(split, compiled)
+
+    # equal boundaries -> one vmapped dispatch
+    cs = sweep.compile_sweep(SPEC, N, replicas=2, base_loss=0.0)
+    keys = sweep.sweep_key_schedule(rkeys, cs)
+    assert keys.shape == (2, TICKS, 2)
+    for r, rkey in enumerate(rkeys):
+        np.testing.assert_array_equal(
+            np.asarray(keys[r]), np.asarray(host_schedule(rkey, cs.base))
+        )
+    # per-replica boundaries (kill jitter) -> host fallback, same contract
+    cs2 = sweep.compile_sweep(
+        SPEC, N, replicas=2, base_loss=0.0, kill_jitter=[0, 2]
+    )
+    keys2 = sweep.sweep_key_schedule(rkeys, cs2)
+    for r, rkey in enumerate(rkeys):
+        np.testing.assert_array_equal(
+            np.asarray(keys2[r]),
+            np.asarray(
+                host_schedule(
+                    rkey, cs2.base._replace(boundaries=cs2.boundaries[r])
+                )
+            ),
+        )
+    with pytest.raises(ValueError, match="replica keys"):
+        sweep.sweep_key_schedule(rkeys[:1], cs)
+
+
+# -- fast: SweepTrace on synthetic series -----------------------------------
+
+
+def _synthetic_sweep(r: int = 3, t: int = 6) -> sweep.SweepTrace:
+    conv = np.zeros((r, t), bool)
+    conv[0, 4:] = True  # heals at tick 4
+    conv[1, 2] = True  # converged once, then diverges again -> no heal
+    fd = np.zeros((r, t), np.int32)
+    fd[0, 3] = 1  # detects at tick 3
+    fd[2, 1] = 2  # detects at tick 1
+    return sweep.SweepTrace(
+        metrics={"faulty_declared": fd,
+                 "pings_sent": np.ones((r, t), np.int32)},
+        converged=conv,
+        live=np.full((r, t), 7, np.int32),
+        loss=np.zeros((r, t), np.float32),
+        n=8,
+        backend="dense",
+        replica_keys=np.arange(2 * r, dtype=np.uint32).reshape(r, 2),
+        loss_scales=[1.0] * r,
+        kill_jitter=[0] * r,
+        start_tick=5,
+        spec={"ticks": t, "events": []},
+    )
+
+
+def test_sweep_trace_outcome_ticks():
+    st = _synthetic_sweep()
+    assert st.detect_ticks().tolist() == [3, -1, 1]
+    assert st.heal_ticks().tolist() == [4, -1, -1]
+
+
+def test_sweep_trace_summary_is_stats_key_compatible():
+    st = _synthetic_sweep()
+    summary = st.summary()
+    hist_keys = set(Histogram().print_obj().keys())
+    assert set(summary["detect_tick"].keys()) == hist_keys
+    assert set(summary["heal_tick"].keys()) == hist_keys
+    assert summary["detect_tick"]["min"] == 1.0
+    assert summary["detect_tick"]["max"] == 3.0
+    assert summary["heal_tick"]["median"] == 4.0
+    assert summary["replicas"] == {
+        "count": 3, "detected": 2, "healed": 1, "converged_final": 1
+    }
+
+
+def test_sweep_trace_npz_roundtrip(tmp_path):
+    st = _synthetic_sweep()
+    path = str(tmp_path / "sweep.npz")
+    st.save(path)
+    back = sweep.SweepTrace.load(path).validate()
+    assert back.replicas == 3 and back.ticks == 6
+    assert back.backend == "dense" and back.n == 8 and back.start_tick == 5
+    assert back.loss_scales == (1.0, 1.0, 1.0)
+    assert back.kill_jitter == (0, 0, 0)
+    assert back.spec == st.spec
+    np.testing.assert_array_equal(back.converged, st.converged)
+    np.testing.assert_array_equal(back.replica_keys, st.replica_keys)
+    np.testing.assert_array_equal(
+        back.metrics["faulty_declared"], st.metrics["faulty_declared"]
+    )
+    # a sweep npz is not a Trace npz and vice versa
+    with pytest.raises(ValueError, match="not a sweep trace"):
+        trace_path = str(tmp_path / "trace.npz")
+        Trace(
+            metrics={}, converged=np.ones(3, bool), live=np.full(3, 8),
+            loss=np.zeros(3), n=8, backend="dense",
+        ).save(trace_path)
+        sweep.SweepTrace.load(trace_path)
+
+
+def test_sweep_trace_replica_extraction():
+    st = _synthetic_sweep()
+    tr = st.replica(2).validate()
+    assert isinstance(tr, Trace)
+    assert tr.ticks == 6 and tr.backend == "dense"
+    np.testing.assert_array_equal(
+        tr.metrics["faulty_declared"], st.metrics["faulty_declared"][2]
+    )
+
+
+def test_sweep_trace_validate_rejects_ragged():
+    st = _synthetic_sweep()
+    st.metrics["pings_sent"] = np.zeros((3, 4), np.int32)
+    with pytest.raises(ValueError, match="not .*-shaped"):
+        st.validate()
+
+
+# -- fast: one minimal compiled sweep (the single-dispatch contract) --------
+
+
+def test_sweep_single_dispatch_and_replica_parity(monkeypatch):
+    """R=2 replicas in ONE vmapped dispatch: no swim_step/swim_run
+    dispatch, the sweep counter advances once, the cluster itself does
+    not move, and replica 0 is bit-identical to a standalone
+    run_scenario from the same replica key (same tiny shape as
+    test_scenario's fast smoke, so the scenario-scan compile is
+    shared in-process)."""
+
+    def boom(*a, **k):  # pragma: no cover - would mean a host round-trip
+        raise AssertionError("host-loop dispatch inside run_sweep")
+
+    monkeypatch.setattr(sim, "swim_step", boom)
+    monkeypatch.setattr(sim, "swim_run", boom)
+    spec = {"ticks": 4, "events": [{"at": 1, "op": "kill", "node": 5}]}
+    params = sim.SwimParams(suspicion_ticks=5)
+    before = sweep.dispatch_count()
+    before_scan = runner.dispatch_count()
+    c = SimCluster(6, params, seed=1)
+    state_before = jax.tree_util.tree_map(np.asarray, c.state)
+    strace = c.run_sweep(spec, 2)
+    assert sweep.dispatch_count() - before == 1
+    assert runner.dispatch_count() == before_scan  # no per-replica scan
+    assert strace.replicas == 2 and strace.ticks == 4
+    assert strace.live.tolist() == [[6, 5, 5, 5]] * 2
+    assert all(arr.shape == (2, 4) for arr in strace.metrics.values())
+    # the sweep is a measurement fan-out: the cluster did not advance,
+    # nothing was appended to the telemetry log, only the key moved
+    assert _states_equal(c.state, state_before)
+    assert c.metrics_log == [] and c.traces == []
+    monkeypatch.undo()
+    _assert_replica_parity(
+        strace, 0, lambda: SimCluster(6, params, seed=1),
+        ScenarioSpec.from_dict(spec),
+    )
+
+
+def test_sweep_revive_rejected_on_delta_without_key_burn():
+    spec = ScenarioSpec(ticks=4, events=(Event(at=1, op="revive", node=0),))
+    c = SimCluster(8, FAST, seed=0, backend="delta", capacity=8)
+    key_before = np.asarray(c.key).copy()
+    with pytest.raises(NotImplementedError, match="dense-backend-only"):
+        c.run_sweep(spec, 2)
+    np.testing.assert_array_equal(np.asarray(c.key), key_before)
+
+
+# -- slow: the acceptance grid ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_sweep_dense_parity_every_replica():
+    """Each of R=3 replicas of the acceptance scenario is bit-identical
+    (trace, final state, reference checksums) to a standalone
+    run_scenario from that replica's key."""
+    c = SimCluster(N, FAST, seed=3)
+    strace = c.run_sweep(SPEC, 3)
+    for r in range(3):
+        _assert_replica_parity(
+            strace, r, lambda: SimCluster(N, FAST, seed=3), SPEC
+        )
+
+
+@pytest.mark.slow
+def test_sweep_delta_parity_every_replica():
+    """The same contract on the delta backend (ample caps, the
+    test_swim_delta netsplit convention)."""
+
+    def factory():
+        return SimCluster(
+            N, FAST, seed=3, backend="delta",
+            capacity=N, wire_cap=N, claim_grid=3 * N * N,
+        )
+
+    c = factory()
+    strace = c.run_sweep(SPEC, 2)
+    assert strace.backend == "delta"
+    for r in range(2):
+        _assert_replica_parity(strace, r, factory, SPEC)
+
+
+@pytest.mark.slow
+def test_sweep_jitter_and_scale_parity():
+    """The per-replica batch axes: replica r with loss scale s and kill
+    jitter j equals a standalone run_scenario of replica_spec(spec, j,
+    s) with base loss scaled by s — including a nonzero base loss."""
+    base = sim.SwimParams(suspicion_ticks=8, loss=0.02)
+    scales, jitters = [1.0, 0.5, 2.0], [0, 2, -1]
+    c = SimCluster(N, base, seed=7)
+    strace = c.run_sweep(SPEC, 3, loss_scales=scales, kill_jitter=jitters)
+    assert strace.loss_scales == (1.0, 0.5, 2.0)
+    assert strace.kill_jitter == (0, 2, -1)
+    for r, (s, j) in enumerate(zip(scales, jitters)):
+        spec_r = sweep.replica_spec(SPEC, kill_jitter=j, loss_scale=s)
+
+        def factory(s=s):
+            c2 = SimCluster(N, base, seed=7)
+            c2.set_loss(base.loss * s)
+            return c2
+
+        _assert_replica_parity(strace, r, factory, spec_r)
+
+
+@pytest.mark.slow
+def test_sweep_sharded_matches_unsharded():
+    """shard=True splits the replica axis across the virtual 8-device
+    mesh (conftest) — replicas are data-parallel, so the sharded run is
+    bit-identical to the unsharded one."""
+    if jax.local_device_count() < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    r = jax.local_device_count()
+    a = SimCluster(N, FAST, seed=5)
+    plain = a.run_sweep(SPEC, r)
+    b = SimCluster(N, FAST, seed=5)
+    sharded = b.run_sweep(SPEC, r, shard=True)
+    np.testing.assert_array_equal(plain.converged, sharded.converged)
+    np.testing.assert_array_equal(plain.live, sharded.live)
+    for k in plain.metrics:
+        np.testing.assert_array_equal(plain.metrics[k], sharded.metrics[k])
+    assert _states_equal(
+        jax.tree_util.tree_map(np.asarray, plain.final_states),
+        jax.tree_util.tree_map(np.asarray, sharded.final_states),
+    )
+
+
+def test_sweep_shard_rejects_indivisible_replicas_without_key_burn():
+    """The static shard rejection fires BEFORE the replica keys draw
+    (the run_scenario failed-call contract): a corrected retry on the
+    same cluster must replay from an unmoved key."""
+    if jax.local_device_count() < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    c = SimCluster(N, FAST, seed=5)
+    key_before = np.asarray(c.key).copy()
+    with pytest.raises(ValueError, match="divisible"):
+        c.run_sweep(SPEC, jax.local_device_count() + 1, shard=True)
+    np.testing.assert_array_equal(np.asarray(c.key), key_before)
+
+
+@pytest.mark.slow
+def test_cli_sweep_end_to_end(tmp_path, capsys):
+    """tick-cluster --scenario F --sweep R: one vmapped dispatch,
+    summary line, SweepTrace npz export."""
+    from ringpop_tpu.cli.tick_cluster import main
+
+    spec_path = str(tmp_path / "spec.json")
+    trace_path = str(tmp_path / "sweep.npz")
+    ScenarioSpec.from_dict(
+        {"ticks": 10, "events": [{"at": 2, "op": "kill", "node": 3}]}
+    ).save(spec_path)
+    before = sweep.dispatch_count()
+    main([
+        "--backend", "tpu-sim", "-n", "8",
+        "--scenario", spec_path, "--sweep", "3",
+        "--sweep-loss-scales", "1.0,1.0,0.5",
+        "--trace-out", trace_path,
+    ])
+    assert sweep.dispatch_count() - before == 1
+    out = capsys.readouterr().out
+    assert "one vmapped dispatch" in out
+    strace = sweep.SweepTrace.load(trace_path).validate()
+    assert strace.replicas == 3 and strace.ticks == 10
+    assert strace.loss_scales == (1.0, 1.0, 0.5)
+    assert strace.live[:, -1].tolist() == [7, 7, 7]
