@@ -26,6 +26,18 @@ import subprocess
 import sys
 import time
 
+# The dispatch ledger (ringpop_tpu/obs/ledger.py) is jax-free at import,
+# so the parent can record forensics rows without touching a backend.
+from ringpop_tpu.obs.ledger import ENV_VAR as LEDGER_ENV
+from ringpop_tpu.obs.ledger import default_ledger
+
+# Every probe and rung leaves a JSON line here (overridable via
+# RINGPOP_LEDGER): the next "accelerator probe timed out after 240s"
+# failure ships its own forensics instead of needing a repro session.
+DEFAULT_LEDGER_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_ledger.jsonl"
+)
+
 REFERENCE_ROUNDS_PER_NODE_SEC = 5.0  # 200 ms protocol period
 TICKS_PER_CALL = 20
 # The delta tick is ~10-100x cheaper than a dense tick, so its batch is
@@ -178,8 +190,10 @@ def bench_once(n: int, layout: str = "dense") -> float:
     calls_per_batch = ticks_per_batch // ticks_per_step
     keys = jax.random.split(key, (repeats + 1) * calls_per_batch)
     print(f"# compiling {layout} n={n}", file=sys.stderr, flush=True)
+    t_cold = time.perf_counter()
     state, metrics = step(state, net, keys[0], params)
     _sync(metrics)
+    cold_s = time.perf_counter() - t_cold
     it = iter(keys[1:])
     for _ in range(calls_per_batch - 1):  # warm the steady-state timing
         state, metrics = step(state, net, next(it), params)
@@ -193,6 +207,28 @@ def bench_once(n: int, layout: str = "dense") -> float:
         dt = time.perf_counter() - t0
         best = max(best, ticks_per_batch * n / dt)
         print(f"# {layout} n={n}: {best:.0f} node-rounds/s", file=sys.stderr, flush=True)
+    # per-rung ledger row: the compile-vs-execute split the round-5
+    # triage lacked.  cold_total_s is the measured first dispatch
+    # (compile + one call's execution + sync); execute_s the warm
+    # per-call time at the best rate, so compile_s is their difference
+    # — an estimate, but one measured on the production call path
+    # instead of an AOT replay that would double-compile on TPU.
+    warm_call_s = ticks_per_step * n / best
+    default_ledger().record(
+        {
+            "program": "bench_rung",
+            "backend": layout,
+            "platform": jax.default_backend(),
+            "n": n,
+            "ticks": ticks_per_step,
+            "replicas": 1,
+            "cold": True,
+            "cold_total_s": round(cold_s, 3),
+            "compile_s": round(max(cold_s - warm_call_s, 0.0), 3),
+            "execute_s": round(warm_call_s, 6),
+            "node_rounds_per_sec": round(best, 1),
+        }
+    )
     if layout.startswith("delta"):
         drops = int(metrics["overflow_drops"])
         print(
@@ -280,10 +316,22 @@ def child_main(attempts: list[tuple[str, int]]) -> None:
 
     pin_cpu_if_requested()
     enable_compilation_cache()
+
+    def _measure(n: int, layout: str) -> float:
+        profile_dir = os.environ.get("RINGPOP_PROFILE_DIR")
+        if not profile_dir:
+            return bench_once(n, layout)
+        from ringpop_tpu.obs.annotate import profile_trace
+
+        # per-attempt run directories so a retried size doesn't clobber
+        # the trace of the one that worked
+        with profile_trace(os.path.join(profile_dir, f"{layout}_n{n}")):
+            return bench_once(n, layout)
+
     last_err = None
     for layout, n in attempts:
         try:
-            value = bench_once(n, layout)
+            value = _measure(n, layout)
         except Exception as e:
             # Recoverable per-attempt failures fall through to the next
             # attempt: OOM (shrink the cluster) and delta capacity
@@ -342,7 +390,12 @@ def _run_child(args: list[str], env: dict, timeout: int) -> tuple[int | None, st
 
 
 def _probe_tpu() -> str | None:
-    """Can the ambient accelerator initialize and run a matmul? -> error or None."""
+    """Can the ambient accelerator initialize and run a matmul? -> error or None.
+
+    Every probe (initial and post-timeout re-probes) leaves a ledger
+    row with its measured duration: a wedged tunnel's 240 s timeout is
+    then a recorded fact, not a line lost in CI stderr."""
+    t0 = time.perf_counter()
     rc, out, err = _run_child(
         [
             "-c",
@@ -352,12 +405,25 @@ def _probe_tpu() -> str | None:
         env=dict(os.environ),
         timeout=PROBE_TIMEOUT_S,
     )
+    duration = time.perf_counter() - t0
     if rc == 0:
-        return None
-    if rc is None:
-        return f"accelerator probe timed out after {PROBE_TIMEOUT_S}s"
-    tail = (err or out).strip().splitlines()[-1:] or ["no output"]
-    return f"accelerator probe failed (rc={rc}): {tail[0][:300]}"
+        result = None
+    elif rc is None:
+        result = f"accelerator probe timed out after {PROBE_TIMEOUT_S}s"
+    else:
+        tail = (err or out).strip().splitlines()[-1:] or ["no output"]
+        result = f"accelerator probe failed (rc={rc}): {tail[0][:300]}"
+    default_ledger().record(
+        {
+            "program": "accelerator_probe",
+            "platform": "parent",
+            "execute_s": round(duration, 3),
+            "timeout_s": PROBE_TIMEOUT_S,
+            "ok": rc == 0,
+            "error": result,
+        }
+    )
+    return result
 
 
 def _is_worker_crash(err: str | None) -> bool:
@@ -390,8 +456,27 @@ def _extract_json(stdout: str) -> dict | None:
     return None
 
 
+def _emit(result: dict) -> None:
+    """The one JSON line of the bench contract, now carrying the path
+    to its own forensics (the dispatch ledger)."""
+    result.setdefault("ledger", default_ledger().path)
+    print(json.dumps(result), flush=True)
+
+
 def main() -> None:
     errors = []
+
+    # Ledger first: the probe row below must land in it, and children
+    # inherit the path via the environment.  The default file is
+    # truncated per run — "the" probe timeout must be THIS run's, not a
+    # mix of stale rows (a user-supplied RINGPOP_LEDGER is theirs to
+    # manage and is appended to).
+    ledger_path = os.environ.get(LEDGER_ENV)
+    if not ledger_path:
+        ledger_path = DEFAULT_LEDGER_PATH
+        open(ledger_path, "w").close()
+    os.environ[LEDGER_ENV] = ledger_path
+    default_ledger().enable(ledger_path)
 
     tpu_err = _probe_tpu()
     if tpu_err is None:
@@ -529,12 +614,12 @@ def main() -> None:
                         break
         if best_pass is not None:
             best_pass.pop("_n", None)
-            print(json.dumps(best_pass), flush=True)
+            _emit(best_pass)
             return
         if fallback is not None:
             # No rung cleared 1.0; report the best on-chip number rather
             # than falling through to CPU.
-            print(json.dumps(fallback), flush=True)
+            _emit(fallback)
             return
     else:
         errors.append(tpu_err)
@@ -564,7 +649,7 @@ def main() -> None:
                 "scale reached on the fallback host"
             )
             result["error"] = "; ".join(errors)
-            print(json.dumps(result), flush=True)
+            _emit(result)
             return
         reason = (
             f"timed out after {rung_timeout}s" if rc is None else f"rc={rc}"
@@ -587,23 +672,20 @@ def main() -> None:
         _echo_child_stderr(err)
         result["platform"] = "cpu-fallback"
         result["error"] = "; ".join(errors)
-        print(json.dumps(result), flush=True)
+        _emit(result)
         return
 
     reason = f"timed out after {CPU_BENCH_TIMEOUT_S}s" if rc is None else f"rc={rc}"
     tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
     errors.append(f"cpu bench {reason}: {tail[0][:300]}")
-    print(
-        json.dumps(
-            {
-                "metric": "swim_sim_node_rounds_per_sec",
-                "value": 0,
-                "unit": "node-rounds/s",
-                "vs_baseline": 0.0,
-                "error": "; ".join(errors),
-            }
-        ),
-        flush=True,
+    _emit(
+        {
+            "metric": "swim_sim_node_rounds_per_sec",
+            "value": 0,
+            "unit": "node-rounds/s",
+            "vs_baseline": 0.0,
+            "error": "; ".join(errors),
+        }
     )
 
 
@@ -612,8 +694,26 @@ def _parse_attempt(s: str) -> tuple[str, int]:
     return (layout, int(n)) if n else ("dense", int(layout))
 
 
+def _pop_flag(argv: list[str], name: str) -> str | None:
+    """Extract ``--name VALUE`` from argv (the bench's arg surface is
+    deliberately tiny; argparse would impose structure the --child
+    protocol doesn't have)."""
+    if name in argv:
+        i = argv.index(name)
+        if i + 1 < len(argv):
+            value = argv[i + 1]
+            del argv[i:i + 2]
+            return value
+    return None
+
+
 if __name__ == "__main__":
-    if len(sys.argv) > 2 and sys.argv[1] == "--child":
-        child_main([_parse_attempt(s) for s in sys.argv[2].split(",")])
+    _argv = sys.argv[1:]
+    _profile_dir = _pop_flag(_argv, "--profile-dir")
+    if _profile_dir:
+        # children do the actual measuring, so they write the traces
+        os.environ["RINGPOP_PROFILE_DIR"] = os.path.abspath(_profile_dir)
+    if len(_argv) > 1 and _argv[0] == "--child":
+        child_main([_parse_attempt(s) for s in _argv[1].split(",")])
     else:
         main()

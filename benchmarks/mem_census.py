@@ -58,26 +58,14 @@ def _spec_dict(ticks: int) -> dict:
     }
 
 
-def _stats_row(compiled: Any) -> dict[str, int]:
-    ma = compiled.memory_analysis()
-    arg = int(ma.argument_size_in_bytes)
-    out = int(ma.output_size_in_bytes)
-    temp = int(ma.temp_size_in_bytes)
-    alias = int(ma.alias_size_in_bytes)
-    explicit_peak = int(getattr(ma, "peak_memory_in_bytes", 0) or 0)
-    return {
-        "argument_bytes": arg,
-        "output_bytes": out,
-        "temp_bytes": temp,
-        "alias_bytes": alias,
-        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
-        "peak_bytes": explicit_peak or (arg + out + temp - alias),
-        "peak_is_derived": not explicit_peak,
-    }
+# The memory_analysis flattening now lives in the dispatch ledger
+# (obs/ledger.py) — the same field set every ledgered dispatch records,
+# so a census row and a runtime ledger row diff key-for-key.
+from ringpop_tpu.obs.ledger import memory_row  # noqa: E402
 
 
 def _census(jitted, *args, **kwargs) -> dict[str, int]:
-    return _stats_row(jitted.lower(*args, **kwargs).compile())
+    return memory_row(jitted.lower(*args, **kwargs).compile())
 
 
 def _dense_fixture(n: int):

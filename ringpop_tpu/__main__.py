@@ -6,6 +6,7 @@ scripts/generate-hosts.js):
   worker          run one node (main.js parity)
   tick-cluster    multi-node harness & fault injector
   generate-hosts  write a hosts.json
+  obs-ledger      summarize a dispatch-ledger .jsonl (obs/ledger.py)
 """
 
 from __future__ import annotations
@@ -29,6 +30,10 @@ def main() -> None:
         from ringpop_tpu.cli.generate_hosts import main as hosts_main
 
         hosts_main(rest)
+    elif command == "obs-ledger":
+        from ringpop_tpu.obs.ledger import main as ledger_main
+
+        ledger_main(rest)
     else:
         print(__doc__)
         sys.exit(0 if command in (None, "-h", "--help") else 1)

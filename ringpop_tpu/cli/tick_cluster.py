@@ -42,6 +42,19 @@ from ringpop_tpu.cli.admin_client import AdminRequestError, admin_request
 from ringpop_tpu.cli.generate_hosts import generate
 
 
+def print_op_percentiles(protocol: dict[str, Any], indent: str = "    ") -> None:
+    """The per-operation p50/p95/p99 lines of the `p` command, shared
+    by the proc and host-sim drivers (get_stats()['protocol'] shape)."""
+    for op in ("ping", "pingReq"):
+        agg = protocol.get(op)
+        if agg and agg.get("count"):
+            print(
+                f"{indent}{op}: p50={agg['median']:.1f}"
+                f" p95={agg['p95']:.1f} p99={agg['p99']:.1f}"
+                f" count={agg['count']}"
+            )
+
+
 def group_by_checksum(checksums: dict[str, Any]) -> dict[Any, list[str]]:
     """tick-cluster.js:100-113: hosts grouped by membership checksum."""
     groups: dict[Any, list[str]] = {}
@@ -191,6 +204,7 @@ class ProcCluster(ClusterDriver):
                     f" p50={timing['median']:.1f} p95={timing['p95']:.1f}"
                     f" p99={timing['p99']:.1f} count={timing['count']}"
                 )
+                print_op_percentiles(r["protocol"])
             else:
                 print(f"  {hp}: {r}")
 
@@ -305,6 +319,7 @@ class SimCluster(ClusterDriver):
                 f"  {node.host_port}: p50={timing['median']:.1f}"
                 f" p95={timing['p95']:.1f} count={timing['count']}"
             )
+            print_op_percentiles(stats["protocol"])
 
     def debug_set(self, flag: str) -> None:
         for node in self.cluster.live_nodes():
@@ -361,7 +376,7 @@ class TpuSimCluster(ClusterDriver):
     def __init__(self, size: int, seed: int = 1, loss: float = 0.0,
                  damping: bool = False, sparse_cap: int = 0,
                  probe: str = "sweep", layout: str = "dense",
-                 capacity: int = 256):
+                 capacity: int = 256, stats_out: str | None = None):
         import jax
 
         # The environment may pre-register a TPU plugin and pin
@@ -390,8 +405,10 @@ class TpuSimCluster(ClusterDriver):
 
         from ringpop_tpu.models import swim_sim as sim
         from ringpop_tpu.models.cluster import SimCluster
+        from ringpop_tpu.obs.emitters import make_emitter
 
         self.sim = sim
+        self.stats_emitter = make_emitter(stats_out) if stats_out else None
         self.cluster = SimCluster(
             size,
             sim.SwimParams(loss=loss, sparse_cap=sparse_cap, probe=probe),
@@ -399,6 +416,7 @@ class TpuSimCluster(ClusterDriver):
             damping=damping,
             backend=layout,
             capacity=capacity,
+            stats_emitter=self.stats_emitter,
         )
         self._suspended: list[int] = []
         self._killed: list[int] = []
@@ -472,7 +490,8 @@ class TpuSimCluster(ClusterDriver):
         self.cluster.tick(ticks)
 
     def shutdown(self) -> None:
-        pass
+        if self.stats_emitter is not None:
+            self.stats_emitter.close()
 
     def run_scenario(
         self,
@@ -543,6 +562,28 @@ class TpuSimCluster(ClusterDriver):
                 f"sweep trace ({replicas} x {strace.ticks} x "
                 f"{len(strace.metrics) + 3} series) -> {trace_out}"
             )
+        if self.cluster.stats_sink is not None:
+            # run_sweep is a measurement fan-out, not the cluster's own
+            # trajectory, so SimCluster does not bridge it; stream one
+            # representative replica so --stats-out still observes it.
+            # The cluster state did not advance, so its current
+            # checksum (the sweep's shared starting point) is the
+            # honest value for the checksum gauge.
+            from ringpop_tpu.obs import bridge as obs_bridge
+
+            checksum = None
+            live = self.cluster.live_indices()
+            if live.size:
+                first = int(live[0])
+                checksum = self.cluster.checksums(indices=[first])[
+                    self.cluster.book.addresses[first]
+                ]
+            sink = self.cluster.stats_sink
+            obs_bridge.replay_trace(
+                strace.replica(0), sink.emitter, prefix=sink.prefix,
+                checksum=checksum,
+            )
+            print("stats: bridged sweep replica 0 to --stats-out")
 
 
 MENU = """commands:
@@ -635,6 +676,18 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                         help="with --sweep: comma list of R per-replica "
                              "tick offsets applied to the spec's kill "
                              "events")
+    parser.add_argument("--stats-out", default=None, metavar="SPEC",
+                        help="tpu-sim: stream protocol stats under "
+                             "reference statsd keys (obs/bridge.py key "
+                             "table) to SPEC — a JSON-lines file path, "
+                             "'-' (stdout), or statsd://HOST:PORT (UDP "
+                             "line protocol); ticks stream as they run, "
+                             "--scenario replays its whole trace")
+    parser.add_argument("--profile-dir", default=None, metavar="DIR",
+                        help="tpu-sim: bracket the run with a jax "
+                             "profiler trace written to DIR "
+                             "(TensorBoard/Perfetto-loadable, protocol "
+                             "phases named via obs/annotate.py scopes)")
     parser.add_argument("--script-to-scenario", default=None, metavar="FILE",
                         help="compile --script into a scenario spec JSON at "
                              "FILE and exit (no cluster is started)")
@@ -669,6 +722,11 @@ def main(argv: list[str] | None = None) -> None:
     if args.sweep and not args.scenario:
         parser.error("--sweep needs --scenario (it replicates a compiled "
                      "scenario, not an interactive session)")
+    if (args.stats_out or args.profile_dir) and backend != "tpu-sim":
+        parser.error("--stats-out/--profile-dir need --backend tpu-sim "
+                     "(the obs bridge and profiler scopes instrument the "
+                     "tensor simulation; proc nodes inject a statsd "
+                     "emitter via RingPop(statsd=...))")
     sweep_scales = sweep_jitter = None
     if args.sweep_loss_scales is not None:
         sweep_scales = [float(x) for x in args.sweep_loss_scales.split(",")]
@@ -681,24 +739,35 @@ def main(argv: list[str] | None = None) -> None:
         driver = TpuSimCluster(args.size, seed=args.seed, loss=args.loss,
                                sparse_cap=args.sparse_cap, probe=args.probe,
                                damping=args.damping, layout=args.layout,
-                               capacity=args.capacity)
+                               capacity=args.capacity,
+                               stats_out=args.stats_out)
     else:
         cluster = ProcCluster(args.size, args.base_port,
                               log_level=args.log_level)
         cluster.wait_healthy(args.startup_timeout_s)
         driver = cluster
 
+    import contextlib
+
+    profile_ctx: Any = contextlib.nullcontext()
+    if args.profile_dir:
+        from ringpop_tpu.obs.annotate import profile_trace
+
+        profile_ctx = profile_trace(args.profile_dir)
     try:
-        if args.scenario:
-            driver.run_scenario(
-                args.scenario, args.trace_out, sweep=args.sweep,
-                sweep_loss_scales=sweep_scales,
-                sweep_kill_jitter=sweep_jitter,
-            )
-        elif args.script:
-            run_script(driver, args.script)
-        else:
-            run_interactive(driver)
+        with profile_ctx:
+            if args.scenario:
+                driver.run_scenario(
+                    args.scenario, args.trace_out, sweep=args.sweep,
+                    sweep_loss_scales=sweep_scales,
+                    sweep_kill_jitter=sweep_jitter,
+                )
+            elif args.script:
+                run_script(driver, args.script)
+            else:
+                run_interactive(driver)
+        if args.profile_dir:
+            print(f"profiler trace -> {args.profile_dir}")
     finally:
         driver.shutdown()
 

@@ -24,6 +24,8 @@ from ringpop_tpu.hashring import HashRing
 from ringpop_tpu.models import checksum as cksum
 from ringpop_tpu.models import swim_delta as sdelta
 from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.obs import bridge as obs_bridge
+from ringpop_tpu.obs.ledger import default_ledger
 from ringpop_tpu.ops import checksum_device as ckdev
 from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 
@@ -61,6 +63,8 @@ class SimCluster:
         capacity: int = 256,
         wire_cap: int = 16,
         claim_grid: int = 64,
+        stats_emitter: Any | None = None,
+        stats_prefix: str = obs_bridge.DEFAULT_PREFIX,
     ):
         """``backend='dense'``: the N x N state (swim_sim.py) — every
         scenario incl. partitions and mode='self' bootstrap.
@@ -70,7 +74,10 @@ class SimCluster:
         netsplits and init='self' bootstraps when ``capacity`` is sized
         for their ~n-wide transitions;
         ``capacity``/``wire_cap``/``claim_grid`` are its resource
-        caps."""
+        caps.  ``stats_emitter`` (any ``increment/gauge/timing`` sink,
+        obs/emitters.py) receives every tick's protocol counters and
+        every scenario trace under reference-parity statsd key names
+        via the Trace→stats bridge (obs/bridge.py)."""
         if backend not in ("dense", "delta"):
             raise ValueError(f"unknown backend: {backend!r}")
         if backend == "delta" and damping:
@@ -104,6 +111,11 @@ class SimCluster:
         self.key = jax.random.PRNGKey(seed)
         self.metrics_log: list[dict[str, int]] = []
         self.traces: list[Any] = []  # scenarios.Trace per run_scenario
+        self.stats_sink = (
+            obs_bridge.StatSink(stats_emitter, stats_prefix)
+            if stats_emitter is not None
+            else None
+        )
         self._device_book = None  # lazy ckdev.DeviceBook (device checksums)
         if device is not None:
             self.state = jax.device_put(self.state, device)
@@ -120,23 +132,39 @@ class SimCluster:
         return sub
 
     def tick(self, ticks: int = 1) -> dict[str, int]:
-        """Advance every node ``ticks`` protocol periods."""
+        """Advance every node ``ticks`` protocol periods.
+
+        Dispatches route through the obs ledger (a call-through while
+        it is disabled, the default); with a ``stats_emitter`` the
+        returned counters also stream out under reference statsd keys.
+        """
+        led = default_ledger()
+        meta = {"backend": self.backend, "n": self.n, "ticks": ticks,
+                "replicas": 1}
         if self.backend == "delta":
             if ticks == 1:
-                self.state, metrics = sdelta.delta_step(
-                    self.state, self.net, self._split(), self.dparams
+                self.state, metrics = led.dispatch(
+                    "delta_step", sdelta.delta_step,
+                    self.state, self.net, self._split(),
+                    params=self.dparams, _meta=meta,
                 )
             else:
-                self.state, metrics = sdelta.delta_run(
-                    self.state, self.net, self._split(), self.dparams, ticks
+                self.state, metrics = led.dispatch(
+                    "delta_run", sdelta.delta_run,
+                    self.state, self.net, self._split(),
+                    params=self.dparams, ticks=ticks, _meta=meta,
                 )
         elif ticks == 1:
-            self.state, metrics = sim.swim_step(
-                self.state, self.net, self._split(), self.params
+            self.state, metrics = led.dispatch(
+                "swim_step", sim.swim_step,
+                self.state, self.net, self._split(),
+                params=self.params, _meta=meta,
             )
         else:
-            self.state, metrics = sim.swim_run(
-                self.state, self.net, self._split(), self.params, ticks
+            self.state, metrics = led.dispatch(
+                "swim_run", sim.swim_run,
+                self.state, self.net, self._split(),
+                params=self.params, ticks=ticks, _meta=meta,
             )
         out = {k: int(v) for k, v in metrics.items()}
         # multi-tick entries report only the LAST tick's counters (the
@@ -145,6 +173,10 @@ class SimCluster:
         # per-tick time series)
         out["ticks"] = int(ticks)
         self.metrics_log.append(out)
+        if self.stats_sink is not None:
+            obs_bridge.emit_counters(
+                out, self.stats_sink, live=len(self.live_indices())
+            )
         return out
 
     def run_scenario(self, spec) -> Any:
@@ -203,6 +235,22 @@ class SimCluster:
         entry = {k: int(v[-1]) for k, v in trace.metrics.items()}
         entry["ticks"] = spec.ticks
         self.metrics_log.append(entry)
+        if self.stats_sink is not None:
+            # replay the whole per-tick series under reference statsd
+            # keys, closing with the post-run membership checksum gauge
+            # (one live row through the host kernel — cheap)
+            live = self.live_indices()
+            checksum = None
+            if live.size:
+                checksum = self.checksums(indices=[int(live[0])])[
+                    self.book.addresses[int(live[0])]
+                ]
+            obs_bridge.replay_trace(
+                trace,
+                self.stats_sink.emitter,
+                prefix=self.stats_sink.prefix,
+                checksum=checksum,
+            )
         return trace
 
     def run_sweep(

@@ -103,6 +103,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ringpop_tpu.obs import annotate
 from ringpop_tpu.models.swim_sim import (
     ALIVE,
     FAULTY,
@@ -554,6 +555,7 @@ def refresh_carried(state: DeltaState) -> DeltaState:
     return state._replace(d_bpmask=None, d_bprank=None)
 
 
+@annotate.scoped("delta.refresh")
 def _refresh_in_step(state: DeltaState) -> DeltaState:
     """Wholesale recompute of the carried derivatives INSIDE the step
     (the full-sync flip path).  Keys the slot-base recompute on the
@@ -668,6 +670,7 @@ def _windowed_changes(
     return jax.lax.cond(jnp.any(within), compacted, quiet, None)
 
 
+@annotate.scoped("delta.select")
 def _selection(
     state: DeltaState,
     stats: _Stats,
@@ -814,6 +817,7 @@ class _MergeOut(NamedTuple):
     dropped: jax.Array  # int32[] claims lost to table capacity
 
 
+@annotate.scoped("delta.merge_claims")
 def _merge_claims(
     state: DeltaState,
     c_subj: jax.Array,  # int32[N, K] subject per claim, ascending per row, SENTINEL pad
@@ -1055,6 +1059,7 @@ def _run_bounds(sorted_vals: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
     return bounds[:-1], bounds[1:]
 
 
+@annotate.scoped("delta.route_claims")
 def _route_claims(
     n: int,
     send_subj: jax.Array,  # int32[N, W] sender's claim subjects (SENTINEL pad)
@@ -1576,7 +1581,14 @@ def delta_step_impl(
             st4 = _refresh_in_step(st4)
             return st4, applied_b
 
-        return jax.lax.cond(any_fs, with_fs, normal, st)
+        # the absorb branch only runs when a full sync fired somewhere;
+        # the profiler scopes make the heavy path legible in a trace
+        return jax.lax.cond(
+            any_fs,
+            annotate.scoped("delta.fs_absorb")(with_fs),
+            annotate.scoped("delta.ack_merge")(normal),
+            st,
+        )
 
     def ack_skip(st: DeltaState) -> tuple[DeltaState, jax.Array]:
         return st, jnp.int32(0)
@@ -2080,6 +2092,7 @@ def _converged_impl(
 
 
 @jax.jit
+@annotate.scoped("delta.compact")
 def compact(state: DeltaState) -> DeltaState:
     """Drop slots that match the base again with no active pb/suspicion
     (divergence healed by gossip); keeps rows sorted."""

@@ -108,6 +108,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ringpop_tpu.obs import annotate
+
 
 # Status encoding: lattice rank == code (alive < suspect < faulty < leave,
 # matching equal-incarnation precedence in membership-update-rules.js).
@@ -509,6 +511,7 @@ class _Merge(NamedTuple):
     flapped: jax.Array  # bool[N, N] — applied status transition touching alive
 
 
+@annotate.scoped("swim.merge_incoming")
 def _merge_incoming(
     state: ClusterState,
     in_key: jax.Array,  # int32[N, N]: claim about j arriving at receiver r (0 = none)
@@ -668,6 +671,7 @@ def _validate_params(n: int, params: SwimParams) -> int:
     return int(params.suspicion_ticks) + 1
 
 
+@annotate.scoped("swim.phase01_select")
 def _phase01_select(
     state: ClusterState, net: NetState, k_sel: jax.Array, params: SwimParams
 ) -> _Selection:
@@ -769,6 +773,7 @@ def _stage_issue(
     return st._replace(pb=pb), issued
 
 
+@annotate.scoped("swim.pingreq")
 def _phase5_pingreq(
     state: ClusterState,
     net: NetState,
@@ -857,17 +862,19 @@ def _phase5_pingreq(
             total = total + _inbound_counts(recv_idx[:, m], masks[:, m])
         return total
 
-    def _stage_merge(st, acc, pred, build_in, active):
+    def _stage_merge(st, acc, pred, build_in, active, name):
         """One exchange stage's merge under a has-claims cond: in the
         converged steady state failed probes happen every tick but
         nobody holds an active change, so every stage's claim matrix is
         zero and the [N, N] gathers/sort-merges must cost nothing.
         ``pred`` (any issued change at a participant) is conservative —
-        claims only shrink from there — so a skipped stage is a no-op."""
+        claims only shrink from there — so a skipped stage is a no-op.
+        ``name`` labels the stage in profiler traces (obs.annotate)."""
         applied_total, flapped = acc
 
         def go(st2):
-            mrg = _merge_incoming(st2, build_in(st2), active, sl_start)
+            with annotate.scope(name):
+                mrg = _merge_incoming(st2, build_in(st2), active, sl_start)
             return mrg.state, jnp.sum(mrg.applied, dtype=jnp.int32), mrg.flapped
 
         def skip(st2):
@@ -907,7 +914,9 @@ def _phase5_pingreq(
                 acc_in = jnp.maximum(acc_in, slot_in)
             return acc_in
 
-        st, acc = _stage_merge(st, acc, jnp.any(issue_src), in_a, nsrv > 0)
+        st, acc = _stage_merge(
+            st, acc, jnp.any(issue_src), in_a, nsrv > 0, "swim.pingreq_5a"
+        )
 
         # -- 5b: the witness relay-pings the target with its changes -----
         st, issue_wit = _stage_issue(st, nsrv, maxpb8)
@@ -931,7 +940,9 @@ def _phase5_pingreq(
                 acc_in = jnp.maximum(acc_in, slot_in)
             return acc_in
 
-        st, acc = _stage_merge(st, acc, jnp.any(issue_wit), in_b, ntgt > 0)
+        st, acc = _stage_merge(
+            st, acc, jnp.any(issue_wit), in_b, ntgt > 0, "swim.pingreq_5b"
+        )
 
         # -- 5c: the target's ack carries its changes back ----------------
         st, issue_tgt = _stage_issue(st, ntgt, maxpb8)
@@ -954,7 +965,9 @@ def _phase5_pingreq(
                 acc_in = jnp.maximum(acc_in, slot_in)
             return acc_in
 
-        st, acc = _stage_merge(st, acc, jnp.any(issue_tgt), in_c, nwit_ack > 0)
+        st, acc = _stage_merge(
+            st, acc, jnp.any(issue_tgt), in_c, nwit_ack > 0, "swim.pingreq_5c"
+        )
 
         # -- 5d: the witness response carries its (fresh) changes ---------
         # issue set from the post-5c state: what the witness just learned
@@ -974,7 +987,9 @@ def _phase5_pingreq(
                 )
             return acc_in
 
-        st, acc = _stage_merge(st, acc, jnp.any(issue_wit2), in_d, any_resp)
+        st, acc = _stage_merge(
+            st, acc, jnp.any(issue_wit2), in_d, any_resp, "swim.pingreq_5d"
+        )
         return st, acc[0], acc[1]
 
     def no_exchange(st: ClusterState):
@@ -1006,6 +1021,7 @@ def _phase5_pingreq(
     )
 
 
+@annotate.scoped("swim.expiry")
 def _phase6_expiry(
     state: ClusterState, gossiping: jax.Array
 ) -> tuple[ClusterState, jax.Array]:
@@ -1092,6 +1108,7 @@ def _inbound_counts(t_safe: jax.Array, fwd_ok: jax.Array) -> jax.Array:
     return bounds[1:] - bounds[:-1]
 
 
+@annotate.scoped("swim.recv_merge")
 def _receiver_merge(
     t_safe: jax.Array, fwd_ok: jax.Array, claim_rows: jax.Array
 ) -> tuple[jax.Array, jax.Array]:

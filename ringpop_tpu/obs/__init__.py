@@ -1,0 +1,44 @@
+"""Observability: emitters, dispatch ledger, profiler scopes, bridge.
+
+The flight recorder for the compiled SWIM stack (ISSUE 5):
+
+* ``obs.emitters`` — real sinks behind the reference's injected-statsd
+  ``increment/gauge/timing`` interface (statsd UDP line protocol,
+  in-memory capture, JSON lines), so ``RingPop(statsd=...)`` finally
+  records somewhere at runtime;
+* ``obs.ledger`` — per-dispatch compile-vs-execute wall time plus the
+  AOT ``memory_analysis`` footprint of every jitted entry point
+  (``swim_run``/``delta_run``/``run_scenario``/``run_sweep``/the
+  recv-merge forms), persisted as JSON lines with a summarizer CLI;
+* ``obs.annotate`` — ``jax.named_scope`` protocol-phase scopes and the
+  ``--profile-dir`` trace bracket (TensorBoard / Perfetto);
+* ``obs.bridge`` — replays per-tick ``Trace`` counters into any
+  emitter under reference-parity key names (``ping.send``,
+  ``full-sync``, ``membership-update.*`` ...).
+
+``annotate`` is NOT imported eagerly: it needs jax, and the bench
+parent process (bench.py's orchestrator) must be able to record ledger
+rows without ever initializing a backend.
+"""
+
+from __future__ import annotations
+
+from ringpop_tpu.obs.emitters import (
+    CaptureEmitter,
+    JsonlEmitter,
+    MultiEmitter,
+    StatsdEmitter,
+    make_emitter,
+)
+from ringpop_tpu.obs.ledger import DispatchLedger, default_ledger, memory_row
+
+__all__ = [
+    "CaptureEmitter",
+    "JsonlEmitter",
+    "MultiEmitter",
+    "StatsdEmitter",
+    "make_emitter",
+    "DispatchLedger",
+    "default_ledger",
+    "memory_row",
+]

@@ -1,0 +1,63 @@
+"""Profiler scopes and trace brackets for the compiled protocol.
+
+``jax.named_scope`` pushes a name onto jax's tracing name stack, so
+every op traced inside carries the scope in its HLO ``op_name``
+metadata — which is what TensorBoard's trace viewer and Perfetto group
+by.  The models wrap each protocol phase (phase-0/1 select, receiver
+merge, the ping-req 5a–5c exchange, delta absorb/compact) so a
+device trace reads as protocol phases instead of a fused-op soup.
+
+``profile_trace(dir)`` brackets a run with
+``jax.profiler.start_trace/stop_trace`` — the implementation behind
+``tick-cluster --profile-dir`` and ``bench.py --profile-dir``; the
+directory is TensorBoard-loadable (``plugins/profile/<run>/`` with
+``.xplane.pb`` + ``.trace.json.gz``).
+
+jax is imported lazily so that importing ``ringpop_tpu.obs`` never
+initializes a backend (bench.py's parent process contract).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+from typing import Any, Callable, Iterator
+
+
+def scope(name: str) -> Any:
+    """Context manager: a ``jax.named_scope`` for one protocol phase."""
+    import jax
+
+    return jax.named_scope(name)
+
+
+def scoped(name: str) -> Callable[[Callable[..., Any]], Callable[..., Any]]:
+    """Decorator form of ``scope`` (wraps the whole function body)."""
+
+    def deco(fn: Callable[..., Any]) -> Callable[..., Any]:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            import jax
+
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+@contextlib.contextmanager
+def profile_trace(directory: str) -> Iterator[str]:
+    """Bracket a block with a jax profiler trace written to
+    ``directory`` (created if missing).  ``stop_trace`` runs even when
+    the block raises, so a crashed run still ships its trace."""
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    jax.profiler.start_trace(directory)
+    try:
+        yield directory
+    finally:
+        jax.profiler.stop_trace()

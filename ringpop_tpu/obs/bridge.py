@@ -1,0 +1,190 @@
+"""Trace→stats bridge: replay simulated telemetry as reference metrics.
+
+A real ringpop deployment is observed through its statsd namespace
+(``ringpop.<host_port>.ping.send``, ``.membership-update.suspect``,
+``.full-sync`` ...).  The compiled simulation stacks the same protocol
+facts into per-tick ``Trace`` counters — this bridge replays them into
+any emitter under the SAME key names, so a simulated 10k-node chaos
+scenario produces the metric namespace a production cluster would, and
+every downstream consumer (dashboards, alert rules, the CI namespace
+assertion) works unchanged.
+
+Key table (trace series → reference stat; the suffixes are asserted
+against the host facade's own emissions in tests/test_obs.py):
+
+| trace series                 | type      | reference key               |
+|------------------------------|-----------|-----------------------------|
+| pings_sent                   | increment | ping.send                   |
+| acks                         | increment | ping.recv                   |
+| ping_reqs                    | increment | ping-req.send               |
+| full_syncs                   | increment | full-sync                   |
+| suspects_declared            | increment | membership-update.suspect   |
+| faulty_declared              | increment | membership-update.faulty    |
+| live (tick-0 baseline + ups) | increment | membership-update.alive     |
+| *_changes_applied (summed)   | gauge     | changes.apply               |
+| live                         | gauge     | num-members                 |
+| checksum (caller-provided)   | gauge     | checksum                    |
+
+Increments carry the tick's count as the statsd count value (``:N|c``);
+zero-count ticks emit nothing (the reference increments per event, so
+an eventless tick is silence there too).  ``membership-update.alive``
+is emitted at tick 0 with the starting live count — the simulation's
+analog of every node's bootstrap ``make_alive`` — and afterwards with
+the positive live-count delta (revives re-entering the gossip set).
+Sim-only series that have no reference analog keep a ``sim.`` prefix
+(``sim.converged``, ``sim.loss``, ``sim.claims_dropped`` ...), so the
+reference namespace stays exactly reference-shaped.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+# trace counter -> reference increment key (per tick, count as value)
+COUNTER_KEYS: dict[str, str] = {
+    "pings_sent": "ping.send",
+    "acks": "ping.recv",
+    "ping_reqs": "ping-req.send",
+    "full_syncs": "full-sync",
+    "suspects_declared": "membership-update.suspect",
+    "faulty_declared": "membership-update.faulty",
+}
+
+# the changes-applied trio folds into the reference's changes.apply gauge
+CHANGES_APPLIED = (
+    "ping_changes_applied",
+    "ack_changes_applied",
+    "pingreq_changes_applied",
+)
+
+# every reference-parity key the bridge can emit — the namespace the CI
+# smoke asserts a scenario's --stats-out stream is a superset of
+REFERENCE_KEYS: tuple[str, ...] = (
+    *COUNTER_KEYS.values(),
+    "membership-update.alive",
+    "changes.apply",
+    "num-members",
+    "checksum",
+)
+
+DEFAULT_PREFIX = "ringpop.sim"
+
+
+class StatSink:
+    """``RingPop.stat``'s prefix + key-cache fast path (index.js:561-575)
+    over a bare emitter: fully-qualified keys are built once per key,
+    not per call."""
+
+    def __init__(self, emitter: Any, prefix: str = DEFAULT_PREFIX):
+        self.emitter = emitter
+        self.prefix = prefix
+        self._keys: dict[str, str] = {}
+
+    def _fq(self, key: str) -> str:
+        fq = self._keys.get(key)
+        if fq is None:
+            fq = self._keys[key] = f"{self.prefix}.{key}"
+        return fq
+
+    def increment(self, key: str, value: Any = None) -> None:
+        self.emitter.increment(self._fq(key), value)
+
+    def gauge(self, key: str, value: Any = None) -> None:
+        self.emitter.gauge(self._fq(key), value)
+
+    def timing(self, key: str, value: Any = None) -> None:
+        self.emitter.timing(self._fq(key), value)
+
+
+def emit_counters(
+    metrics: dict[str, Any], sink: StatSink, *, live: int | None = None
+) -> int:
+    """Bridge ONE tick's counter dict (a ``SimCluster.tick`` metrics
+    entry, or one row of a trace) into the sink.  Returns the number of
+    stat calls made.
+
+    A multi-tick entry (``metrics["ticks"] > 1`` — ``swim_run`` reports
+    only the LAST tick's counters) emits gauges only: gauges are
+    last-write-wins so the latest tick's value is exactly right, but
+    replaying a one-tick sample as the whole span's increments would
+    understate protocol traffic by up to ticks× (use ``run_scenario``
+    for an exact per-tick stream)."""
+    calls = 0
+    changes = 0
+    one_tick = int(metrics.get("ticks", 1)) == 1
+    for name, value in metrics.items():
+        v = int(value)
+        key = COUNTER_KEYS.get(name)
+        if key is not None:
+            if v and one_tick:
+                sink.increment(key, v)
+                calls += 1
+        elif name in CHANGES_APPLIED:
+            changes += v
+        elif name not in ("converged", "live", "loss", "ticks"):
+            # always emitted, zeros included: a statsd gauge holds its
+            # last write, so suppressing zeros would freeze a spike
+            # (e.g. claims-dropped) on the dashboard forever
+            sink.gauge(f"sim.{name.replace('_', '-')}", v)
+            calls += 1
+    sink.gauge("changes.apply", changes)
+    calls += 1
+    if live is not None:
+        sink.gauge("num-members", int(live))
+        calls += 1
+    return calls
+
+
+def replay_trace(
+    trace: Any,
+    emitter: Any,
+    *,
+    prefix: str = DEFAULT_PREFIX,
+    checksum: int | None = None,
+    declare_namespace: bool = True,
+) -> int:
+    """Replay a ``scenarios.Trace`` tick by tick into ``emitter`` under
+    reference-parity keys (see the module key table).  ``checksum``
+    (the cluster's post-run membership checksum) emits one final
+    ``checksum`` gauge — the reference recomputes-and-gauges it on
+    every membership update; the simulation computes it on demand.
+
+    ``declare_namespace`` (default) first touches every counter key
+    with a zero-count increment (``key:0|c`` — a legal statsd no-op),
+    so the emitted key set is the full reference namespace even for a
+    quiet scenario whose run produced no faulty/full-sync events —
+    the deterministic superset the CI smoke asserts.  With no
+    ``checksum`` available (e.g. every node dead) the declaration also
+    touches the ``checksum`` gauge with 0 (documented sentinel for
+    "not computed"), keeping the namespace guarantee total.
+
+    Returns the total number of stat calls."""
+    sink = StatSink(emitter, prefix)
+    calls0 = 0
+    if declare_namespace:
+        for key in (*COUNTER_KEYS.values(), "membership-update.alive"):
+            sink.increment(key, 0)
+            calls0 += 1
+        if checksum is None:
+            sink.gauge("checksum", 0)
+            calls0 += 1
+    live = np.asarray(trace.live, dtype=np.int64)
+    converged = np.asarray(trace.converged, dtype=bool)
+    loss = np.asarray(trace.loss, dtype=np.float64)
+    calls = calls0
+    for t in range(trace.ticks):
+        tick_metrics = {k: v[t] for k, v in trace.metrics.items()}
+        calls += emit_counters(tick_metrics, sink, live=int(live[t]))
+        alive = int(live[t]) if t == 0 else int(live[t]) - int(live[t - 1])
+        if alive > 0:
+            sink.increment("membership-update.alive", alive)
+            calls += 1
+        sink.gauge("sim.converged", int(converged[t]))
+        sink.gauge("sim.loss", float(loss[t]))
+        calls += 2
+    if checksum is not None:
+        sink.gauge("checksum", int(checksum))
+        calls += 1
+    return calls

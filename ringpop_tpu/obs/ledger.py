@@ -1,0 +1,345 @@
+"""Dispatch ledger: per-dispatch forensics for the jitted entry points.
+
+The round-4/5 failures (a 65k worker crash, a 240 s accelerator-probe
+timeout) were diagnosed after the fact by building instrumentation; the
+ledger records the same facts as they happen.  Every dispatch routed
+through it gets one JSON line:
+
+    {"ts": ..., "program": "run_scenario", "backend": "dense",
+     "platform": "cpu", "n": 16, "ticks": 60, "replicas": 1,
+     "cold": true, "trace_s": ..., "compile_s": ..., "execute_s": ...,
+     "argument_bytes": ..., "output_bytes": ..., "temp_bytes": ...,
+     "alias_bytes": ..., "generated_code_bytes": ...,
+     "peak_bytes": ..., "peak_is_derived": ...}
+
+Cold/warm discrimination is structural, not guessed: the ledger owns an
+AOT executable cache (``jit(...).lower(...).compile()``) keyed by the
+abstract signature, so the first dispatch of a shape pays (and records)
+trace + compile separately from execute, and warm dispatches reuse the
+compiled executable — exactly one XLA compile per shape, same as plain
+``jax.jit``.  The footprint fields come from the same
+``memory_analysis`` read ``benchmarks/mem_census.py`` pioneered
+(``memory_row`` below is that machinery, now shared).
+
+The ledger is OFF by default and adds nothing to the hot path
+(``dispatch`` is a plain call-through when disabled).  Enable it with
+``default_ledger().enable(path)`` or ``RINGPOP_LEDGER=/path/to.jsonl``
+in the environment; ``path=None`` keeps rows in memory only (tests).
+
+This module never imports jax at the top level: bench.py's parent
+orchestrator records probe rows without initializing any backend.
+
+Summarizer CLI:  python -m ringpop_tpu.obs.ledger LEDGER.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable
+
+ENV_VAR = "RINGPOP_LEDGER"
+
+# In-memory row cap (the JSONL file keeps everything): a long-lived
+# worker dispatching for days must not leak one dict per dispatch —
+# the in-process consumers (/admin/ledger, summary()) want aggregates
+# and recency, not unbounded history.
+MAX_ROWS_IN_MEMORY = 10_000
+
+_MEM_FIELDS = (
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "alias_bytes",
+    "generated_code_bytes",
+    "peak_bytes",
+    "peak_is_derived",
+)
+
+
+def memory_row(compiled: Any) -> dict[str, int | bool]:
+    """XLA ``memory_analysis`` of an AOT-compiled executable, flattened
+    to the census field set.  ``peak_bytes`` is the backend's own peak
+    when reported (TPU) and otherwise the derived
+    ``argument + output + temp - alias`` (donated buffers counted once).
+    Defensive: a backend without the analysis yields zeros, not a crash.
+    """
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — forensics must not kill the run
+        ma = None
+    if ma is None:
+        return {f: (False if f == "peak_is_derived" else 0) for f in _MEM_FIELDS}
+    arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+    out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+    temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    explicit_peak = int(getattr(ma, "peak_memory_in_bytes", 0) or 0)
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(
+            getattr(ma, "generated_code_size_in_bytes", 0) or 0
+        ),
+        "peak_bytes": explicit_peak or (arg + out + temp - alias),
+        "peak_is_derived": not explicit_peak,
+    }
+
+
+def _signature(args: tuple, statics: dict) -> tuple:
+    """Hashable abstract signature of a dispatch: pytree structure plus
+    (shape, dtype) per array leaf and repr per static leaf.  Matches
+    jit's recompile granularity closely enough to reuse executables."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, statics))
+    parts = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            # placement is part of the executable contract: an AOT
+            # program compiled for one device/sharding must not be fed
+            # differently-placed arrays (plain jit would recompile)
+            placement = str(getattr(leaf, "sharding", None))
+            parts.append((tuple(leaf.shape), str(leaf.dtype), placement))
+        else:
+            parts.append(repr(leaf))
+    return (str(treedef), tuple(parts))
+
+
+class DispatchLedger:
+    """JSON-lines flight recorder for jitted dispatches (see module
+    docstring).  Thread-safe appends; one instance is process-global
+    (``default_ledger``) so every entry point shares a file."""
+
+    def __init__(self, path: str | None = None):
+        self.rows: list[dict[str, Any]] = []
+        self._path = path
+        self._explicit = path is not None
+        self._enabled = path is not None
+        self._compiled: dict[tuple, tuple[Any, dict[str, Any]]] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def path(self) -> str | None:
+        self._maybe_enable_from_env()
+        return self._path
+
+    @property
+    def enabled(self) -> bool:
+        self._maybe_enable_from_env()
+        return self._enabled
+
+    def _maybe_enable_from_env(self) -> None:
+        if not self._explicit and not self._enabled and os.environ.get(ENV_VAR):
+            self.enable(os.environ[ENV_VAR])
+
+    def enable(self, path: str | None = None) -> "DispatchLedger":
+        """Start recording; ``path=None`` keeps rows in memory only."""
+        self._path = path
+        self._explicit = True
+        self._enabled = True
+        return self
+
+    def disable(self) -> None:
+        self._explicit = True
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self.rows.clear()
+            self._compiled.clear()
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, row: dict[str, Any]) -> dict[str, Any]:
+        """Append a pre-built row (bench.py's probe/rung entries use
+        this directly — their timings come from the bench's own
+        watchdogged measurement, not an AOT replay).  A no-op while the
+        ledger is disabled; in-memory rows are capped at
+        ``MAX_ROWS_IN_MEMORY`` (oldest dropped — the file keeps all)."""
+        if not self.enabled:
+            return row
+        row = dict(row)
+        row.setdefault("ts", round(time.time(), 3))
+        with self._lock:
+            self.rows.append(row)
+            if len(self.rows) > MAX_ROWS_IN_MEMORY:
+                del self.rows[: -MAX_ROWS_IN_MEMORY]
+            if self._path:
+                with open(self._path, "a") as f:
+                    f.write(json.dumps(row) + "\n")
+        return row
+
+    def dispatch(
+        self,
+        program: str,
+        jitted: Callable[..., Any],
+        *args: Any,
+        _meta: dict[str, Any] | None = None,
+        **static_kwargs: Any,
+    ) -> Any:
+        """Run ``jitted(*args, **static_kwargs)`` and record one row.
+
+        Disabled (the default): a plain call-through — zero overhead,
+        bit-identical behavior.  Enabled: the call goes through the
+        ledger's AOT cache (lower → compile → execute, each timed; the
+        executable is reused on warm dispatches, so there is still
+        exactly one XLA compile per abstract signature).  Static
+        arguments MUST be passed as keywords.
+        """
+        if not self.enabled:
+            return jitted(*args, **static_kwargs)
+        import jax
+
+        key = (program, _signature(args, static_kwargs))
+        cold = key not in self._compiled
+        trace_s = compile_s = 0.0
+        if cold:
+            t0 = time.perf_counter()
+            lowered = jitted.lower(*args, **static_kwargs)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+            trace_s, compile_s = t1 - t0, t2 - t1
+            self._compiled[key] = (compiled, memory_row(compiled))
+        compiled, mem = self._compiled[key]
+        t0 = time.perf_counter()
+        out = compiled(*args)
+        out = jax.block_until_ready(out)
+        execute_s = time.perf_counter() - t0
+        row = {
+            "program": program,
+            "platform": jax.default_backend(),
+            "cold": cold,
+            "trace_s": round(trace_s, 6),
+            "compile_s": round(compile_s, 6),
+            "execute_s": round(execute_s, 6),
+            **mem,
+        }
+        if _meta:
+            row.update(_meta)
+        self.record(row)
+        return out
+
+    # -- reading back -------------------------------------------------------
+
+    @staticmethod
+    def load_rows(path: str) -> list[dict[str, Any]]:
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return rows
+
+    def summary(self) -> list[dict[str, Any]]:
+        return summarize(self.rows)
+
+
+def summarize(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Aggregate ledger rows by (program, backend, platform, n, ticks,
+    replicas): dispatch/cold counts, total compile seconds, execute
+    percentiles (stats.py Histogram — the repo's one reservoir), and
+    the peak-bytes high-water mark."""
+    from ringpop_tpu.stats import Histogram
+
+    groups: dict[tuple, dict[str, Any]] = {}
+    hists: dict[tuple, Histogram] = {}
+    for row in rows:
+        key = tuple(
+            row.get(k) for k in ("program", "backend", "platform", "n",
+                                 "ticks", "replicas")
+        )
+        g = groups.setdefault(
+            key,
+            {
+                "program": row.get("program"),
+                "backend": row.get("backend"),
+                "platform": row.get("platform"),
+                "n": row.get("n"),
+                "ticks": row.get("ticks"),
+                "replicas": row.get("replicas"),
+                "dispatches": 0,
+                "cold": 0,
+                "compile_s_total": 0.0,
+                "peak_bytes_max": 0,
+            },
+        )
+        g["dispatches"] += 1
+        g["cold"] += int(bool(row.get("cold")))
+        g["compile_s_total"] += float(row.get("compile_s") or 0.0)
+        g["peak_bytes_max"] = max(
+            g["peak_bytes_max"], int(row.get("peak_bytes") or 0)
+        )
+        if row.get("execute_s") is not None:
+            hists.setdefault(key, Histogram(seed=0)).update(
+                float(row["execute_s"])
+            )
+    out = []
+    for key, g in groups.items():
+        hist = hists.get(key)
+        if hist is not None:
+            pct = hist.percentiles([0.5, 0.95, 0.99])
+            g["execute_s"] = {
+                "count": hist._count,
+                "p50": pct["0.5"],
+                "p95": pct["0.95"],
+                "p99": pct["0.99"],
+            }
+        g["compile_s_total"] = round(g["compile_s_total"], 6)
+        out.append(g)
+    out.sort(key=lambda g: (str(g["program"]), str(g["backend"]),
+                            g["n"] or 0))
+    return out
+
+
+_default = DispatchLedger()
+
+
+def default_ledger() -> DispatchLedger:
+    """The process-global ledger every instrumented call site shares."""
+    return _default
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m ringpop_tpu.obs.ledger",
+        description="Summarize a dispatch-ledger JSON-lines file.",
+    )
+    ap.add_argument("path", help="ledger .jsonl written via RINGPOP_LEDGER "
+                                 "or DispatchLedger.enable(path)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON summary row per group")
+    args = ap.parse_args(argv)
+    rows = DispatchLedger.load_rows(args.path)
+    groups = summarize(rows)
+    if args.json:
+        for g in groups:
+            print(json.dumps(g))
+        return
+    print(f"{len(rows)} dispatches in {args.path}")
+    for g in groups:
+        shape = f"n={g['n']} T={g['ticks']} R={g['replicas']}"
+        ex = g.get("execute_s") or {}
+        peak = g["peak_bytes_max"]
+        peak_str = f"{peak / 1e6:.1f} MB" if peak >= 1e6 else f"{peak:,} B"
+        print(
+            f"  {g['program']} [{g['backend']}/{g['platform']}] {shape}: "
+            f"{g['dispatches']} dispatches ({g['cold']} cold, "
+            f"compile {g['compile_s_total']:.3f}s), "
+            f"execute p50={ex.get('p50', 0):.4f}s p99={ex.get('p99', 0):.4f}s, "
+            f"peak {peak_str}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ringpop_tpu.obs import annotate
+from ringpop_tpu.obs.ledger import default_ledger
+
 # Lane width of one grid step's fetch/accumulate tile (int32 lanes; a
 # multiple of 128).  Larger blocks amortize per-step overhead and grow
 # DMA granularity at 4 bytes/lane; VMEM cost is ~4 tiles of cb int32.
@@ -91,19 +94,13 @@ def _pick_col_block(n: int) -> tuple[int, int]:
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def recv_merge_pallas(
+@annotate.scoped("swim.recv_merge_pallas")
+def _recv_merge_pallas_jit(
     t_safe: jax.Array,
     fwd_ok: jax.Array,
     claim_rows: jax.Array,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
-    """(in_key int32[N, N], inbound int32[N]): per-receiver lattice max
-    of the delivered claim rows and the delivered-ping count —
-    bit-identical to swim_sim._receiver_merge's sorted/scatter forms.
-
-    ``t_safe[s]`` is sender s's receiver, ``fwd_ok[s]`` whether its ping
-    was delivered, ``claim_rows[s]`` its (already masked, >= 0) claims.
-    """
     n = t_safe.shape[0]
     recv = jnp.where(fwd_ok, t_safe, n).astype(jnp.int32)
     order = jnp.argsort(recv).astype(jnp.int32)  # flat [N]: cheap
@@ -151,3 +148,35 @@ def recv_merge_pallas(
     )(recv_s, starts, order, claims)
     in_key = jnp.where((inbound > 0)[:, None], out[:, 0, :n], 0)
     return in_key, inbound
+
+
+def recv_merge_pallas(
+    t_safe: jax.Array,
+    fwd_ok: jax.Array,
+    claim_rows: jax.Array,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """(in_key int32[N, N], inbound int32[N]): per-receiver lattice max
+    of the delivered claim rows and the delivered-ping count —
+    bit-identical to swim_sim._receiver_merge's sorted/scatter forms.
+
+    ``t_safe[s]`` is sender s's receiver, ``fwd_ok[s]`` whether its ping
+    was delivered, ``claim_rows[s]`` its (already masked, >= 0) claims.
+
+    A host-level call (concrete arrays) with the dispatch ledger
+    enabled is recorded there (compile/execute split + footprint);
+    traced calls — the kernel inlined into ``swim_step`` — go straight
+    through, as do ledger-off calls.
+    """
+    ledger = default_ledger()
+    if ledger.enabled and not isinstance(t_safe, jax.core.Tracer):
+        return ledger.dispatch(
+            "recv_merge_pallas",
+            _recv_merge_pallas_jit,
+            t_safe,
+            fwd_ok,
+            claim_rows,
+            interpret=interpret,
+            _meta={"backend": "dense", "n": int(t_safe.shape[0])},
+        )
+    return _recv_merge_pallas_jit(t_safe, fwd_ok, claim_rows, interpret=interpret)

@@ -35,7 +35,7 @@ from ringpop_tpu.request_proxy.http import ProxyResponse
 from ringpop_tpu.request_proxy.proxy import RequestProxy
 from ringpop_tpu.rollup import MembershipUpdateRollup
 from ringpop_tpu.server import create_server
-from ringpop_tpu.stats import Meter
+from ringpop_tpu.stats import Histogram, Meter
 from ringpop_tpu.suspicion import Suspicion
 from ringpop_tpu.swim.join_sender import join_cluster
 from ringpop_tpu.swim.ping_req_sender import send_ping_req
@@ -101,6 +101,16 @@ class RingPop(EventEmitter):
         self.clock = clock or SimScheduler()
         self.rng = rng or random.Random()
         self.logger = logger or NullLogger()
+        # Only an emitter WE build (from a spec string) is ours to close
+        # on destroy(); a caller-injected object may be shared by other
+        # nodes (the harness cluster passes one emitter to every node).
+        self._owns_statsd = isinstance(statsd, str)
+        if self._owns_statsd:
+            # emitter spec string ("statsd://HOST:PORT", a .jsonl path,
+            # "-", "capture") — the obs subsystem's sink forms
+            from ringpop_tpu.obs.emitters import make_emitter
+
+            statsd = make_emitter(statsd)
         self.statsd = statsd or NullStatsd()
         self.bootstrap_file = bootstrap_file
 
@@ -160,6 +170,11 @@ class RingPop(EventEmitter):
         self.stat_prefix = f"ringpop.{self.stat_host_port}"
         self.stat_keys: dict[str, str] = {}
         self.stats_hooks: dict[str, Any] = {}
+        # every timing stat also feeds a local reservoir so /admin/stats
+        # can answer with p50/p95/p99 aggregates (the reference's
+        # protocol timing percentiles, gossip.js:33) even when statsd is
+        # a fire-and-forget UDP sink
+        self.timing_histograms: dict[str, Histogram] = {}
 
         self.destroyed = False
         self.joiner = None
@@ -196,6 +211,10 @@ class RingPop(EventEmitter):
             self.joiner.destroy()
         if self.channel is not None and not self.channel.destroyed:
             self.channel.close()
+        if self._owns_statsd:
+            close = getattr(self.statsd, "close", None)
+            if close is not None:
+                close()  # flush file-backed emitters (obs.emitters)
 
     def whoami(self) -> str:
         return self.host_port
@@ -482,6 +501,12 @@ class RingPop(EventEmitter):
                 "clientRate": self.client_rate.print_obj()["m1"],
                 "serverRate": self.server_rate.print_obj()["m1"],
                 "totalRate": self.total_rate.print_obj()["m1"],
+                # per-operation aggregates of the timing stats emitted at
+                # ping_member_now (the reference ships these only to
+                # statsd; /admin/stats answering locally means a cluster
+                # with no collector still has its percentiles)
+                "ping": self.timing_stats("ping"),
+                "pingReq": self.timing_stats("ping-req"),
             },
             "ring": list(self.ring.servers.keys()),
             "version": __version__,
@@ -489,6 +514,12 @@ class RingPop(EventEmitter):
             "uptime": timestamp - self.start_time,
         }
         return stats
+
+    def timing_stats(self, key: str) -> dict[str, Any]:
+        """Histogram aggregate (count/min/max/median/p95/p99 ...) of a
+        timing stat key, zeros-shaped before the first sample."""
+        hist = self.timing_histograms.get(key)
+        return (hist or Histogram()).print_obj()
 
     def get_stats_hooks_stats(self) -> dict[str, Any] | None:
         if not self.stats_hooks:
@@ -537,6 +568,11 @@ class RingPop(EventEmitter):
             self.statsd.gauge(fq_key, value)
         elif type_ == "timing":
             self.statsd.timing(fq_key, value)
+            hist = self.timing_histograms.get(key)
+            if hist is None:
+                hist = self.timing_histograms[key] = Histogram(seed=0)
+            if value is not None:
+                hist.update(value)
 
     # -- test hooks (index.js:696-704) --------------------------------------
 

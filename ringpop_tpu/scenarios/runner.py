@@ -32,6 +32,7 @@ import numpy as np
 from ringpop_tpu.models import swim_delta as sdelta
 from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.models.swim_delta import DeltaParams, DeltaState
+from ringpop_tpu.obs.ledger import default_ledger
 from ringpop_tpu.models.swim_sim import NetState, SwimParams
 from ringpop_tpu.scenarios.compile import (
     EV_KILL,
@@ -217,7 +218,12 @@ def run_compiled(
     precheck(state, net, compiled)
     adj = _normalize_adj(net, compiled.n)
     _dispatches += 1
-    state, up, resp, adj, ys = _scenario_scan(
+    # ledger-off (the default): dispatch() is a plain call-through; on,
+    # the dispatch is recorded with its compile/execute split and AOT
+    # memory footprint (obs/ledger.py)
+    state, up, resp, adj, ys = default_ledger().dispatch(
+        "run_scenario",
+        _scenario_scan,
         state,
         net.up,
         net.responsive,
@@ -231,6 +237,12 @@ def run_compiled(
         keys,
         params=params,
         has_revive=compiled.has_revive,
+        _meta={
+            "backend": "delta" if isinstance(state, DeltaState) else "dense",
+            "n": compiled.n,
+            "ticks": compiled.ticks,
+            "replicas": 1,
+        },
     )
     return state, NetState(up=up, responsive=resp, adj=adj), ys
 

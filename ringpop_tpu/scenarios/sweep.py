@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ringpop_tpu.obs.ledger import default_ledger
 from ringpop_tpu.scenarios import runner
 from ringpop_tpu.scenarios.compile import (
     CompiledScenario,
@@ -431,7 +432,11 @@ def run_sweep_compiled(
             ]
             keys = jax.device_put(keys, sharding)
     _dispatches += 1
-    states, up, resp, adj, ys = _sweep_scan(
+    # routed through the dispatch ledger (obs/ledger.py): a call-through
+    # when disabled, a recorded compile/execute + footprint row when on
+    states, up, resp, adj, ys = default_ledger().dispatch(
+        "run_sweep",
+        _sweep_scan,
         *batched,
         cs.ev_tick,
         cs.ev_kind,
@@ -442,6 +447,12 @@ def run_sweep_compiled(
         keys,
         params=params,
         has_revive=cs.base.has_revive,
+        _meta={
+            "backend": "delta" if hasattr(params, "wire_cap") else "dense",
+            "n": cs.base.n,
+            "ticks": cs.base.ticks,
+            "replicas": r,
+        },
     )
     nets = type(net)(up=up, responsive=resp, adj=adj)
     return states, nets, ys

@@ -24,6 +24,7 @@ class RingpopServer:
     COMMANDS = {
         "/health": "health",
         "/admin/stats": "admin_stats",
+        "/admin/ledger": "admin_ledger",
         "/admin/debugSet": "admin_debug_set",
         "/admin/debugClear": "admin_debug_clear",
         "/admin/gossip": "admin_gossip",
@@ -53,6 +54,27 @@ class RingpopServer:
 
     def admin_stats(self, head: Any, body: Any, host_info: str, cb: Respond) -> None:
         cb(None, None, to_json(self.ringpop.get_stats()))
+
+    def admin_ledger(self, head: Any, body: Any, host_info: str, cb: Respond) -> None:
+        """Dispatch-ledger summary of this process (obs/ledger.py) — an
+        extension endpoint: per-program compile/execute aggregates and
+        peak bytes for any jitted work the node has run (empty when the
+        ledger is disabled or the process never dispatched)."""
+        from ringpop_tpu.obs.ledger import default_ledger
+
+        ledger = default_ledger()
+        cb(
+            None,
+            None,
+            to_json(
+                {
+                    "enabled": ledger.enabled,
+                    "path": ledger.path,
+                    "dispatches": len(ledger.rows),
+                    "summary": ledger.summary(),
+                }
+            ),
+        )
 
     def admin_debug_set(self, head: Any, body: Any, host_info: str, cb: Respond) -> None:
         parsed = safe_parse(body)

@@ -60,9 +60,16 @@ def test_checkpoint_then_fault_injection(tmp_path):
     assert (status[live] == sim.FAULTY).all()
 
 
+@pytest.mark.slow
 def test_delta_backend_roundtrip_and_resume(tmp_path):
     """v3 checkpoints carry the delta backend: DeltaState leaves plus
-    the resource caps, and resume stays bit-deterministic."""
+    the resource caps, and resume stays bit-deterministic.
+
+    Nightly lane: at ~55 s (three delta-program compiles) this was the
+    single heaviest fast-lane test while the whole tier-1 run pushes
+    the ROADMAP's 870 s watchdog; the delta checkpoint family keeps
+    tier-1 representatives (`test_load_backfills_predigest_delta_
+    checkpoint`, `test_roundtrip_telemetry`)."""
     n = 16
     cluster = SimCluster(
         n, sim.SwimParams(loss=0.05), seed=7, backend="delta",

@@ -1,0 +1,441 @@
+"""Observability subsystem (ringpop_tpu/obs/): emitters, dispatch
+ledger, profiler scopes, and the Trace→stats bridge.
+
+Covers the ISSUE-5 acceptance triangle on CPU:
+  (a) one ``run_scenario`` leaves a ledger entry with compile/execute
+      times and peak-bytes populated;
+  (b) a bridged scenario's key set is a superset of the reference-
+      parity bridge keys, and those keys are exactly ones the host
+      facade itself emits (capture-emitter cross-check);
+  (c) the protocol-phase named scopes survive into compiled HLO, and
+      ``profile_trace`` writes a loadable trace directory.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.harness import Cluster
+from ringpop_tpu.harness import test_ringpop as make_node  # not a test
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.obs import annotate
+from ringpop_tpu.obs import bridge
+from ringpop_tpu.obs.emitters import (
+    CaptureEmitter,
+    JsonlEmitter,
+    StatsdEmitter,
+    make_emitter,
+)
+from ringpop_tpu.obs.ledger import DispatchLedger, default_ledger, summarize
+from ringpop_tpu.scenarios.trace import Trace
+
+
+@pytest.fixture
+def ledger():
+    """The process-global ledger, enabled in-memory and restored."""
+    led = default_ledger()
+    led.enable(None)
+    led.clear()
+    yield led
+    led.disable()
+    led.clear()
+
+
+# ---------------------------------------------------------------------------
+# emitters
+# ---------------------------------------------------------------------------
+
+
+def test_statsd_line_protocol():
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.settimeout(5.0)
+    host, port = srv.getsockname()
+    emitter = StatsdEmitter(host, port)
+    emitter.increment("ringpop.h.ping.send")
+    emitter.increment("ringpop.h.ping.send", 3)
+    emitter.gauge("ringpop.h.checksum", 123456)
+    emitter.timing("ringpop.h.ping", 12.5)
+    lines = [srv.recv(1024).decode() for _ in range(4)]
+    assert lines == [
+        "ringpop.h.ping.send:1|c",
+        "ringpop.h.ping.send:3|c",
+        "ringpop.h.checksum:123456|g",
+        "ringpop.h.ping:12.5|ms",
+    ]
+    emitter.close()
+    srv.close()
+
+
+def test_jsonl_emitter_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.jsonl")
+    emitter = JsonlEmitter(path)
+    emitter.increment("a.b", 2)
+    emitter.gauge("a.c", 7)
+    emitter.timing("a.d", 1.5)
+    emitter.close()
+    rows = [json.loads(line) for line in open(path)]
+    assert [(r["type"], r["key"], r.get("value")) for r in rows] == [
+        ("increment", "a.b", 2),
+        ("gauge", "a.c", 7),
+        ("timing", "a.d", 1.5),
+    ]
+    emitter.close()  # idempotent (shared-emitter destroy contract)
+
+
+def test_make_emitter_specs(tmp_path):
+    assert isinstance(make_emitter("capture"), CaptureEmitter)
+    statsd = make_emitter("statsd://127.0.0.1:8125")
+    assert isinstance(statsd, StatsdEmitter) and statsd.port == 8125
+    statsd.close()
+    assert isinstance(make_emitter("udp://localhost:9125"), StatsdEmitter)
+    jl = make_emitter(str(tmp_path / "s.jsonl"))
+    assert isinstance(jl, JsonlEmitter)
+    jl.close()
+    with pytest.raises(ValueError):
+        make_emitter("statsd://noport")
+
+
+# ---------------------------------------------------------------------------
+# RingPop facade: statsd slot end to end, key cache, timing percentiles
+# ---------------------------------------------------------------------------
+
+
+def test_ringpop_stat_key_cache_and_emitter():
+    cap = CaptureEmitter()
+    rp = make_node(statsd=cap)
+    rp.stat("increment", "ping.send")
+    rp.stat("increment", "ping.send")
+    # key-cache fast path (index.js:561-575): the fq key is built once
+    assert rp.stat_keys["ping.send"] == f"{rp.stat_prefix}.ping.send"
+    assert cap.counters[f"{rp.stat_prefix}.ping.send"] == 2
+    for ms in (10, 20, 30, 40):
+        rp.stat("timing", "ping", ms)
+    rp.stat("timing", "ping-req", 55)
+    stats = rp.get_stats()
+    ping = stats["protocol"]["ping"]
+    assert ping["count"] == 4
+    assert ping["min"] == 10 and ping["max"] == 40
+    assert ping["p95"] >= ping["median"] >= ping["min"]
+    assert stats["protocol"]["pingReq"]["count"] == 1
+    # the timing also reached the emitter itself
+    assert cap.timings[f"{rp.stat_prefix}.ping"] == [10, 20, 30, 40]
+
+
+def test_ringpop_statsd_string_spec(tmp_path):
+    path = str(tmp_path / "node.jsonl")
+    rp = make_node(statsd=path)
+    rp.stat("increment", "ping.send")
+    rp.destroy()  # closes (flushes) the file-backed emitter
+    keys = {json.loads(line)["key"] for line in open(path)}
+    assert f"{rp.stat_prefix}.ping.send" in keys
+
+
+# ---------------------------------------------------------------------------
+# dispatch ledger
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_jsonl_roundtrip_and_summary(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    led = DispatchLedger(path)
+    for i in range(3):
+        led.record(
+            {
+                "program": "swim_run",
+                "backend": "dense",
+                "platform": "cpu",
+                "n": 64,
+                "ticks": 8,
+                "replicas": 1,
+                "cold": i == 0,
+                "compile_s": 1.5 if i == 0 else 0.0,
+                "execute_s": 0.01 * (i + 1),
+                "peak_bytes": 1000,
+            }
+        )
+    rows = DispatchLedger.load_rows(path)
+    assert len(rows) == 3 and all("ts" in r for r in rows)
+    (group,) = summarize(rows)
+    assert group["dispatches"] == 3 and group["cold"] == 1
+    assert group["compile_s_total"] == pytest.approx(1.5)
+    assert group["peak_bytes_max"] == 1000
+    assert group["execute_s"]["count"] == 3
+
+
+def test_ledger_summarizer_cli(tmp_path, capsys):
+    from ringpop_tpu.obs import ledger as ledger_mod
+
+    path = str(tmp_path / "ledger.jsonl")
+    DispatchLedger(path).record(
+        {"program": "p", "backend": "dense", "platform": "cpu", "n": 8,
+         "ticks": 1, "replicas": 1, "cold": True, "compile_s": 0.5,
+         "execute_s": 0.01, "peak_bytes": 2048}
+    )
+    ledger_mod.main([path])
+    out = capsys.readouterr().out
+    assert "1 dispatches" in out and "p [dense/cpu]" in out
+
+
+def test_ledger_dispatch_cold_warm_parity(ledger):
+    @jax.jit
+    def double(x):
+        return x * 2
+
+    x = jnp.arange(8)
+    out_cold = ledger.dispatch("double", double, x, _meta={"n": 8})
+    out_warm = ledger.dispatch("double", double, x, _meta={"n": 8})
+    assert np.array_equal(np.asarray(out_cold), np.arange(8) * 2)
+    assert np.array_equal(np.asarray(out_warm), np.arange(8) * 2)
+    rows = [r for r in ledger.rows if r["program"] == "double"]
+    assert [r["cold"] for r in rows] == [True, False]
+    assert rows[0]["compile_s"] > 0 and rows[1]["compile_s"] == 0
+    assert all(r["execute_s"] > 0 for r in rows)
+
+
+def test_ledger_disabled_is_call_through():
+    led = DispatchLedger()  # never enabled, no env activation (explicit)
+    led._explicit = True
+    calls = []
+
+    def fake(*args, **kwargs):
+        calls.append((args, kwargs))
+        return "out"
+
+    assert led.dispatch("fake", fake, 1, k=2) == "out"
+    assert calls == [((1,), {"k": 2})] and led.rows == []
+
+
+def test_recv_merge_pallas_host_call_ledgered(ledger):
+    from ringpop_tpu.ops.recv_merge_pallas import recv_merge_pallas
+
+    n = 8
+    t_safe = jnp.zeros((n,), jnp.int32)
+    fwd_ok = jnp.ones((n,), bool)
+    claims = jnp.ones((n, n), jnp.int32)
+    in_key, inbound = recv_merge_pallas(t_safe, fwd_ok, claims, interpret=True)
+    assert int(inbound[0]) == n
+    (row,) = [r for r in ledger.rows if r["program"] == "recv_merge_pallas"]
+    assert row["n"] == n and row["cold"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance (a) + (b): one run_scenario -> ledger entry + bridged keys
+# ---------------------------------------------------------------------------
+
+
+def test_run_scenario_ledger_and_bridge_smoke(ledger):
+    cap = CaptureEmitter()
+    cluster = SimCluster(
+        8, sim.SwimParams(loss=0.0, suspicion_ticks=3), seed=1,
+        stats_emitter=cap,
+    )
+    trace = cluster.run_scenario(
+        {"ticks": 6, "events": [{"at": 1, "op": "kill", "node": 7}]}
+    )
+    assert trace.ticks == 6
+
+    # (a) the dispatch-ledger entry, compile/execute + footprint populated
+    (row,) = [r for r in ledger.rows if r["program"] == "run_scenario"]
+    assert row["backend"] == "dense" and row["n"] == 8 and row["ticks"] == 6
+    assert row["cold"] is True
+    assert row["compile_s"] > 0 and row["execute_s"] > 0
+    assert row["peak_bytes"] > 0 and row["argument_bytes"] > 0
+
+    # (b) the emitted key namespace is a superset of the reference-
+    # parity bridge keys, under the sim prefix
+    suffixes = cap.suffixes(bridge.DEFAULT_PREFIX)
+    missing = [k for k in bridge.REFERENCE_KEYS if k not in suffixes]
+    assert not missing, f"bridge keys missing from stream: {missing}"
+    # replayed counters match the trace they came from
+    fq = f"{bridge.DEFAULT_PREFIX}.ping.send"
+    assert cap.counters[fq] == int(np.asarray(trace.metrics["pings_sent"]).sum())
+
+
+def test_sim_cluster_tick_bridges_counters(ledger):
+    cap = CaptureEmitter()
+    cluster = SimCluster(8, sim.SwimParams(loss=0.0), seed=2,
+                         stats_emitter=cap)
+    metrics = cluster.tick()
+    fq = f"{bridge.DEFAULT_PREFIX}.ping.send"
+    assert cap.counters[fq] == metrics["pings_sent"]
+    assert f"{bridge.DEFAULT_PREFIX}.num-members" in cap.gauges
+    (row,) = [r for r in ledger.rows if r["program"] == "swim_step"]
+    assert row["n"] == 8 and row["compile_s"] > 0
+
+
+def test_emit_counters_multi_tick_entry_is_gauges_only():
+    """A multi-tick metrics entry carries only the LAST tick's counters
+    (swim_run discards the rest), so the bridge must not replay that
+    sample as the whole span's increments; gauges still update
+    (last-write-wins matches "latest tick")."""
+    cap = CaptureEmitter()
+    sink = bridge.StatSink(cap, "ringpop.t")
+    metrics = {"pings_sent": 7, "full_syncs": 1, "faulty_declared": 0,
+               "ping_changes_applied": 2, "ticks": 25}
+    bridge.emit_counters(metrics, sink, live=6)
+    assert cap.counters["ringpop.t.ping.send"] == 0
+    assert cap.counters["ringpop.t.full-sync"] == 0
+    assert cap.gauges["ringpop.t.changes.apply"] == 2
+    assert cap.gauges["ringpop.t.num-members"] == 6
+    # the same entry with ticks=1 replays exactly
+    bridge.emit_counters(dict(metrics, ticks=1), sink, live=6)
+    assert cap.counters["ringpop.t.ping.send"] == 7
+
+
+def test_destroy_leaves_shared_emitter_open(tmp_path):
+    """destroy() closes only emitters the node built from a spec string
+    — a caller-injected emitter may be shared by other live nodes."""
+    shared = JsonlEmitter(str(tmp_path / "shared.jsonl"))
+    node_a = make_node(host_port="10.0.0.1:3000", statsd=shared)
+    node_b = make_node(host_port="10.0.0.2:3000", statsd=shared)
+    node_a.destroy()
+    node_b.stat("increment", "ping.send")  # must not raise on closed file
+    shared.close()
+    assert shared.emitted >= 1
+    node_b.destroy()
+
+
+# ---------------------------------------------------------------------------
+# bridge key parity against the host facade's own emissions
+# ---------------------------------------------------------------------------
+
+
+def test_bridge_keys_are_exactly_host_emitted_keys():
+    """Every reference-parity key the bridge emits must be a key the
+    host RingPop stack itself emits (same suffix under the node's
+    ``ringpop.<host_port>`` prefix) — the namespace contract that makes
+    simulated metrics drop into real dashboards."""
+    cap = CaptureEmitter()
+    c = Cluster(size=3, statsd=cap)
+    c.bootstrap_all(run=False)
+    assert c.run_until_converged(60000)
+    c.kill(2)
+    c.run(25000)  # ping.send/recv, ping-req.send, suspect -> faulty
+    # manufacture a full sync: node 1 knows an extra member but has no
+    # changes left to piggyback, so a ping to it answers with full-sync
+    c.nodes[1].membership.make_alive("10.99.0.1:9999", 1)
+    c.nodes[1].dissemination.clear_changes()
+    c.run(10000)
+    suffixes = set()
+    for node in c.nodes:
+        suffixes |= cap.suffixes(node.stat_prefix)
+    missing = [k for k in bridge.REFERENCE_KEYS if k not in suffixes]
+    assert not missing, f"bridge keys the host never emitted: {missing}"
+    c.destroy_all()
+
+
+def test_replay_trace_synthetic_counts():
+    ticks = 4
+    trace = Trace(
+        metrics={
+            "pings_sent": np.array([3, 3, 3, 3]),
+            "acks": np.array([3, 2, 3, 3]),
+            "ping_reqs": np.array([0, 1, 0, 0]),
+            "full_syncs": np.array([0, 0, 1, 0]),
+            "suspects_declared": np.array([0, 1, 0, 0]),
+            "faulty_declared": np.array([0, 0, 1, 0]),
+            "ping_changes_applied": np.array([0, 2, 1, 0]),
+            "ack_changes_applied": np.array([0, 1, 0, 0]),
+            "pingreq_changes_applied": np.array([0, 0, 0, 0]),
+        },
+        converged=np.array([True, False, False, True]),
+        live=np.array([4, 3, 3, 3]),
+        loss=np.zeros(ticks, np.float32),
+        n=4,
+        backend="dense",
+    )
+    cap = CaptureEmitter()
+    bridge.replay_trace(trace, cap, prefix="ringpop.t", checksum=42)
+    assert cap.counters["ringpop.t.ping.send"] == 12
+    assert cap.counters["ringpop.t.ping.recv"] == 11
+    assert cap.counters["ringpop.t.ping-req.send"] == 1
+    assert cap.counters["ringpop.t.full-sync"] == 1
+    assert cap.counters["ringpop.t.membership-update.suspect"] == 1
+    assert cap.counters["ringpop.t.membership-update.faulty"] == 1
+    # tick-0 baseline only: live never rises afterwards
+    assert cap.counters["ringpop.t.membership-update.alive"] == 4
+    assert cap.gauges["ringpop.t.num-members"] == 3
+    assert cap.gauges["ringpop.t.checksum"] == 42
+    # zero-count keys still declared (the superset guarantee)
+    suffixes = cap.suffixes("ringpop.t")
+    assert set(bridge.REFERENCE_KEYS) <= suffixes
+
+
+# ---------------------------------------------------------------------------
+# acceptance (c): profiler scopes + trace directory
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_phase_scopes_in_compiled_hlo():
+    state = sim.init_state(8)
+    net = sim.make_net(8)
+    params = sim.SwimParams(loss=0.01)
+    txt = (
+        sim.swim_step.lower(state, net, jax.random.PRNGKey(0), params)
+        .compile()
+        .as_text()
+    )
+    for scope_name in (
+        "swim.phase01_select",
+        "swim.recv_merge",
+        "swim.merge_incoming",
+        "swim.pingreq",
+        "swim.pingreq_5a",
+        "swim.expiry",
+    ):
+        assert scope_name in txt, f"scope {scope_name} missing from HLO"
+
+
+def test_scope_composes_inside_and_outside_tracing():
+    """`annotate.scope` is a plain name-stack push: legal around
+    concrete ops and inside jit tracing alike.  The end-to-end
+    profiler-trace-directory check (start/stop_trace costs ~15 s of
+    xplane serialization on this host) lives in the CI obs-smoke step
+    (tools/obs_smoke.sh), which drives `tick-cluster --profile-dir`
+    for real."""
+    with annotate.scope("swim.outer"):
+        x = jnp.ones((4,)) + 1
+    assert float(x[0]) == 2.0
+
+    @annotate.scoped("swim.decorated")
+    def body(v):
+        return v * 3
+
+    y = jax.jit(body)(jnp.ones((4,)))
+    assert float(y[0]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# /admin endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_admin_ledger_endpoint(ledger):
+    from ringpop_tpu.server import RingpopServer
+
+    class FakeChannel:
+        def register(self, endpoints):
+            self.endpoints = endpoints
+
+    ledger.record(
+        {"program": "swim_run", "backend": "dense", "platform": "cpu",
+         "n": 8, "ticks": 4, "replicas": 1, "cold": True,
+         "compile_s": 0.2, "execute_s": 0.01, "peak_bytes": 64}
+    )
+    rp = make_node()
+    server = RingpopServer(rp, FakeChannel())
+    results = []
+    server.admin_ledger(None, None, "", lambda err, r1, r2: results.append((err, r2)))
+    err, body = results[0]
+    assert err is None
+    payload = json.loads(body)
+    assert payload["enabled"] and payload["dispatches"] == 1
+    assert payload["summary"][0]["program"] == "swim_run"

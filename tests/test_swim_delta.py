@@ -319,12 +319,19 @@ def test_delta_rejects_dense_partition_masks():
         sd.delta_step_impl(delta, net, jax.random.PRNGKey(0), dparams)
 
 
+@pytest.mark.slow
 def test_bit_identical_partition_split_and_heal():
     """Group-id netsplit: split at tick 10, heal at tick 40 (mid-
     transition, suspects still cross-pingable): the full divergence /
     spontaneous-remerge cycle must stay on the dense trajectory bit for
     bit.  Peak per-viewer divergence reaches ~n/2 (the netsplit's dense
-    transition), so capacity is ample here."""
+    transition), so capacity is ample here.
+
+    Nightly lane: ~42 s (the 3n² claim grid dominates compile) while
+    tier-1 pushes the ROADMAP's 870 s watchdog; netsplit parity keeps
+    tier-1 representatives (`test_sided_netsplit_bounded_capacity_
+    heals`, `test_bit_identical_self_bootstrap`,
+    `test_bit_identical_steady_state_with_loss`)."""
     n = 24
     params = sim.SwimParams(loss=0.02, suspicion_ticks=6)
     # ample caps for a netsplit mean claim_grid = 3 * n * n: the post-heal

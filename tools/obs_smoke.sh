@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# CPU obs smoke: a compiled scenario driven through the tick-cluster
+# CLI must (a) leave a dispatch-ledger entry with compile/execute and
+# peak-bytes populated, (b) emit a --stats-out stream whose key set is
+# a superset of the reference-parity bridge keys, and (c) write a
+# profiler trace directory with the named protocol-phase scopes active.
+# This is the CI obs-smoke job's body; run it locally the same way:
+#   tools/obs_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/ringpop-obs.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+spec="$workdir/spec.json"
+stats="$workdir/stats.jsonl"
+ledger="$workdir/ledger.jsonl"
+profdir="$workdir/profile"
+
+cat > "$spec" <<'EOF'
+{
+  "ticks": 40,
+  "events": [
+    {"at": 5,  "op": "kill", "node": 3},
+    {"at": 10, "op": "loss", "p": 0.05},
+    {"at": 25, "op": "loss", "p": 0.0}
+  ]
+}
+EOF
+
+JAX_PLATFORMS=cpu RINGPOP_LEDGER="$ledger" timeout -k 10 600 \
+  python -m ringpop_tpu tick-cluster --backend tpu-sim -n 16 \
+  --scenario "$spec" --stats-out "$stats" --profile-dir "$profdir" \
+  | tee "$workdir/out.log"
+
+grep -q "one dispatch" "$workdir/out.log"
+
+JAX_PLATFORMS=cpu python - "$stats" "$ledger" "$profdir" <<'EOF'
+import json
+import pathlib
+import sys
+
+from ringpop_tpu.obs.bridge import DEFAULT_PREFIX, REFERENCE_KEYS
+from ringpop_tpu.obs.ledger import DispatchLedger
+
+stats_path, ledger_path, profdir = sys.argv[1:4]
+
+# (b) reference-shaped, non-empty key namespace
+keys = {json.loads(line)["key"] for line in open(stats_path)}
+assert keys, "stats stream is empty"
+missing = [k for k in REFERENCE_KEYS if f"{DEFAULT_PREFIX}.{k}" not in keys]
+assert not missing, f"missing reference keys: {missing}"
+
+# (a) the scenario's ledger row with forensics populated
+rows = [r for r in DispatchLedger.load_rows(ledger_path)
+        if r["program"] == "run_scenario"]
+assert len(rows) == 1, rows
+row = rows[0]
+assert row["cold"] and row["compile_s"] > 0 and row["execute_s"] > 0
+assert row["peak_bytes"] > 0 and row["n"] == 16 and row["ticks"] == 40
+
+# (c) the profiler trace directory exists and is non-empty
+files = [p for p in pathlib.Path(profdir).rglob("*") if p.is_file()]
+assert files, "profiler trace directory is empty"
+
+print(f"obs smoke OK: {len(keys)} stat keys, ledger row "
+      f"(compile {row['compile_s']:.2f}s, execute {row['execute_s']:.3f}s, "
+      f"peak {row['peak_bytes']} B), {len(files)} trace files")
+EOF
