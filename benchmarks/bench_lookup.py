@@ -1,0 +1,184 @@
+"""Batched device ring lookups vs the host one-key loop.
+
+The serving question behind ROADMAP's "millions of users" axis: how
+many consistent-hash lookups per second does each path sustain?
+
+* **host loop** — the status quo before the traffic plane: one
+  ``HashRing.lookup(key)`` per key (farmhash + bisect per call), the
+  way every serving-layer call site worked (``models/cluster.py``'s
+  old ``lookup`` loop).
+* **device batch** — ``ops/ring_ops.lookup_idx``: one ``searchsorted``
+  over the whole pre-hashed key tensor (the workload contract:
+  traffic/workloads.py pools are hashed once, up front).
+* **device masked** — the traffic engine's actual hot path
+  (``traffic.engine.lookup_masked_idx``): the same batch resolved
+  through a per-viewer membership mask over the GLOBAL ring, i.e. a
+  per-viewer ring that never materializes.
+
+Device arms dispatch through the obs ledger, so each rung leaves a
+compile-vs-execute forensics row; every JSON line carries the ledger
+path (bench.py convention).  A final scenario-coupled config runs a
+kill under load (SimCluster.run_scenario + traffic) and reports the
+misroute-vs-ring-divergence correlation from the trace the stats
+bridge streams.
+
+Usage: python -m benchmarks.bench_lookup  (or via benchmarks.run_all)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+DEFAULT_LEDGER_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_lookup_ledger.jsonl"
+)
+
+
+def _best_keys_per_sec(fn, m: int, repeats: int) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return m / best
+
+
+def run(n: int = 64, repeats: int = 3, batches=(1024, 16384)) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.hashring import HashRing
+    from ringpop_tpu.obs.ledger import default_ledger
+    from ringpop_tpu.ops import ring_ops
+    from ringpop_tpu.traffic import engine as tengine
+    from ringpop_tpu.traffic.workloads import WorkloadSpec
+
+    led = default_ledger()
+    if not led.enabled:
+        open(DEFAULT_LEDGER_PATH, "w").close()  # fresh forensics per run
+        led.enable(DEFAULT_LEDGER_PATH)
+    ledger_path = led.path
+
+    addrs = [f"10.0.{i // 250}.{i % 250}:{3000 + i}" for i in range(n)]
+    host = HashRing()
+    host.add_remove_servers(addrs, [])
+    ring = ring_ops.build_ring(addrs)
+    platform = jax.devices()[0].platform
+
+    lookup_jit = jax.jit(ring_ops.lookup_idx)
+    masked_jit = jax.jit(
+        lambda rh, ro, kh, mask: tengine.lookup_masked_idx(
+            rh, ro, kh, mask, window=256
+        )
+    )
+
+    results: list[dict] = []
+    pool = WorkloadSpec(pool=max(batches)).pool_keys()
+    for m in batches:
+        keys = pool[:m]
+        khash_np = np.array([host.hash_func(k) for k in keys], dtype=np.uint32)
+        khash = jnp.asarray(khash_np)
+        mask = jnp.ones((m, n), dtype=bool)
+
+        def host_loop():
+            for k in keys:
+                host.lookup(k)
+
+        # timed arms are the BARE compiled calls: the ledger's
+        # per-dispatch bookkeeping (signature hash + JSON row) would be
+        # a fixed overhead comparable to the kernel at small batches
+        def device_batch():
+            lookup_jit(ring, khash).block_until_ready()
+
+        def device_masked():
+            masked_jit(ring.hashes, ring.owners, khash, mask)[
+                0
+            ].block_until_ready()
+
+        # one ledgered dispatch per arm, outside the measurement loop:
+        # the compile-vs-execute forensics row without polluting timings
+        led.dispatch(
+            "bench_lookup_batch", lookup_jit, ring, khash,
+            _meta={"backend": "device", "n": n, "ticks": 1, "replicas": m},
+        )
+        led.dispatch(
+            "bench_lookup_masked", masked_jit,
+            ring.hashes, ring.owners, khash, mask,
+            _meta={"backend": "device", "n": n, "ticks": 1, "replicas": m},
+        )
+        device_batch()  # compile outside the timed region
+        device_masked()
+        host_rate = _best_keys_per_sec(host_loop, m, repeats)
+        dev_rate = _best_keys_per_sec(device_batch, m, repeats)
+        masked_rate = _best_keys_per_sec(device_masked, m, repeats)
+        base = {
+            "unit": "keys/sec",
+            "n": n,
+            "batch": m,
+            "platform": platform,
+            "ledger": ledger_path,
+        }
+        results += [
+            {**base, "metric": "lookup_host_loop",
+             "value": round(host_rate, 1)},
+            {**base, "metric": "lookup_device_batch",
+             "value": round(dev_rate, 1),
+             "speedup_vs_host": round(dev_rate / host_rate, 2)},
+            {**base, "metric": "lookup_device_masked",
+             "value": round(masked_rate, 1),
+             "speedup_vs_host": round(masked_rate / host_rate, 2)},
+        ]
+    results += _scenario_coupled(ledger_path, platform)
+    return results
+
+
+def _scenario_coupled(ledger_path: str | None, platform: str) -> list[dict]:
+    """A kill under load: one compiled scenario+traffic dispatch, the
+    trace replayed through the stats bridge, and the headline number —
+    how tightly per-tick misroutes track ring divergence."""
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.models.swim_sim import SwimParams
+    from ringpop_tpu.obs.emitters import CaptureEmitter
+
+    cap = CaptureEmitter()
+    cluster = SimCluster(16, SwimParams(), seed=3, stats_emitter=cap)
+    spec = {
+        "ticks": 40,
+        "events": [
+            {"at": 5, "op": "kill", "node": 3},
+            {"at": 25, "op": "revive", "node": 3},
+        ],
+    }
+    t0 = time.perf_counter()
+    trace = cluster.run_scenario(spec, traffic="uniform:256")
+    wall = time.perf_counter() - t0
+    mis = trace.metrics["misroutes"].astype(np.float64)
+    div = trace.metrics["ring_divergence"].astype(np.float64)
+    if mis.std() > 0 and div.std() > 0:
+        corr = float(np.corrcoef(mis, div)[0, 1])
+    else:
+        corr = 0.0
+    bridged = sum(1 for _, key, _ in cap.calls if "lookup" in key)
+    return [{
+        "metric": "scenario_traffic_misroute_divergence_corr",
+        "value": round(corr, 3),
+        "unit": "pearson-r",
+        "n": 16,
+        "ticks": 40,
+        "misroutes_total": int(mis.sum()),
+        "divergence_ticks": int((div > 0).sum()),
+        "bridged_lookup_stats": bridged,
+        "wall_s": round(wall, 2),
+        "platform": platform,
+        "ledger": ledger_path,
+    }]
+
+
+if __name__ == "__main__":
+    import json
+
+    for row in run():
+        print(json.dumps(row), flush=True)

@@ -28,6 +28,7 @@ SIM_BENCHES = [
     "bench_pingreq_deviation",
     "bench_scenario",  # one-call compiled scenario vs the host loop
     "bench_sweep",  # one vmapped R-replica dispatch vs R sequential
+    "bench_lookup",  # batched device ring lookups vs the host loop
 ]
 
 

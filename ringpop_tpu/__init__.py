@@ -14,6 +14,9 @@ designed TPU-first:
   nodes per chip with membership checksums identical to the host library.
 * ``ringpop_tpu.ops`` — bit-exact FarmHash32 (C / Python / JAX), checksum and
   hash-ring kernels.
+* ``ringpop_tpu.traffic`` — the serving plane: compiled key workloads
+  (uniform / Zipf / per-tenant) resolved through per-viewer device rings
+  with handle-or-forward simulation, co-run with scenario timelines.
 * ``ringpop_tpu.parallel`` — jax.sharding mesh layouts for multi-chip scale.
 """
 

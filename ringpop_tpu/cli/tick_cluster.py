@@ -42,11 +42,18 @@ from ringpop_tpu.cli.admin_client import AdminRequestError, admin_request
 from ringpop_tpu.cli.generate_hosts import generate
 
 
-def print_op_percentiles(protocol: dict[str, Any], indent: str = "    ") -> None:
+def print_op_percentiles(stats: dict[str, Any], indent: str = "    ") -> None:
     """The per-operation p50/p95/p99 lines of the `p` command, shared
-    by the proc and host-sim drivers (get_stats()['protocol'] shape)."""
-    for op in ("ping", "pingReq"):
-        agg = protocol.get(op)
+    by the proc and host-sim drivers (full get_stats() shape): the
+    protocol timings plus the serving-layer lookup/lookupN aggregates."""
+    protocol = stats.get("protocol", {})
+    ops = [
+        ("ping", protocol.get("ping")),
+        ("pingReq", protocol.get("pingReq")),
+        ("lookup", stats.get("lookup")),
+        ("lookupN", stats.get("lookupN")),
+    ]
+    for op, agg in ops:
         if agg and agg.get("count"):
             print(
                 f"{indent}{op}: p50={agg['median']:.1f}"
@@ -204,7 +211,7 @@ class ProcCluster(ClusterDriver):
                     f" p50={timing['median']:.1f} p95={timing['p95']:.1f}"
                     f" p99={timing['p99']:.1f} count={timing['count']}"
                 )
-                print_op_percentiles(r["protocol"])
+                print_op_percentiles(r)
             else:
                 print(f"  {hp}: {r}")
 
@@ -319,7 +326,7 @@ class SimCluster(ClusterDriver):
                 f"  {node.host_port}: p50={timing['median']:.1f}"
                 f" p95={timing['p95']:.1f} count={timing['count']}"
             )
-            print_op_percentiles(stats["protocol"])
+            print_op_percentiles(stats)
 
     def debug_set(self, flag: str) -> None:
         for node in self.cluster.live_nodes():
@@ -500,19 +507,28 @@ class TpuSimCluster(ClusterDriver):
         sweep: int = 0,
         sweep_loss_scales: list[float] | None = None,
         sweep_kill_jitter: list[int] | None = None,
+        traffic: str | None = None,
     ) -> None:
         """Run a JSON scenario spec as ONE jitted call (scenarios/);
-        with ``sweep=R`` run R replicas in one vmapped dispatch."""
+        with ``sweep=R`` run R replicas in one vmapped dispatch; with
+        ``traffic`` co-run a key workload (spec shorthand like
+        ``zipf:512``, or a JSON workload file) inside the same
+        compiled program and report the serving counters."""
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
         spec = ScenarioSpec.load(path)
         if sweep:
+            if traffic:
+                raise ValueError(
+                    "traffic does not compose with sweep yet "
+                    "(serve traffic on a single-replica scenario)"
+                )
             self._run_sweep(
                 spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter
             )
             return
         t0 = time.perf_counter()
-        trace = self.cluster.run_scenario(spec)
+        trace = self.cluster.run_scenario(spec, traffic=traffic)
         wall_ms = (time.perf_counter() - t0) * 1000
         state = (
             "CONVERGED" if trace.converged[-1]
@@ -525,6 +541,27 @@ class TpuSimCluster(ClusterDriver):
             f"live {int(trace.live[-1])}/{self.cluster.n}"
         )
         print(format_groups(self.cluster.checksum_groups(), wall_ms))
+        if traffic and "lookups" in trace.metrics:
+            m = trace.metrics
+            lookups = int(m["lookups"].sum())
+            misroutes = int(m["misroutes"].sum())
+            peak = int(m["misroutes"].argmax())
+            hops = {
+                k[4:]: int(v.sum())
+                for k, v in sorted(
+                    m.items(),
+                    key=lambda kv: int(kv[0][4:]) if kv[0][4:].isdigit() else 0,
+                )
+                if k.startswith("hops") and v.sum()
+            }
+            print(
+                f"traffic: {lookups} lookups served, "
+                f"{int(m['delivered'].sum())} delivered, "
+                f"{misroutes} misroutes (peak {int(m['misroutes'][peak])} "
+                f"at tick {peak}), {int(m['proxy_retries'].sum())} retries, "
+                f"{int(m['proxy_failed'].sum())} failed; "
+                f"forward hops {hops}"
+            )
         if trace_out:
             trace.save(trace_out)
             print(f"trace ({trace.ticks} ticks x "
@@ -663,6 +700,15 @@ def add_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--trace-out", default=None, metavar="FILE",
                         help="with --scenario: write the per-tick telemetry "
                              "trace (.npz) here")
+    parser.add_argument("--traffic", default=None, metavar="SPEC",
+                        help="with --scenario: co-run a key workload in "
+                             "the same compiled program — SPEC is "
+                             "kind:M[:pool] shorthand (uniform/zipf/"
+                             "tenant, M keys per tick) or a JSON "
+                             "workload file (traffic/workloads.py); "
+                             "serving counters (lookup, requestProxy.*, "
+                             "misroutes, forward hops) join the trace "
+                             "and the --stats-out stream")
     parser.add_argument("--sweep", type=int, default=0, metavar="R",
                         help="with --scenario: run R replicas of the "
                              "scenario in ONE vmapped jitted dispatch "
@@ -722,6 +768,12 @@ def main(argv: list[str] | None = None) -> None:
     if args.sweep and not args.scenario:
         parser.error("--sweep needs --scenario (it replicates a compiled "
                      "scenario, not an interactive session)")
+    if args.traffic and not args.scenario:
+        parser.error("--traffic needs --scenario (the workload co-runs "
+                     "inside the compiled scenario scan)")
+    if args.traffic and args.sweep:
+        parser.error("--traffic does not compose with --sweep yet "
+                     "(serve traffic on a single-replica scenario)")
     if (args.stats_out or args.profile_dir) and backend != "tpu-sim":
         parser.error("--stats-out/--profile-dir need --backend tpu-sim "
                      "(the obs bridge and profiler scopes instrument the "
@@ -761,6 +813,7 @@ def main(argv: list[str] | None = None) -> None:
                     args.scenario, args.trace_out, sweep=args.sweep,
                     sweep_loss_scales=sweep_scales,
                     sweep_kill_jitter=sweep_jitter,
+                    traffic=args.traffic,
                 )
             elif args.script:
                 run_script(driver, args.script)

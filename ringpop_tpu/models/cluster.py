@@ -14,6 +14,7 @@ reference-format checksums and stats.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Sequence
 
 import jax
@@ -34,6 +35,26 @@ DEFAULT_BASE_INC = 1_400_000_000_000  # host clock epoch (clock.SimScheduler)
 
 # the predicate itself lives in swim_sim (shared with the scenario scan)
 _converged_impl = jax.jit(sim.converged_impl)
+
+
+@partial(jax.jit, static_argnames=("window",))
+def _lookup_batch_jit(ring_hashes, ring_owners, bufs, lens, in_ring, *, window):
+    """One dispatch: hash the key strings on device, then resolve each
+    through the masked global ring.  The walk is windowed (a full-ring
+    window would gather O(M x 100N) — gigabytes at the batch sizes this
+    exists for); the host caller resolves the geometrically-rare
+    ``found=False`` residue through the host ring.  ``in_ring`` is the
+    single viewer's bool[N] row — broadcast to the kernel's [M, N] form
+    INSIDE the jit, where XLA fuses it into the gather instead of
+    materializing an M x N buffer."""
+    from ringpop_tpu.ops.farmhash_jax import farmhash32_batch_jax
+    from ringpop_tpu.traffic import engine as tengine
+
+    hashes = farmhash32_batch_jax(bufs, lens)
+    mask = jnp.broadcast_to(in_ring[None, :], (bufs.shape[0], in_ring.shape[0]))
+    return tengine.lookup_masked_idx(
+        ring_hashes, ring_owners, hashes, mask, window=window
+    )
 
 
 def groups_to_gid(groups: Sequence[Sequence[int]], n: int) -> np.ndarray:
@@ -117,6 +138,7 @@ class SimCluster:
             else None
         )
         self._device_book = None  # lazy ckdev.DeviceBook (device checksums)
+        self._traffic_ring = None  # lazy global DeviceRing (traffic plane)
         if device is not None:
             self.state = jax.device_put(self.state, device)
             self.net = jax.device_put(self.net, device)
@@ -179,7 +201,7 @@ class SimCluster:
             )
         return out
 
-    def run_scenario(self, spec) -> Any:
+    def run_scenario(self, spec, traffic: Any | None = None) -> Any:
         """Run a declarative fault timeline as ONE jitted call.
 
         ``spec`` is a ``scenarios.ScenarioSpec`` (or its dict form, or
@@ -192,6 +214,14 @@ class SimCluster:
         trajectory is bit-identical to the equivalent host sequence of
         ``kill()``/``partition()``/``tick()`` calls — minus the
         per-fault dispatch round-trips.
+
+        ``traffic`` (a ``traffic.WorkloadSpec``, its dict/JSON-path/
+        shorthand form, or a pre-lowered ``CompiledTraffic``) co-runs a
+        batched key workload inside the same compiled program: every
+        tick's keys are served through per-viewer device rings derived
+        from that tick's views, adding lookup/forward/misroute counters
+        to the trace.  The workload PRNG is its own stream — the
+        protocol trajectory stays bit-identical to a traffic-free run.
         """
         from ringpop_tpu.scenarios import compile as scompile
         from ringpop_tpu.scenarios import runner as srunner
@@ -203,6 +233,8 @@ class SimCluster:
         elif isinstance(spec, dict):
             spec = ScenarioSpec.from_dict(spec)
         spec.validate(self.n)
+        if traffic is not None:
+            traffic = self.compile_traffic(traffic)
         compiled = scompile.compile_spec(
             spec, self.n, base_loss=self.params.loss
         )
@@ -213,10 +245,15 @@ class SimCluster:
         params = self.dparams if self.backend == "delta" else self.params
         start_tick = int(self.state.tick)
         self.state, self.net, ys = srunner.run_compiled(
-            self.state, self.net, keys, compiled, params
+            self.state, self.net, keys, compiled, params, traffic=traffic
         )
         self.set_loss(float(compiled.loss[-1]))  # host mirror of the schedule
         stacks = {k: np.asarray(v) for k, v in ys.items()}
+        spec_dict = spec.to_dict()
+        if traffic is not None:
+            # provenance rides along in the trace (ScenarioSpec.from_dict
+            # ignores unknown keys, so the npz round trip is unaffected)
+            spec_dict["traffic"] = traffic.spec.to_dict()
         trace = Trace(
             metrics={
                 k: v
@@ -229,7 +266,7 @@ class SimCluster:
             n=self.n,
             backend=self.backend,
             start_tick=start_tick,
-            spec=spec.to_dict(),
+            spec=spec_dict,
         ).validate()
         self.traces.append(trace)
         entry = {k: int(v[-1]) for k, v in trace.metrics.items()}
@@ -448,6 +485,86 @@ class SimCluster:
 
     def lookup(self, key: str, viewer: int = 0) -> str | None:
         return self.ring_for(viewer).lookup(key)
+
+    # -- batched device lookups (traffic plane, ops/ring_ops.py) -------------
+
+    def traffic_ring(self):
+        """The cluster's GLOBAL device ring — every address's replica
+        points, sorted; per-viewer rings are masks over it (the traffic
+        engine's representation).  The address book is immutable, so
+        this is built once and cached."""
+        if self._traffic_ring is None:
+            from ringpop_tpu.ops import ring_ops
+
+            self._traffic_ring = ring_ops.build_ring(self.book.addresses)
+        return self._traffic_ring
+
+    def compile_traffic(self, spec: Any) -> Any:
+        """Lower a ``traffic.WorkloadSpec`` (or its dict/JSON/shorthand
+        form) against this cluster's address book, reusing the cached
+        global ring.  A pre-lowered ``CompiledTraffic`` passes through
+        only if it was lowered against a cluster of the same size —
+        foreign viewer indices and ring tables would otherwise clamp
+        silently inside jitted gathers and report bogus counters."""
+        from ringpop_tpu.traffic import workloads as tworkloads
+
+        if isinstance(spec, tworkloads.CompiledTraffic):
+            if spec.n != self.n:
+                raise ValueError(
+                    f"CompiledTraffic was lowered for n={spec.n}, "
+                    f"this cluster has n={self.n}; re-compile the spec"
+                )
+            return spec
+        return tworkloads.compile_traffic(
+            spec, self.n, self.book.addresses, ring=self.traffic_ring()
+        )
+
+    def lookup_batch(
+        self, keys: Sequence[str], viewer: int = 0
+    ) -> list[str | None]:
+        """Resolve a whole batch of keys through ``viewer``'s ring in
+        ONE device dispatch — the batched replacement for looping
+        ``lookup()`` one key at a time: keys are hashed on device
+        (farmhash kernel) and resolved by a masked walk of the cached
+        global ring, bit-identical to ``ring_for(viewer).lookup``
+        (tests/test_traffic.py pins it) — including the empty-ring case,
+        which yields ``None`` per key like the host path.  The walk is
+        windowed (memory-bounded at any batch size); keys it cannot
+        settle — geometrically rare unless the viewer's ring is nearly
+        empty — fall back to the host ring."""
+        from ringpop_tpu.ops import ring_ops
+        from ringpop_tpu.traffic import engine as tengine
+        from ringpop_tpu.traffic.workloads import DEFAULT_WINDOW
+
+        keys = list(keys)
+        if not keys:
+            return []
+        ring = self.traffic_ring()
+        row = jnp.asarray(self._view_rows(np.asarray([viewer]))[0])
+        in_ring = tengine.in_ring_from_rows(row)
+        if getattr(self.state, "damped", None) is not None:
+            # damped members are quarantined from the ring (ring_for)
+            in_ring = in_ring & ~self.state.damped[viewer]
+        bufs, lens = ring_ops.encode_strings(keys)
+        owners, found = _lookup_batch_jit(
+            ring.hashes,
+            ring.owners,
+            jnp.asarray(bufs),
+            jnp.asarray(lens),
+            in_ring,
+            window=min(ring.size, DEFAULT_WINDOW),
+        )
+        owners = np.asarray(owners)
+        found = np.asarray(found)
+        out: list[str | None] = [
+            self.book.addresses[int(o)] if ok else None
+            for o, ok in zip(owners, found)
+        ]
+        if not found.all():
+            host_ring = self.ring_for(viewer)
+            for i in np.flatnonzero(~found):
+                out[i] = host_ring.lookup(keys[i])
+        return out
 
     # -- fault injection (tick-cluster.js:418-471; partitions via masks) -----
 

@@ -25,6 +25,19 @@ against the host facade's own emissions in tests/test_obs.py):
 | live                         | gauge     | num-members                 |
 | checksum (caller-provided)   | gauge     | checksum                    |
 
+Traffic-coupled traces (scenarios co-run with a ``traffic`` workload)
+additionally carry the serving plane's counters:
+
+| lookups                      | increment | lookup                       |
+| lookupns                     | increment | lookupn                      |
+| proxy_sends                  | increment | requestProxy.send.success    |
+| proxy_retries                | increment | requestProxy.retry.attempted |
+| proxy_failed                 | increment | requestProxy.retry.failed    |
+
+with the rest of the traffic series (misroutes, delivered_misroutes,
+ring_divergence, hops0..hopsK, unresolved, dropped ...) flowing as
+``sim.``-prefixed gauges like every other sim-only series.
+
 Increments carry the tick's count as the statsd count value (``:N|c``);
 zero-count ticks emit nothing (the reference increments per event, so
 an eventless tick is silence there too).  ``membership-update.alive``
@@ -43,13 +56,31 @@ from typing import Any
 import numpy as np
 
 # trace counter -> reference increment key (per tick, count as value)
-COUNTER_KEYS: dict[str, str] = {
+PROTOCOL_COUNTER_KEYS: dict[str, str] = {
     "pings_sent": "ping.send",
     "acks": "ping.recv",
     "ping_reqs": "ping-req.send",
     "full_syncs": "full-sync",
     "suspects_declared": "membership-update.suspect",
     "faulty_declared": "membership-update.faulty",
+}
+
+# traffic-plane counters (traffic/engine.counter_names) -> the serving
+# layer's reference keys: lookup/lookupn are the index.js lookup stats,
+# the requestProxy.* trio is request_proxy/send.py's retry accounting.
+# Kept out of REFERENCE_KEYS: a scenario without traffic emits none of
+# these (the host stack only emits them when lookups/proxies happen).
+TRAFFIC_COUNTER_KEYS: dict[str, str] = {
+    "lookups": "lookup",
+    "lookupns": "lookupn",
+    "proxy_sends": "requestProxy.send.success",
+    "proxy_retries": "requestProxy.retry.attempted",
+    "proxy_failed": "requestProxy.retry.failed",
+}
+
+COUNTER_KEYS: dict[str, str] = {
+    **PROTOCOL_COUNTER_KEYS,
+    **TRAFFIC_COUNTER_KEYS,
 }
 
 # the changes-applied trio folds into the reference's changes.apply gauge
@@ -59,15 +90,19 @@ CHANGES_APPLIED = (
     "pingreq_changes_applied",
 )
 
-# every reference-parity key the bridge can emit — the namespace the CI
-# smoke asserts a scenario's --stats-out stream is a superset of
+# every reference-parity key the bridge emits for ANY scenario — the
+# namespace the CI smoke asserts a scenario's --stats-out stream is a
+# superset of (traffic keys join only when a workload co-ran)
 REFERENCE_KEYS: tuple[str, ...] = (
-    *COUNTER_KEYS.values(),
+    *PROTOCOL_COUNTER_KEYS.values(),
     "membership-update.alive",
     "changes.apply",
     "num-members",
     "checksum",
 )
+
+# the serving-plane keys a traffic-coupled scenario additionally emits
+TRAFFIC_KEYS: tuple[str, ...] = tuple(TRAFFIC_COUNTER_KEYS.values())
 
 DEFAULT_PREFIX = "ringpop.sim"
 
@@ -164,7 +199,14 @@ def replay_trace(
     sink = StatSink(emitter, prefix)
     calls0 = 0
     if declare_namespace:
-        for key in (*COUNTER_KEYS.values(), "membership-update.alive"):
+        declared = [*PROTOCOL_COUNTER_KEYS.values(), "membership-update.alive"]
+        if "lookups" in trace.metrics:  # a traffic-coupled trace
+            declared += [
+                TRAFFIC_COUNTER_KEYS[s]
+                for s in TRAFFIC_COUNTER_KEYS
+                if s in trace.metrics
+            ]
+        for key in declared:
             sink.increment(key, 0)
             calls0 += 1
         if checksum is None:

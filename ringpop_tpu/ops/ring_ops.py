@@ -167,7 +167,11 @@ def lookup_keys(ring: DeviceRing, key_bufs: jax.Array, key_lens: jax.Array) -> j
 
 
 def lookup_n_idx(
-    ring: DeviceRing, key_hashes: jax.Array, n: int, window: int | None = None
+    ring: DeviceRing,
+    key_hashes: jax.Array,
+    n: int,
+    window: int | None = None,
+    in_ring: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Preference list per key: the first ``n`` distinct owners walking
     the ring clockwise with wraparound (ring.js:150-182 lookupN).
@@ -178,7 +182,14 @@ def lookup_n_idx(
     ``complete[m]`` is False when the window ended before finding
     ``min(n, server_count)`` distinct owners — callers re-resolve those
     rows with a larger window (or the host ring) rather than trusting
-    the -1 padding."""
+    the -1 padding.
+
+    ``in_ring`` (bool[M, S], optional) restricts key m's walk to the
+    masked server subset — the traffic plane's per-viewer rings
+    (bit-identical to a host ring built from exactly that subset; equal
+    owners share a mask value, so the first-occurrence dedup is
+    unchanged).  The completeness floor then counts each key's in-mask
+    servers instead of the global server count."""
     if ring.size == 0:
         raise ValueError("lookupN on an empty DeviceRing (no servers)")
     if window is None:
@@ -187,10 +198,12 @@ def lookup_n_idx(
     start = jnp.searchsorted(ring.hashes, key_hashes, side="left")
     offs = (start[:, None] + jnp.arange(window)[None, :]) % ring.size
     owners = ring.owners[offs]  # int32[M, W]
-    # first occurrence of each owner within the walk
+    # first (in-mask) occurrence of each owner within the walk
     eq = owners[:, :, None] == owners[:, None, :]
     earlier = jnp.tril(jnp.ones((window, window), dtype=bool), k=-1)
     first = ~jnp.any(eq & earlier[None, :, :], axis=2)
+    if in_ring is not None:
+        first = first & jnp.take_along_axis(in_ring, owners, axis=1)
     rank = jnp.cumsum(first.astype(jnp.int32), axis=1) - 1
     m = key_hashes.shape[0]
     rows = jnp.broadcast_to(jnp.arange(m)[:, None], owners.shape)
@@ -198,7 +211,10 @@ def lookup_n_idx(
     cols = jnp.where(first & (rank < n), rank, n)
     out = jnp.full((m, n), -1, dtype=jnp.int32)
     out = out.at[rows, cols].set(owners, mode="drop")
-    server_count = jnp.max(ring.owners) + 1
+    if in_ring is None:
+        server_count: jax.Array = jnp.max(ring.owners) + 1
+    else:
+        server_count = jnp.sum(in_ring.astype(jnp.int32), axis=1)
     found = jnp.sum(first.astype(jnp.int32), axis=1)
     complete = (found >= jnp.minimum(n, server_count)) | (window >= ring.size)
     return out, complete

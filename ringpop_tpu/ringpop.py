@@ -410,6 +410,9 @@ class RingPop(EventEmitter):
     def lookup(self, key: Any) -> str:
         start = self.clock.now()
         dest = self.ring.lookup(str(key))
+        # timing stat + local histogram (same path as ping/ping-req), so
+        # get_stats()["lookup"] answers p50/p95/p99 without a collector
+        self.stat("timing", "lookup", self.clock.now() - start)
         self.emit("lookup", {"timing": self.clock.now() - start})
         if not dest:
             self.logger.debug("could not find destination for a key", {"key": key})
@@ -419,6 +422,7 @@ class RingPop(EventEmitter):
     def lookup_n(self, key: Any, n: int) -> list[str]:
         start = self.clock.now()
         dests = self.ring.lookup_n(str(key), n)
+        self.stat("timing", "lookupn", self.clock.now() - start)
         self.emit("lookupN", {"timing": self.clock.now() - start})
         if not dests:
             self.logger.debug("could not find destinations for a key", {"key": key})
@@ -509,6 +513,10 @@ class RingPop(EventEmitter):
                 "pingReq": self.timing_stats("ping-req"),
             },
             "ring": list(self.ring.servers.keys()),
+            # serving-layer timing aggregates (the lookup/lookupn stats
+            # emitted above; tick-cluster's `p` command prints them)
+            "lookup": self.timing_stats("lookup"),
+            "lookupN": self.timing_stats("lookupn"),
             "version": __version__,
             "timestamp": timestamp,
             "uptime": timestamp - self.start_time,

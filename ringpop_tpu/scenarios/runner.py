@@ -43,6 +43,7 @@ from ringpop_tpu.scenarios.compile import (
     expand_events,
 )
 from ringpop_tpu.scenarios.spec import ScenarioSpec
+from ringpop_tpu.traffic import engine as traffic_engine
 
 _dispatches = 0
 
@@ -132,9 +133,11 @@ def _scenario_scan_impl(
     p_gid,
     loss,
     keys,
+    tr_tensors=None,
     *,
     params,
     has_revive: bool,
+    traffic=None,
 ):
     n = up.shape[0]
     ticks = keys.shape[0]
@@ -180,6 +183,22 @@ def _scenario_scan_impl(
         y["converged"] = conv
         y["live"] = live
         y["loss"] = loss_t
+        if traffic is not None:
+            # serve this tick's key batch against the views the protocol
+            # period just produced: lookups under churn, in the same
+            # compiled program (workload PRNG is its own stream, so the
+            # protocol trajectory is bit-identical with traffic off).
+            # Rows are passed as a thunk so the delta backend's O(N^2)
+            # materialize only traces inside the on-cadence branch.
+            y.update(
+                traffic_engine.serve_tick(
+                    (lambda s=st: sdelta.materialize_rows(s, ids))
+                    if is_delta
+                    else st.view_key,
+                    u, r, tr_tensors, t, static=traffic,
+                    damped=getattr(st, "damped", None),
+                )
+            )
         return (st, u, r, gid), y
 
     xs = (jnp.arange(ticks, dtype=jnp.int32), keys, loss)
@@ -191,7 +210,7 @@ def _scenario_scan_impl(
 
 _scenario_scan = jax.jit(
     _scenario_scan_impl,
-    static_argnames=("params", "has_revive"),
+    static_argnames=("params", "has_revive", "traffic"),
     donate_argnums=(0, 1, 2, 3),
 )
 
@@ -202,6 +221,7 @@ def run_compiled(
     keys: jax.Array,
     compiled: CompiledScenario,
     params: SwimParams | DeltaParams,
+    traffic: Any | None = None,
 ) -> tuple[Any, NetState, dict[str, jax.Array]]:
     """One jitted call: (state, net, per-tick telemetry stacks [ticks]).
 
@@ -209,6 +229,12 @@ def run_compiled(
     ``DeltaParams`` for a ``DeltaState``; its ``loss`` is overridden
     per tick by the compiled schedule.  ``keys`` is the segment-exact
     uint32[ticks, 2] schedule from ``compile.key_schedule``.
+
+    ``traffic`` (a ``traffic.CompiledTraffic``) co-runs a key workload
+    inside the same scan: every tick's batch is served against the
+    views that tick produced, adding the serving counters
+    (``traffic.engine.counter_names``) to the telemetry stacks without
+    touching the protocol key schedule.
     """
     global _dispatches
     if keys.shape[0] != compiled.ticks:
@@ -218,6 +244,14 @@ def run_compiled(
     precheck(state, net, compiled)
     adj = _normalize_adj(net, compiled.n)
     _dispatches += 1
+    meta = {
+        "backend": "delta" if isinstance(state, DeltaState) else "dense",
+        "n": compiled.n,
+        "ticks": compiled.ticks,
+        "replicas": 1,
+    }
+    if traffic is not None:
+        meta["traffic_m"] = traffic.static.m
     # ledger-off (the default): dispatch() is a plain call-through; on,
     # the dispatch is recorded with its compile/execute split and AOT
     # memory footprint (obs/ledger.py)
@@ -235,14 +269,11 @@ def run_compiled(
         compiled.p_gid,
         compiled.loss,
         keys,
+        traffic.tensors if traffic is not None else None,
         params=params,
         has_revive=compiled.has_revive,
-        _meta={
-            "backend": "delta" if isinstance(state, DeltaState) else "dense",
-            "n": compiled.n,
-            "ticks": compiled.ticks,
-            "replicas": 1,
-        },
+        traffic=traffic.static if traffic is not None else None,
+        _meta=meta,
     )
     return state, NetState(up=up, responsive=resp, adj=adj), ys
 

@@ -1,0 +1,28 @@
+"""Device-resident traffic plane: compiled key workloads served against
+per-viewer hash rings derived from (simulated) membership state.
+
+The reference stack above membership — L2 hashring, L5 request_proxy,
+L6 RingPop — resolves one key at a time on the host.  This package is
+its data-parallel form: shape-static workload generators producing
+pre-hashed key tensors (``workloads``), vmapped masked ring lookups and
+the handle-or-forward chain simulation (``engine``), co-run with the
+scenario scan (scenarios/runner.py) so lookups happen *under churn* and
+an entire chaos experiment plus its traffic is one jitted dispatch.
+"""
+
+from ringpop_tpu.traffic.workloads import (  # noqa: F401
+    CompiledTraffic,
+    WorkloadSpec,
+    compile_traffic,
+)
+from ringpop_tpu.traffic.engine import (  # noqa: F401
+    TrafficStatic,
+    TrafficTensors,
+    counter_names,
+    in_ring_from_rows,
+    lookup_masked_idx,
+    lookup_n_masked_idx,
+    sample_tick,
+    serve_once,
+    serve_tick,
+)

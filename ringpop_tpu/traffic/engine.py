@@ -1,0 +1,322 @@
+"""Serving kernels: masked ring lookups + the handle-or-forward chain.
+
+Per-viewer rings never materialize.  The GLOBAL ring — every address's
+replica points, sorted by (hash, name-rank) exactly like the host
+``HashRing``'s (hash, server) entry order — is one pair of [R] tables,
+and a viewer's ring is a boolean mask over servers (its view's
+alive|suspect members, membership-update-listener.js:34-45).  Because a
+filtered ring is a subsequence of the global sorted table, ``lookup``
+on the viewer's ring is: ``searchsorted`` into the global table, then
+walk clockwise to the first replica whose owner is in the viewer's
+mask.  The walk scans a static ``window`` of successive replicas
+(geometrically certain to suffice; ``found=False`` reports the
+residue), so a batch of M keys is one [M, W] gather — no sorts, no
+per-viewer state.
+
+``serve_tick`` simulates the reference's forwarding fabric on top:
+each key arrives at a viewer, resolves through the viewer's masked
+ring (lookup), and — when the owner is remote — follows the
+handle-or-forward chain (index.js handleOrProxy → request_proxy): the
+holder re-resolves through its OWN view, a disagreement forwards again
+(``requestProxy.retry.attempted``) up to the retry cap.  Against the
+ground-truth ring (the actually-gossiping nodes) this yields per-tick
+misroute counts, the forward-hop distribution, and a ring-divergence
+gauge — the serving-plane observables during kills/partitions/heals.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.models.swim_sim import ALIVE, SUSPECT
+from ringpop_tpu.ops.ring_ops import DeviceRing, lookup_n_idx
+
+
+class TrafficStatic(NamedTuple):
+    """The jit-static facts of a compiled workload (hashable)."""
+
+    m: int  # keys per traffic tick
+    max_retries: int  # forward-chain retry cap (request_proxy budget)
+    window: int  # masked-walk width over the global ring
+    every: int  # serve on ticks where tick % every == 0
+    lookup_n: int  # >0: also resolve n-wide preference lists
+
+
+class TrafficTensors(NamedTuple):
+    """The device-resident half: key pool, sampler, ring tables, PRNG."""
+
+    pool: jax.Array  # uint32[K] pre-hashed key pool
+    logits: jax.Array  # float32[K] sampler log-weights
+    viewers: jax.Array  # int32[V] arrival nodes
+    ring_hashes: jax.Array  # uint32[R] global ring, sorted
+    ring_owners: jax.Array  # int32[R] owner per replica
+    key: jax.Array  # uint32[2] workload PRNG key
+
+
+def sample_tick(
+    tensors: TrafficTensors, t: jax.Array, m: int
+) -> tuple[jax.Array, jax.Array]:
+    """(pool index int32[M], viewer int32[M]) for traffic tick ``t`` —
+    pure function of (workload key, t): replaying a tick resamples the
+    identical batch, on device or host (the oracle's sampling path)."""
+    kk, kv = jax.random.split(jax.random.fold_in(tensors.key, t))
+    idx = jax.random.categorical(kk, tensors.logits, shape=(m,)).astype(
+        jnp.int32
+    )
+    viewer = tensors.viewers[
+        jax.random.randint(kv, (m,), 0, tensors.viewers.shape[0])
+    ]
+    return idx, viewer
+
+
+def in_ring_from_rows(rows_key: jax.Array) -> jax.Array:
+    """bool in-ring mask from packed view-key rows: alive and suspect
+    members are ring members (the host ``ring_for`` filter)."""
+    s = rows_key & 7
+    return (s == ALIVE) | (s == SUSPECT)
+
+
+def lookup_masked_idx(
+    ring_hashes: jax.Array,
+    ring_owners: jax.Array,
+    key_hashes: jax.Array,
+    in_ring: jax.Array,
+    *,
+    window: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Owner per key on a per-key-masked ring.
+
+    ``in_ring`` is bool[M, S]: key m resolves as if the ring contained
+    only servers with ``in_ring[m, s]`` — bit-identical to a host
+    ``HashRing`` built from exactly that server subset (the filtered
+    entries are a subsequence of the global (hash, name-rank) order, so
+    the first in-mask replica at or after ``searchsorted`` IS the
+    filtered ring's lookup, wraparound included).  Returns
+    ``(owner int32[M] — -1 where not found, found bool[M])``;
+    ``found[m]`` is False when no in-mask replica fell inside the
+    ``window``-wide walk (escalate: larger window, or the host ring).
+    """
+    r = ring_hashes.shape[0]
+    w = min(window, r)
+    m = key_hashes.shape[0]
+    start = jnp.searchsorted(ring_hashes, key_hashes, side="left")
+    offs = (start[:, None] + jnp.arange(w)[None, :]) % r
+    owners = ring_owners[offs]  # int32[M, W]
+    ok = jnp.take_along_axis(in_ring, owners, axis=1)  # bool[M, W]
+    j = jnp.argmax(ok, axis=1)
+    found = jnp.any(ok, axis=1)
+    owner = owners[jnp.arange(m), j]
+    return jnp.where(found, owner, -1).astype(jnp.int32), found
+
+
+def lookup_n_masked_idx(
+    ring_hashes: jax.Array,
+    ring_owners: jax.Array,
+    key_hashes: jax.Array,
+    in_ring: jax.Array,
+    n: int,
+    *,
+    window: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Preference list per key on a per-key-masked ring: the first
+    ``n`` distinct in-mask owners walking clockwise (ring.js:150-182
+    lookupN over the viewer's ring) — ``ring_ops.lookup_n_idx`` with
+    its ``in_ring`` mask, one copy of the dedup machinery.  Returns
+    ``(owners int32[M, n] -1-padded, complete bool[M])``."""
+    return lookup_n_idx(
+        DeviceRing(hashes=ring_hashes, owners=ring_owners),
+        key_hashes,
+        n,
+        window=window,
+        in_ring=in_ring,
+    )
+
+
+def counter_names(static: TrafficStatic) -> tuple[str, ...]:
+    """The per-tick traffic counter series, in emission order — the
+    trace schema for one compiled workload shape."""
+    names = [
+        "lookups",
+        "dropped",
+        "handled_local",
+        "proxy_sends",
+        "proxy_retries",
+        "proxy_failed",
+        "delivered",
+        "misroutes",
+        "delivered_misroutes",
+        "unresolved",
+        "ring_divergence",
+    ]
+    names += [f"hops{h}" for h in range(static.max_retries + 2)]
+    if static.lookup_n:
+        names += ["lookupns", "lookupn_incomplete"]
+    return tuple(names)
+
+
+def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None):
+    n = view_rows.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    rh, ro = tensors.ring_hashes, tensors.ring_owners
+    w = static.window
+
+    mask_all = in_ring_from_rows(view_rows)  # bool[N, N]
+    # the gossip predicate (truth ring + served arrivals) is pure
+    # liveness — a member damped out of everyone's ring still serves
+    # the requests that land on it
+    gossip = up & responsive & mask_all[ids, ids]  # ground-truth ring
+    if damped is not None:
+        # damped members are quarantined from the viewer's RING, same
+        # as the host ring_for (damping extension)
+        mask_all = mask_all & ~damped
+    kidx, viewer = sample_tick(tensors, t, static.m)
+    khash = tensors.pool[kidx]
+
+    # a request landing on a dead/suspended node is dropped, not served
+    served = gossip[viewer]
+    truth_mask = jnp.broadcast_to(gossip[None, :], (static.m, n))
+    truth_owner, truth_found = lookup_masked_idx(
+        rh, ro, khash, truth_mask, window=w
+    )
+    owner0, found0 = lookup_masked_idx(
+        rh, ro, khash, mask_all[viewer], window=w
+    )
+    resolved = served & found0
+    handled_local = resolved & (owner0 == viewer)
+    unresolved = served & ~found0
+
+    # handle-or-forward chain: a LIVE holder re-resolves through its OWN
+    # view, a disagreement forwards again (reroute); a send to a DEAD
+    # holder fails and the origin's retry re-resolves the same frozen
+    # view — same owner, so the holder stays put and the retry budget
+    # drains (request_proxy/send.py's schedule, collapsed to one tick).
+    # Trip count max_retries+1: the holder reached by the last allowed
+    # retry still gets its settle check.
+    active = resolved & ~handled_local
+    carry = (
+        jnp.where(active, owner0, viewer),  # current holder
+        handled_local,  # settled
+        active,
+        jnp.where(handled_local, viewer, -1),  # final handler
+        jnp.zeros(static.m, dtype=jnp.int32),  # retries consumed
+        active.astype(jnp.int32),  # forwards sent (first send counted)
+        unresolved,
+    )
+
+    def hop(_, c):
+        h, settled, act, final, retries, forwards, unres = c
+        hc = jnp.clip(h, 0, n - 1)
+        has_retry = retries < static.max_retries
+        alive_h = gossip[hc]
+        retry_dead = act & ~alive_h & has_retry  # failed send, re-sent
+        nxt, f = lookup_masked_idx(rh, ro, khash, mask_all[hc], window=w)
+        done = act & alive_h & f & (nxt == h)
+        settled = settled | done
+        final = jnp.where(done, h, final)
+        unres = unres | (act & alive_h & ~f)
+        go = act & alive_h & f & (nxt != h) & has_retry  # reroute
+        stepped = (go | retry_dead).astype(jnp.int32)
+        retries = retries + stepped
+        forwards = forwards + stepped
+        h = jnp.where(go, nxt, h)
+        return (h, settled, go | retry_dead, final, retries, forwards, unres)
+
+    h, settled, act, final, retries, forwards, unresolved = jax.lax.fori_loop(
+        0, static.max_retries + 1, hop, carry
+    )
+
+    def count(mask):
+        return jnp.sum(mask, dtype=jnp.int32)
+
+    out = {
+        "lookups": count(served),
+        "dropped": jnp.int32(static.m) - count(served),
+        "handled_local": count(handled_local),
+        "proxy_sends": count(resolved & ~handled_local),
+        "proxy_retries": jnp.sum(retries, dtype=jnp.int32),
+        "proxy_failed": count(served & ~settled & ~unresolved),
+        "delivered": count(settled),
+        "misroutes": count(resolved & truth_found & (owner0 != truth_owner)),
+        "delivered_misroutes": count(
+            settled & truth_found & (final != truth_owner)
+        ),
+        "unresolved": count(unresolved),
+        "ring_divergence": count(
+            gossip & jnp.any(mask_all != gossip[None, :], axis=1)
+        ),
+    }
+    for hp in range(static.max_retries + 2):
+        out[f"hops{hp}"] = count(settled & (forwards == hp))
+    if static.lookup_n:
+        # the preference walk builds an [M, W, W] dedup cube, so its
+        # window uses lookup_n_idx's n-scaled heuristic rather than the
+        # single-lookup residue window (256 would cube to GBs at large
+        # M); the incomplete residue is counted, not silently padded
+        wn = min(w, 32 + 8 * static.lookup_n)
+        _, complete = lookup_n_masked_idx(
+            rh, ro, khash, mask_all[viewer], static.lookup_n, window=wn
+        )
+        out["lookupns"] = count(served)
+        out["lookupn_incomplete"] = count(served & ~complete)
+    return out
+
+
+def serve_tick(
+    view_rows: jax.Array,
+    up: jax.Array,
+    responsive: jax.Array,
+    tensors: TrafficTensors,
+    t: jax.Array,
+    *,
+    static: TrafficStatic,
+    damped: jax.Array | None = None,
+) -> dict[str, jax.Array]:
+    """One traffic tick's counters (int32 scalars, ``counter_names``
+    schema) against the given membership views.  Traceable: composes
+    into the scenario scan (scenarios/runner.py) or jits standalone
+    (``serve_once``).
+
+    ``view_rows`` is the int32[N, N] packed view table, or a zero-arg
+    callable producing it — pass a callable when the rows are derived
+    (the delta backend's O(N^2) ``materialize_rows``): it is traced
+    INSIDE the on-cadence branch, so off-cadence ticks
+    (``t % every != 0``) report zeros without materializing anything.
+    ``damped`` (bool[N, N] or None) quarantines flap-damped members
+    from per-viewer rings, matching the host ``ring_for``."""
+    get_rows = view_rows if callable(view_rows) else (lambda: view_rows)
+    if static.every == 1:
+        return _serve_impl(
+            get_rows(), up, responsive, tensors, t, static, damped
+        )
+    zeros = {k: jnp.int32(0) for k in counter_names(static)}
+    return jax.lax.cond(
+        t % static.every == 0,
+        lambda _: _serve_impl(
+            get_rows(), up, responsive, tensors, t, static, damped
+        ),
+        lambda _: zeros,
+        None,
+    )
+
+
+@partial(jax.jit, static_argnames=("static",))
+def serve_once(
+    view_rows: jax.Array,
+    up: jax.Array,
+    responsive: jax.Array,
+    tensors: TrafficTensors,
+    t: jax.Array,
+    *,
+    static: TrafficStatic,
+    damped: jax.Array | None = None,
+) -> dict[str, jax.Array]:
+    """The standalone jitted entry: ONE dispatch serves one traffic
+    tick against a snapshot of membership state (benchmarks, ad-hoc
+    serving against a live ``SimCluster``)."""
+    return serve_tick(
+        view_rows, up, responsive, tensors, t, static=static, damped=damped
+    )

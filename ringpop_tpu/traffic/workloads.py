@@ -1,0 +1,203 @@
+"""Shape-static key workloads: seeded, replayable, pre-hashed.
+
+A workload is a distribution over a fixed pool of K distinct keys plus
+an arrival policy (which node each request lands on).  Everything the
+compiled engine consumes is a fixed-shape tensor: the pool is hashed
+ONCE on device (``farmhash32_batch_jax`` over the encoded key strings —
+bit-identical to the host ring's farmhash32, so host-ring oracles
+resolve the very same keys), and each traffic tick samples ``M`` pool
+indices and ``M`` arrival viewers from a PRNG key derived by
+``fold_in(workload_key, tick)`` — the same replayable-schedule
+discipline as the scenario PRNG (scenarios/compile.key_schedule), and
+deliberately a SEPARATE key stream: adding traffic to a scenario must
+not perturb the protocol trajectory (pinned in tests/test_traffic.py).
+
+Three kinds:
+
+* ``uniform`` — every pool key equally likely;
+* ``zipf`` — pool rank r drawn with p ∝ (r+1)^-s (hot-key skew; s is
+  ``zipf_s``);
+* ``tenant`` — keys belong round-robin to T tenants, tenant t weighted
+  ∝ (t+1)^-s, uniform within a tenant (per-tenant skew: a few tenants
+  dominate the traffic while each key stays individually cold).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.ops import ring_ops
+from ringpop_tpu.ops.farmhash_jax import farmhash32_batch_jax
+from ringpop_tpu.traffic.engine import (
+    TrafficStatic,
+    TrafficTensors,
+    sample_tick,  # noqa: F401  (re-export: the oracle's sampling path)
+)
+
+# forward chain cap: the request_proxy's default retry budget
+# (request_proxy/send.py RETRY_SCHEDULE has 3 slots, send.js:49)
+DEFAULT_MAX_RETRIES = 3
+
+# masked-walk width when the spec leaves it unset: the chance that W
+# consecutive global replicas ALL belong to out-of-ring servers decays
+# geometrically (dead_fraction^W); 256 puts even a 90%-dead cluster at
+# ~2e-12 per key, and the engine still reports the residue (unresolved)
+DEFAULT_WINDOW = 256
+
+
+class WorkloadSpec(NamedTuple):
+    """Declarative traffic workload (the serving twin of ScenarioSpec)."""
+
+    kind: str = "uniform"  # uniform | zipf | tenant
+    keys_per_tick: int = 256  # M requests per traffic tick
+    pool: int = 4096  # K distinct keys ("key-0" .. f"key-{K-1}")
+    seed: int = 0  # workload PRNG stream (independent of protocol)
+    zipf_s: float = 1.1  # skew exponent (zipf ranks / tenant weights)
+    tenants: int = 16  # tenant count (kind="tenant")
+    viewers: tuple[int, ...] | None = None  # arrival nodes; None = all
+    lookup_n: int = 0  # >0: also resolve n-wide preference lists
+    max_retries: int = DEFAULT_MAX_RETRIES  # forward-chain retry cap
+    window: int | None = None  # masked-walk width; None = heuristic
+    every: int = 1  # serve on ticks where tick % every == 0
+
+    # -- parsing ------------------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: Any) -> "WorkloadSpec":
+        """Accept a WorkloadSpec, a dict, a JSON file path, or the CLI
+        shorthand ``kind:M[:pool]`` (e.g. ``zipf:512``)."""
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, str):
+            if os.path.exists(spec) or spec.endswith(".json"):
+                with open(spec) as f:
+                    spec = json.load(f)
+            else:
+                parts = spec.split(":")
+                out = {"kind": parts[0]}
+                if len(parts) > 1:
+                    out["keys_per_tick"] = int(parts[1])
+                if len(parts) > 2:
+                    out["pool"] = int(parts[2])
+                spec = out
+        if isinstance(spec, dict):
+            if "viewers" in spec and spec["viewers"] is not None:
+                spec = {**spec, "viewers": tuple(spec["viewers"])}
+            return cls(**spec)
+        raise TypeError(f"cannot build a WorkloadSpec from {type(spec)}")
+
+    def to_dict(self) -> dict[str, Any]:
+        d = self._asdict()
+        if d["viewers"] is not None:
+            d["viewers"] = list(d["viewers"])
+        return d
+
+    def validate(self, n: int) -> "WorkloadSpec":
+        if self.kind not in ("uniform", "zipf", "tenant"):
+            raise ValueError(f"unknown workload kind {self.kind!r}")
+        if self.keys_per_tick < 1:
+            raise ValueError("keys_per_tick must be >= 1")
+        if self.pool < 1:
+            raise ValueError("pool must be >= 1")
+        if self.kind == "tenant" and not (1 <= self.tenants <= self.pool):
+            raise ValueError("tenants must be in [1, pool]")
+        if self.lookup_n < 0 or self.max_retries < 0:
+            raise ValueError("lookup_n and max_retries must be >= 0")
+        if self.every < 1:
+            raise ValueError("every must be >= 1")
+        if self.viewers is not None:
+            if not self.viewers:
+                raise ValueError("viewers must be non-empty when given")
+            if any(not (0 <= v < n) for v in self.viewers):
+                raise ValueError(f"viewers out of range for n={n}")
+        if self.window is not None and self.window < 1:
+            raise ValueError("window must be >= 1 when given")
+        return self
+
+    # -- the pool (shared with host-side oracles) ---------------------------
+
+    def pool_keys(self) -> list[str]:
+        """The K distinct key strings; ``pool_hashes[i]`` is exactly
+        ``farmhash32(pool_keys()[i])`` — host ring oracles resolve these."""
+        return [f"key-{i}" for i in range(self.pool)]
+
+    def logits(self) -> np.ndarray:
+        """float32[K] unnormalized log-probabilities per pool key."""
+        k = self.pool
+        if self.kind == "uniform":
+            return np.zeros(k, dtype=np.float32)
+        if self.kind == "zipf":
+            return (-self.zipf_s * np.log(np.arange(1, k + 1))).astype(
+                np.float32
+            )
+        # tenant: key i belongs to tenant i % T; tenant weight is zipf
+        # over tenants, split uniformly across that tenant's keys
+        t = np.arange(k) % self.tenants
+        per_tenant = np.bincount(t, minlength=self.tenants).astype(np.float64)
+        w = (np.arange(1, self.tenants + 1) ** -self.zipf_s) / per_tenant
+        return np.log(w[t]).astype(np.float32)
+
+
+class CompiledTraffic(NamedTuple):
+    """A workload lowered against one cluster's address book: the static
+    shape facts (jit-static), the device tensors (pool hashes, sampler
+    logits, viewer list, global ring tables, workload key), the spec
+    for provenance, and the cluster size it was lowered against
+    (viewer indices and ring owners are meaningless on any other)."""
+
+    static: TrafficStatic
+    tensors: TrafficTensors
+    spec: WorkloadSpec
+    n: int
+
+
+def compile_traffic(
+    spec: Any,
+    n: int,
+    addresses: Sequence[str],
+    *,
+    ring: ring_ops.DeviceRing | None = None,
+) -> CompiledTraffic:
+    """Lower a workload spec against a cluster of ``n`` nodes.
+
+    The GLOBAL ring — every address's replica points, sorted — is built
+    once (host batched C farmhash; pass a cached ``ring`` to skip the
+    rebuild); per-viewer rings never materialize, they are masks over
+    this table (engine.lookup_masked_idx).  The key pool is encoded and
+    hashed on device in one ``farmhash32_batch_jax`` call.
+    """
+    spec = WorkloadSpec.from_spec(spec).validate(n)
+    if len(addresses) != n:
+        raise ValueError("addresses must have length n")
+    if ring is None:
+        ring = ring_ops.build_ring(addresses)
+    bufs, lens = ring_ops.encode_strings(spec.pool_keys())
+    pool_hashes = farmhash32_batch_jax(jnp.asarray(bufs), jnp.asarray(lens))
+    viewers = (
+        np.arange(n, dtype=np.int32)
+        if spec.viewers is None
+        else np.asarray(spec.viewers, dtype=np.int32)
+    )
+    window = spec.window if spec.window is not None else DEFAULT_WINDOW
+    static = TrafficStatic(
+        m=spec.keys_per_tick,
+        max_retries=spec.max_retries,
+        window=min(window, ring.size),
+        every=spec.every,
+        lookup_n=spec.lookup_n,
+    )
+    tensors = TrafficTensors(
+        pool=pool_hashes,
+        logits=jnp.asarray(spec.logits()),
+        viewers=jnp.asarray(viewers),
+        ring_hashes=ring.hashes,
+        ring_owners=ring.owners,
+        key=jax.random.PRNGKey(spec.seed),
+    )
+    return CompiledTraffic(static=static, tensors=tensors, spec=spec, n=n)

@@ -80,6 +80,86 @@ def test_exact_replica_hash_owns_itself():
     assert owner == int(np.asarray(dev.owners)[7])
 
 
+def test_lookup_n_parity_across_churn():
+    """lookup/lookupN bit-parity must survive membership churn: add a
+    server, remove one, re-build the device ring each time (the traffic
+    plane's ring lifecycle), and re-check against the mutated host ring."""
+    host = host_ring()
+    servers = list(SERVERS)
+    rng = random.Random(17)
+    keys = [f"churn-{rng.randrange(10 ** 9)}" for _ in range(150)]
+    hashes = jnp.asarray(
+        np.array([farmhash32(k) for k in keys], dtype=np.uint32)
+    )
+    mutations = [
+        ("add", "10.0.1.99:4000"),
+        ("remove", SERVERS[3]),
+        ("remove", SERVERS[0]),
+        ("add", "10.0.2.7:5000"),
+    ]
+    for op, server in mutations:
+        if op == "add":
+            host.add_server(server)
+            servers.append(server)
+        else:
+            host.remove_server(server)
+            servers.remove(server)
+        dev = ring_ops.build_ring(servers)
+        owners = np.asarray(ring_ops.lookup_idx(dev, hashes))
+        prefs, complete = ring_ops.lookup_n_idx(dev, hashes, 3)
+        assert bool(np.asarray(complete).all())
+        prefs = np.asarray(prefs)
+        for key, owner, row in zip(keys, owners, prefs):
+            assert servers[owner] == host.lookup(key), (op, server, key)
+            got = [servers[i] for i in row if i >= 0]
+            assert got == host.lookup_n(key, 3), (op, server, key)
+
+
+def test_lookup_wraparound_at_ring_minimum():
+    """A key hashing past the LAST replica wraps to the ring minimum
+    (ring.js:142-145), and a preference walk started there continues
+    from the top of the table — for lookup, lookup_n, and the masked
+    traffic kernels."""
+    from ringpop_tpu.traffic import engine as tengine
+
+    dev = ring_ops.build_ring(SERVERS)
+    hashes_np = np.asarray(dev.hashes)
+    owners_np = np.asarray(dev.owners)
+    assert int(hashes_np[-1]) < 2 ** 32 - 1  # probe below is representable
+    probes = jnp.asarray(
+        np.array(
+            [int(hashes_np[-1]) + 1, int(hashes_np[-1]), int(hashes_np[0])],
+            dtype=np.uint32,
+        )
+    )
+    got = np.asarray(ring_ops.lookup_idx(dev, probes))
+    # past-the-end wraps to the minimum; exact hits own themselves
+    assert got[0] == owners_np[0]
+    assert got[1] == owners_np[-1]
+    assert got[2] == owners_np[0]
+
+    # lookupN from the wrap point: the first n distinct owners walking
+    # from the top of the table
+    n = 4
+    expect = []
+    for o in owners_np:
+        if o not in expect:
+            expect.append(int(o))
+        if len(expect) == n:
+            break
+    prefs, complete = ring_ops.lookup_n_idx(dev, probes[:1], n)
+    assert bool(np.asarray(complete).all())
+    assert list(np.asarray(prefs)[0]) == expect
+
+    # the masked kernel wraps identically (all-True mask == plain ring)
+    mask = jnp.ones((3, len(SERVERS)), dtype=bool)
+    mowner, mfound = tengine.lookup_masked_idx(
+        dev.hashes, dev.owners, probes, mask, window=dev.size
+    )
+    assert bool(np.asarray(mfound).all())
+    assert np.array_equal(np.asarray(mowner), got)
+
+
 def test_empty_device_ring_lookup_raises():
     """Host HashRing.lookup returns None on an empty ring; the fixed-shape
     device path raises instead of dividing by zero."""

@@ -1,0 +1,374 @@
+"""Traffic plane: workloads, masked ring kernels, handle-or-forward.
+
+The load-bearing oracle here is `test_scenario_traffic_misroute_oracle`:
+per-tick misroute counts from the compiled scenario+traffic scan must
+bit-match a host-side loop that steps the identical key schedule and
+resolves the identical key batch through ``ring_for(viewer).lookup()``
+(the reference's per-viewer host ring) against a ground-truth ring of
+the actually-live nodes — on both backends.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import SwimParams
+from ringpop_tpu.ops import ring_ops
+from ringpop_tpu.ops.farmhash import farmhash32
+from ringpop_tpu.traffic import engine as tengine
+from ringpop_tpu.traffic.workloads import WorkloadSpec, compile_traffic
+
+N = 10
+ADDRS = [f"10.0.0.{i}:{3000 + i}" for i in range(N)]
+
+
+# -- workloads ---------------------------------------------------------------
+
+
+def test_workload_spec_parsing_and_validation():
+    ws = WorkloadSpec.from_spec("zipf:512:2048")
+    assert (ws.kind, ws.keys_per_tick, ws.pool) == ("zipf", 512, 2048)
+    ws = WorkloadSpec.from_spec({"kind": "tenant", "tenants": 4, "viewers": [0, 2]})
+    assert ws.viewers == (0, 2)
+    with pytest.raises(ValueError):
+        WorkloadSpec.from_spec("bogus:8").validate(N)
+    with pytest.raises(ValueError):
+        WorkloadSpec.from_spec({"viewers": [99]}).validate(N)
+    with pytest.raises(ValueError):
+        WorkloadSpec(every=0).validate(N)
+
+
+def test_pool_hashes_match_host_farmhash():
+    ct = compile_traffic({"pool": 64, "keys_per_tick": 8}, N, ADDRS)
+    hashes = np.asarray(ct.tensors.pool)
+    for i, key in enumerate(ct.spec.pool_keys()):
+        assert int(hashes[i]) == farmhash32(key)
+
+
+def test_sampler_replayable_and_skewed():
+    ct = compile_traffic({"kind": "zipf", "pool": 256, "keys_per_tick": 512,
+                          "zipf_s": 1.4}, N, ADDRS)
+    t = jnp.int32(7)
+    idx1, view1 = tengine.sample_tick(ct.tensors, t, ct.static.m)
+    idx2, view2 = tengine.sample_tick(ct.tensors, t, ct.static.m)
+    assert np.array_equal(np.asarray(idx1), np.asarray(idx2))
+    assert np.array_equal(np.asarray(view1), np.asarray(view2))
+    # different ticks draw different batches
+    idx3, _ = tengine.sample_tick(ct.tensors, jnp.int32(8), ct.static.m)
+    assert not np.array_equal(np.asarray(idx1), np.asarray(idx3))
+    # zipf: rank-0 strictly hotter than the tail
+    counts = np.bincount(np.asarray(idx1), minlength=256)
+    assert counts[0] > counts[128:].max()
+    assert np.asarray(view1).min() >= 0 and np.asarray(view1).max() < N
+
+
+# -- masked lookup kernels ---------------------------------------------------
+
+
+def _full_window(ring):
+    return ring.hashes.shape[0]
+
+
+def test_lookup_masked_parity_random_subsets():
+    """Masked lookup over the global ring == a host HashRing built from
+    exactly the masked server subset (full-window walk: exact)."""
+    ring = ring_ops.build_ring(ADDRS)
+    rng = random.Random(11)
+    keys = [f"key-{rng.randrange(10 ** 9)}" for _ in range(200)]
+    kh = jnp.asarray(np.array([farmhash32(k) for k in keys], dtype=np.uint32))
+    for trial in range(4):
+        alive = np.array([rng.random() < 0.6 for _ in range(N)])
+        alive[trial % N] = True  # never empty
+        host = HashRing()
+        host.add_remove_servers(
+            [a for a, ok in zip(ADDRS, alive) if ok], []
+        )
+        mask = jnp.broadcast_to(jnp.asarray(alive)[None, :], (len(keys), N))
+        owners, found = tengine.lookup_masked_idx(
+            ring.hashes, ring.owners, kh, mask, window=_full_window(ring)
+        )
+        assert bool(np.asarray(found).all())
+        for k, o in zip(keys, np.asarray(owners)):
+            assert ADDRS[o] == host.lookup(k), (trial, k)
+
+
+def test_lookup_n_masked_parity():
+    ring = ring_ops.build_ring(ADDRS)
+    rng = random.Random(13)
+    keys = [f"pref-{rng.randrange(10 ** 9)}" for _ in range(100)]
+    kh = jnp.asarray(np.array([farmhash32(k) for k in keys], dtype=np.uint32))
+    alive = np.ones(N, dtype=bool)
+    alive[[2, 5, 6]] = False
+    host = HashRing()
+    host.add_remove_servers([a for a, ok in zip(ADDRS, alive) if ok], [])
+    mask = jnp.broadcast_to(jnp.asarray(alive)[None, :], (len(keys), N))
+    owners, complete = tengine.lookup_n_masked_idx(
+        ring.hashes, ring.owners, kh, mask, 4, window=_full_window(ring)
+    )
+    assert bool(np.asarray(complete).all())
+    for k, row in zip(keys, np.asarray(owners)):
+        got = [ADDRS[i] for i in row if i >= 0]
+        assert got == host.lookup_n(k, 4), k
+
+
+def test_lookup_masked_reports_window_exhaustion():
+    """A window too small to reach any in-mask replica must say so, not
+    fabricate an owner."""
+    ring = ring_ops.build_ring(ADDRS)
+    only = np.zeros(N, dtype=bool)
+    only[4] = True
+    kh = jnp.asarray(
+        np.array([farmhash32(f"k{i}") for i in range(64)], dtype=np.uint32)
+    )
+    mask = jnp.broadcast_to(jnp.asarray(only)[None, :], (64, N))
+    owners, found = tengine.lookup_masked_idx(
+        ring.hashes, ring.owners, kh, mask, window=2
+    )
+    f = np.asarray(found)
+    assert not f.all()  # with 1/10 of replicas in-mask, W=2 misses some
+    assert (np.asarray(owners)[~f] == -1).all()
+    assert (np.asarray(owners)[f] == 4).all()
+
+
+# -- handle-or-forward oracle ------------------------------------------------
+
+
+def _host_serve_counts(cluster, ct, t):
+    """The reference-semantics host model of one traffic tick: sample
+    the identical batch, resolve through ``ring_for(viewer).lookup``,
+    follow the forward chain on per-holder host rings, compare against
+    a ground-truth ring of the actually-live nodes."""
+    m = ct.static.m
+    idx, viewers = tengine.sample_tick(ct.tensors, jnp.int32(t), m)
+    idx, viewers = np.asarray(idx), np.asarray(viewers)
+    keys = ct.spec.pool_keys()
+    live = set(int(i) for i in cluster.live_indices())
+    truth = HashRing()
+    truth.add_remove_servers([cluster.book.addresses[i] for i in sorted(live)], [])
+    addr_index = cluster.book.index
+    rings: dict[int, HashRing] = {}
+
+    def ring_of(node):
+        if node not in rings:
+            rings[node] = cluster.ring_for(node)
+        return rings[node]
+
+    counts = {k: 0 for k in ("lookups", "dropped", "handled_local",
+                             "misroutes", "proxy_retries", "delivered",
+                             "proxy_failed")}
+    for kidx, v in zip(idx, viewers):
+        v = int(v)
+        if v not in live:
+            counts["dropped"] += 1
+            continue
+        key = keys[int(kidx)]
+        counts["lookups"] += 1
+        owner0 = addr_index[ring_of(v).lookup(key)]
+        if truth.lookup(key) != cluster.book.addresses[owner0]:
+            counts["misroutes"] += 1
+        if owner0 == v:
+            counts["handled_local"] += 1
+            counts["delivered"] += 1
+            continue
+        h, retries, settled = owner0, 0, False
+        while True:
+            if h not in live:
+                # failed send; the origin's retry re-resolves the same
+                # frozen view -> same holder
+                if retries < ct.static.max_retries:
+                    retries += 1
+                    continue
+                break
+            nxt = addr_index[ring_of(h).lookup(key)]
+            if nxt == h:
+                settled = True
+                break
+            if retries < ct.static.max_retries:
+                retries += 1
+                h = nxt
+                continue
+            break
+        counts["proxy_retries"] += retries
+        if settled:
+            counts["delivered"] += 1
+        else:
+            counts["proxy_failed"] += 1
+    return counts
+
+
+# The workload every scenario-coupled test shares: identical statics
+# and tensor shapes mean ONE compiled scenario+traffic program per
+# backend serves the whole module (the jit cache does the rest).
+ORACLE_TICKS = 12
+ORACLE_WL = {"kind": "uniform", "keys_per_tick": 24, "pool": 256,
+             "window": N * ring_ops.DEFAULT_REPLICA_POINTS}  # exact walk
+
+
+@pytest.mark.parametrize(
+    "backend",
+    ["dense", pytest.param("delta", marks=pytest.mark.slow)],
+)
+def test_scenario_traffic_misroute_oracle(backend):
+    """Acceptance oracle: per-tick serving counters from the compiled
+    scenario+traffic scan bit-match the host loop (same key schedule,
+    same sampled batch, ``ring_for`` host rings, truth = live ring).
+
+    Tier-1 runs the dense arm; the delta twin is identical machinery
+    on the O(N*C) state and rides the nightly slow lane (suite budget:
+    each backend's scenario+traffic program is its own XLA compile).
+    """
+    ticks, kill_at = ORACLE_TICKS, 3
+    spec = {"ticks": ticks, "events": [{"at": kill_at, "op": "kill", "node": 2}]}
+    a = SimCluster(N, SwimParams(), seed=5, backend=backend)
+    ct = a.compile_traffic(ORACLE_WL)
+    trace = a.run_scenario(spec, traffic=ct)
+
+    from ringpop_tpu.scenarios import compile as scompile
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+    b = SimCluster(N, SwimParams(), seed=5, backend=backend)
+    compiled = scompile.compile_spec(ScenarioSpec.from_dict(spec), N)
+    keys = scompile.key_schedule(b._split, compiled)
+    for t in range(ticks):
+        if t == kill_at:
+            b.kill(2)
+        if backend == "delta":
+            b.state, _ = sdelta.delta_step(
+                b.state, b.net, keys[t], params=b.dparams
+            )
+        else:
+            b.state, _ = sim.swim_step(
+                b.state, b.net, keys[t], params=b.params
+            )
+        want = _host_serve_counts(b, ct, t)
+        for name, value in want.items():
+            got = int(trace.metrics[name][t])
+            assert got == value, (t, name, got, value)
+    # churn actually exercised the misroute path
+    assert trace.metrics["misroutes"].sum() > 0
+
+
+def test_traffic_does_not_perturb_protocol_and_bridges_serving_keys():
+    """One scenario, run with and without traffic: (a) every protocol
+    series is bit-identical (the workload PRNG is its own stream), and
+    (b) the traffic-coupled trace streams the serving-plane keys
+    through the stats bridge while the traffic-free one does not."""
+    from ringpop_tpu.obs import bridge
+    from ringpop_tpu.obs.emitters import CaptureEmitter
+
+    # same shapes/statics as the oracle test -> the with-traffic program
+    # is a jit-cache hit, not a fresh XLA compile
+    spec = {"ticks": ORACLE_TICKS,
+            "events": [{"at": 2, "op": "kill", "node": 1}]}
+    cap_a, cap_b = CaptureEmitter(), CaptureEmitter()
+    a = SimCluster(N, SwimParams(), seed=9, stats_emitter=cap_a)
+    ta = a.run_scenario(spec, traffic=a.compile_traffic(ORACLE_WL))
+    b = SimCluster(N, SwimParams(), seed=9, stats_emitter=cap_b)
+    tb = b.run_scenario(spec)
+    for name, series in tb.metrics.items():
+        assert np.array_equal(ta.metrics[name], series), name
+    assert np.array_equal(ta.converged, tb.converged)
+    assert np.array_equal(ta.live, tb.live)
+    suffixes_a = cap_a.suffixes(bridge.DEFAULT_PREFIX)
+    suffixes_b = cap_b.suffixes(bridge.DEFAULT_PREFIX)
+    for key in bridge.TRAFFIC_KEYS:
+        if key == "lookupn":
+            continue  # lookup_n disabled in this workload
+        assert key in suffixes_a, key
+        assert key not in suffixes_b, key
+    assert "sim.misroutes" in suffixes_a
+    assert "sim.ring-divergence" in suffixes_a
+    assert set(bridge.REFERENCE_KEYS) <= suffixes_b
+
+
+# -- satellites --------------------------------------------------------------
+
+
+def test_lookup_batch_matches_host_loop():
+    c = SimCluster(N, SwimParams(), seed=4)
+    c.kill(3)
+    c.tick(4)  # let some views diverge
+    keys = [f"user:{i}" for i in range(50)]
+    for viewer in (0, 7):
+        got = c.lookup_batch(keys, viewer=viewer)
+        want = [c.lookup(k, viewer=viewer) for k in keys]
+        assert got == want
+    # host-fallback path: a bootstrap-shaped view whose ring holds only
+    # the viewer itself — with 1/N of the replicas in-mask the windowed
+    # walk misses for some keys, and the fallback must keep parity
+    s = SimCluster(N, SwimParams(), seed=0, init="self")
+    got = s.lookup_batch(keys, viewer=2)
+    assert got == [s.lookup(k, viewer=2) for k in keys]
+    assert set(got) == {s.book.addresses[2]}
+
+
+def test_ringpop_lookup_timing_stats():
+    from ringpop_tpu.ringpop import RingPop
+
+    rp = RingPop(app="t", host_port="127.0.0.1:3000")
+    rp.ring.add_remove_servers(ADDRS, [])
+    for i in range(20):
+        rp.lookup(f"k{i}")
+    rp.lookup_n("k0", 3)
+    stats = rp.get_stats()
+    assert stats["lookup"]["count"] == 20
+    assert stats["lookupN"]["count"] == 1
+    for agg in (stats["lookup"], stats["lookupN"]):
+        for field in ("median", "p95", "p99"):
+            assert field in agg
+    rp.destroy()
+
+
+def test_compiled_traffic_rejects_foreign_cluster():
+    """A workload lowered against one cluster must not run on another:
+    foreign viewer ids / ring tables would clamp silently inside jitted
+    gathers and report bogus counters."""
+    big = SimCluster(16, SwimParams(), seed=0)
+    ct = big.compile_traffic({"keys_per_tick": 8, "pool": 32})
+    small = SimCluster(N, SwimParams(), seed=0)
+    with pytest.raises(ValueError, match="lowered for n=16"):
+        small.compile_traffic(ct)
+
+
+def test_damping_quarantine_parity():
+    """Damped members are quarantined from served rings exactly like
+    the host ``ring_for`` (damping extension): the engine's counters
+    with the damped mask bit-match the host serve model, and the
+    quarantined owner's keys misroute vs the (liveness-only) truth."""
+    c = SimCluster(N, SwimParams(), seed=6, damping=True)
+    c.state = c.state._replace(damped=c.state.damped.at[:, 4].set(True))
+    ct = c.compile_traffic(ORACLE_WL)
+    out = tengine.serve_once(
+        c.state.view_key, c.net.up, c.net.responsive, ct.tensors,
+        jnp.int32(0), static=ct.static, damped=c.state.damped,
+    )
+    want = _host_serve_counts(c, ct, 0)
+    for name, value in want.items():
+        assert int(out[name]) == value, name
+    assert int(out["misroutes"]) > 0  # node 4's arcs route elsewhere
+
+
+def test_serve_once_single_dispatch_smoke():
+    """The standalone serving entry: one jitted dispatch against a
+    state snapshot, counters consistent with the schema."""
+    c = SimCluster(N, SwimParams(), seed=2)
+    ct = c.compile_traffic({"keys_per_tick": 32, "pool": 128, "lookup_n": 3})
+    out = tengine.serve_once(
+        c.state.view_key, c.net.up, c.net.responsive, ct.tensors,
+        jnp.int32(0), static=ct.static,
+    )
+    assert set(out.keys()) == set(tengine.counter_names(ct.static))
+    vals = {k: int(v) for k, v in out.items()}
+    assert vals["lookups"] + vals["dropped"] == ct.static.m
+    assert vals["lookups"] == vals["delivered"]  # converged: all served
+    assert vals["misroutes"] == 0
+    assert vals["lookupns"] == vals["lookups"]
+    assert vals["lookupn_incomplete"] == 0

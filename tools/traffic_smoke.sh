@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# CPU traffic smoke: a compiled scenario CO-RUN with a key workload
+# through the tick-cluster CLI must (a) execute as ONE compiled
+# dispatch whose ledger row carries the workload batch size, (b) emit
+# a non-empty misroute trace while the kill event's divergence window
+# is open, and (c) stream the serving-plane stat keys (lookup,
+# requestProxy.*) through --stats-out alongside the protocol namespace.
+# This is the CI traffic-smoke job's body; run it locally the same way:
+#   tools/traffic_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/ringpop-traffic.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+spec="$workdir/spec.json"
+stats="$workdir/stats.jsonl"
+ledger="$workdir/ledger.jsonl"
+
+cat > "$spec" <<'EOF'
+{
+  "ticks": 30,
+  "events": [
+    {"at": 5, "op": "kill", "node": 3}
+  ]
+}
+EOF
+
+JAX_PLATFORMS=cpu RINGPOP_LEDGER="$ledger" timeout -k 10 600 \
+  python -m ringpop_tpu tick-cluster --backend tpu-sim -n 16 \
+  --scenario "$spec" --traffic zipf:128 --stats-out "$stats" \
+  | tee "$workdir/out.log"
+
+grep -q "one dispatch" "$workdir/out.log"
+grep -q "traffic:" "$workdir/out.log"
+
+JAX_PLATFORMS=cpu python - "$stats" "$ledger" <<'EOF'
+import json
+import sys
+
+from ringpop_tpu.obs.bridge import DEFAULT_PREFIX, REFERENCE_KEYS, TRAFFIC_KEYS
+from ringpop_tpu.obs.ledger import DispatchLedger
+
+stats_path, ledger_path = sys.argv[1:3]
+
+# (a) ONE compiled dispatch, carrying the workload batch size
+rows = [r for r in DispatchLedger.load_rows(ledger_path)
+        if r["program"] == "run_scenario"]
+assert len(rows) == 1, rows
+row = rows[0]
+assert row["cold"] and row["compile_s"] > 0 and row["execute_s"] > 0
+assert row["n"] == 16 and row["ticks"] == 30 and row["traffic_m"] == 128
+
+# (b) the misroute trace is non-empty under the kill event
+lines = [json.loads(line) for line in open(stats_path)]
+misroutes = sum(
+    line["value"] for line in lines
+    if line["key"] == f"{DEFAULT_PREFIX}.sim.misroutes"
+)
+assert misroutes > 0, "no misroutes traced during the kill window"
+
+# (c) serving-plane keys alongside the protocol namespace
+keys = {line["key"] for line in lines}
+wanted = [*REFERENCE_KEYS, *(k for k in TRAFFIC_KEYS if k != "lookupn")]
+missing = [k for k in wanted if f"{DEFAULT_PREFIX}.{k}" not in keys]
+assert not missing, f"missing stat keys: {missing}"
+lookups = sum(
+    line["value"] for line in lines
+    if line["key"] == f"{DEFAULT_PREFIX}.lookup"
+    and line["value"] is not None
+)
+assert lookups > 0, "no lookup increments streamed"
+
+print(f"traffic smoke OK: one dispatch (compile {row['compile_s']:.2f}s, "
+      f"execute {row['execute_s']:.3f}s), {int(lookups)} lookups, "
+      f"{int(misroutes)} misroutes traced, {len(keys)} stat keys")
+EOF
