@@ -1,0 +1,254 @@
+"""Streamed chunked-scan soak: pipelined dispatch/drain vs the
+blocking segment loop, at equal total ticks.
+
+The streaming runner's reason to exist, measured end to end through
+the public API on BOTH backends.  All arms run the SAME scenario from
+the SAME seed — and because the streamed runner derives the identical
+key schedule the one-dispatch run uses, every arm's final checksums
+are bit-identical (asserted below; it is a correctness cross-check,
+not a statistical accident):
+
+* **pipelined** — ``run_scenario(segment_ticks=S)`` in the full soak
+  configuration (segment store + PR 5 stats emitter): segment k+1 is
+  dispatched before segment k's telemetry is pulled to host, so device
+  compute overlaps trace conversion + npz store writes + per-tick
+  stats bridging (``scenarios/stream.py``; the per-soak drain overlap
+  is in the bench's own ledger, summarized by ``obs-ledger``).
+* **blocking whole-trace loop** — the pre-streaming pattern for a
+  memory-bounded long run: chop the spec into S-tick sub-scenarios
+  and call ``run_scenario`` per chunk, saving each chunk's trace npz
+  (the "one terminal npz" persistence a soak needs either way).
+  Every chunk blocks on its dispatch, derives its own key schedule,
+  materializes + validates a whole chunk ``Trace``, replays it
+  through the emitter, saves it, and pulls a checksum row — and
+  chunks with different event counts are different compiled shapes
+  (several cold compiles, where the streamed runner has exactly one
+  per segment shape).
+* **unpipelined** — ablation: the streamed runner with
+  ``pipeline=False`` (drain fully before the next dispatch), isolating
+  what dispatch/drain overlap alone contributes.
+* **whole** — the original one-dispatch ``run_scenario`` (same
+  emitter; its trace replays in one terminal drain), for reference:
+  competitive wall-clock at small T but O(T) host trace memory and no
+  checkpoint/resume; the streamed arms are the ones that scale to
+  1M-tick soaks.
+
+The pipelined/unpipelined/whole arms are bit-identical trajectories
+(same key schedule — asserted); the chunk loop draws keys per chunk,
+so it is the same experiment (equal T, same faults) but not the same
+bits, like any pre-streaming long run was.  Exactly one cold compile
+serves every segment of a streamed arm; warm timings are best-of-4 —
+on a shared CPU host the drain/compute interleaving is noisy, and the
+minimum is the contention-free reading of each arm.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _spec(n: int, ticks: int) -> dict:
+    return {
+        "ticks": ticks,
+        "events": [
+            {"at": ticks // 8, "op": "kill", "node": n - 1},
+            {"at": ticks // 4, "op": "loss", "p": 0.05},
+            {"at": ticks // 2, "op": "loss", "p": 0.0},
+        ],
+    }
+
+
+def _chunk_specs(spec: dict, segment_ticks: int) -> list[dict]:
+    """The spec chopped into S-tick sub-scenarios (events shifted to
+    chunk-relative ticks) — what running a long scenario in bounded
+    memory looked like before the streaming runner.  Loss persistence
+    across chunks is free: ``run_scenario`` mirrors the final loss
+    into the cluster params, which seeds the next chunk's base."""
+    ticks = spec["ticks"]
+    out = []
+    for a in range(0, ticks, segment_ticks):
+        b = min(a + segment_ticks, ticks)
+        out.append(
+            {
+                "ticks": b - a,
+                "events": [
+                    {**e, "at": e["at"] - a}
+                    for e in spec["events"]
+                    if a <= e["at"] < b
+                ],
+            }
+        )
+    return out
+
+
+def run(
+    n: int = 128,
+    ticks: int = 240,
+    segment_ticks: int = 48,
+    backends: tuple[str, ...] = ("dense", "delta"),
+) -> list[dict]:
+    from ringpop_tpu.models import swim_sim as sim
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.obs.emitters import make_emitter
+    from ringpop_tpu.obs.ledger import default_ledger, summarize_runs
+
+    spec = _spec(n, ticks)
+    params = sim.SwimParams(suspicion_ticks=8)
+    segments = -(-ticks // segment_ticks)
+    rows = []
+    for backend in backends:
+        kw: dict = {"backend": backend}
+        if backend == "delta":
+            kw.update(capacity=min(256, n), wire_cap=16, claim_grid=64)
+
+        workdir = tempfile.mkdtemp(prefix=f"bench-stream-{backend}-")
+        ledger = default_ledger()
+        ledger_was = ledger.enabled
+        ledger.enable(os.path.join(workdir, "ledger.jsonl"))
+        ledger.clear()
+
+        def streamed(pipeline: bool, tag: str) -> tuple[float, dict]:
+            store = os.path.join(workdir, f"store-{tag}")
+            shutil.rmtree(store, ignore_errors=True)
+            emitter = make_emitter(os.path.join(workdir, f"stats-{tag}.jsonl"))
+            c = SimCluster(n, params, seed=11, stats_emitter=emitter, **kw)
+            t0 = time.perf_counter()
+            c.run_scenario(
+                spec, segment_ticks=segment_ticks, store=store,
+                assemble=False, pipeline=pipeline,
+            )
+            wall = time.perf_counter() - t0
+            emitter.close()
+            return wall, c.checksums()
+
+        def whole() -> tuple[float, dict]:
+            emitter = make_emitter(os.path.join(workdir, "stats-whole.jsonl"))
+            c = SimCluster(n, params, seed=11, stats_emitter=emitter, **kw)
+            t0 = time.perf_counter()
+            c.run_scenario(spec)
+            wall = time.perf_counter() - t0
+            emitter.close()
+            return wall, c.checksums()
+
+        chunks = _chunk_specs(spec, segment_ticks)
+
+        def chunk_loop() -> tuple[float, int]:
+            emitter = make_emitter(os.path.join(workdir, "stats-loop.jsonl"))
+            c = SimCluster(n, params, seed=11, stats_emitter=emitter, **kw)
+            t0 = time.perf_counter()
+            for i, chunk in enumerate(chunks):
+                trace = c.run_scenario(chunk)
+                trace.save(os.path.join(workdir, f"loop-chunk-{i:05d}.npz"))
+            wall = time.perf_counter() - t0
+            emitter.close()
+            return wall, int(trace.converged[-1])
+
+        # cold pass compiles the segment program (shared by both
+        # streamed arms — same signature), the whole-run program, and
+        # the chunk loop's one-shape-per-event-count programs
+        cold_pipe, sums_pipe = streamed(True, "pipe")
+        cold_block, sums_block = streamed(False, "block")
+        cold_whole, sums_whole = whole()
+        cold_loop, loop_conv = chunk_loop()
+        assert sums_pipe == sums_block == sums_whole, (
+            "streamed arms diverged from the one-dispatch run"
+        )
+        warm = {"pipelined": [], "unpipelined": [], "whole": [], "loop": []}
+        for _ in range(4):
+            warm["loop"].append(chunk_loop()[0])
+            warm["unpipelined"].append(streamed(False, "block")[0])
+            warm["pipelined"].append(streamed(True, "pipe")[0])
+            warm["whole"].append(whole()[0])
+        best = {k: min(v) for k, v in warm.items()}
+        runs = summarize_runs(ledger.rows)
+        cold_rows = [
+            r for r in ledger.rows
+            if r.get("run_id") and r.get("cold")
+        ]
+        # one cold compile per (backend, segment shape): the full-S
+        # segment plus the ragged tail when S does not divide T
+        shapes = {r["ticks"] for r in ledger.rows if r.get("run_id")}
+        assert len(cold_rows) == len(shapes), (cold_rows, shapes)
+        overlap = max((g["overlap_pct"] for g in runs), default=0.0)
+        if not ledger_was:
+            ledger.disable()
+            ledger.clear()
+        rows.append(
+            {
+                "metric": (
+                    f"stream_pipelined_{backend}_n{n}_t{ticks}"
+                    f"_s{segment_ticks}"
+                ),
+                "value": round(ticks / best["pipelined"], 1),
+                "unit": "ticks_per_s_warm",
+                "wall_s": round(best["pipelined"], 3),
+                "cold_s": round(cold_pipe, 2),
+                "segments": segments,
+                "cold_compiles": len(cold_rows),
+                "drain_overlap_pct_max": overlap,
+                "speedup_vs_blocking_loop": round(
+                    best["loop"] / max(best["pipelined"], 1e-9), 3
+                ),
+                "speedup_vs_unpipelined": round(
+                    best["unpipelined"] / max(best["pipelined"], 1e-9), 3
+                ),
+                "ledger": os.path.join(workdir, "ledger.jsonl"),
+            }
+        )
+        rows.append(
+            {
+                "metric": (
+                    f"stream_blocking_loop_{backend}_n{n}_t{ticks}"
+                    f"_s{segment_ticks}"
+                ),
+                "value": round(ticks / best["loop"], 1),
+                "unit": "ticks_per_s_warm",
+                "wall_s": round(best["loop"], 3),
+                "cold_s": round(cold_loop, 2),
+                "segments": segments,
+                "converged": loop_conv,
+            }
+        )
+        rows.append(
+            {
+                "metric": (
+                    f"stream_unpipelined_{backend}_n{n}_t{ticks}"
+                    f"_s{segment_ticks}"
+                ),
+                "value": round(ticks / best["unpipelined"], 1),
+                "unit": "ticks_per_s_warm",
+                "wall_s": round(best["unpipelined"], 3),
+                "cold_s": round(cold_block, 2),
+                "segments": segments,
+            }
+        )
+        rows.append(
+            {
+                "metric": f"stream_whole_{backend}_n{n}_t{ticks}",
+                "value": round(ticks / best["whole"], 1),
+                "unit": "ticks_per_s_warm",
+                "wall_s": round(best["whole"], 3),
+                "cold_s": round(cold_whole, 2),
+                "segments": 1,
+            }
+        )
+    return rows
+
+
+if __name__ == "__main__":
+    import json
+
+    kwargs: dict = {}
+    args = [a for a in sys.argv[1:] if a.isdigit()]
+    if args:
+        kwargs["n"] = int(args[0])
+    if len(args) > 1:
+        kwargs["ticks"] = int(args[1])
+    for row in run(**kwargs):
+        print(json.dumps(row), flush=True)

@@ -27,6 +27,13 @@ program needs, not the HBM the arrays occupy.
 Usage:  python -m benchmarks.mem_census [--backend dense|delta|both]
             [--n 1024[,4096,...]] [--replicas 8] [--ticks 8]
             [--capacity 64] [--programs run,scenario,sweep]
+            [--segment-ticks S]
+
+``--segment-ticks S`` adds the streamed runner's S-tick segment
+program (scenarios/stream.py) next to each whole-horizon
+``run_scenario`` row: its footprint is a function of (backend, n, S)
+only — flat in total ``--ticks`` — which is what makes million-tick
+soaks compile- and memory-feasible.
 
 ``tests/test_mem_census.py`` pins the dense-vs-delta peak ordering at
 a fixed shape as a slow regression test.
@@ -112,8 +119,18 @@ def _compiled_scenario(n: int, ticks: int, base_loss: float):
     return spec, compile_spec(spec, n, base_loss=base_loss)
 
 
-def census_scenario(backend: str, n: int, ticks: int, capacity: int) -> dict:
-    """run_scenario: the event-applying scan (runner._scenario_scan)."""
+def census_scenario(
+    backend: str, n: int, ticks: int, capacity: int,
+    segment_ticks: int | None = None,
+) -> dict:
+    """run_scenario: the event-applying scan (runner._scenario_scan).
+
+    With ``segment_ticks=S`` the census covers the STREAMED runner's
+    program instead (scenarios/stream.py): the S-shaped segment scan
+    with a traced tick0 offset — the one executable a whole soak
+    re-dispatches.  Its footprint depends only on (backend, n, S),
+    never on the total tick count: the CPU-side deliverable of the
+    streaming rework, pinned by tests/test_mem_census.py."""
     from ringpop_tpu.scenarios import runner
 
     if backend == "delta":
@@ -122,7 +139,28 @@ def census_scenario(backend: str, n: int, ticks: int, capacity: int) -> dict:
         state, net, params = _dense_fixture(n)
     swim = params.swim if backend == "delta" else params
     _, compiled = _compiled_scenario(n, ticks, swim.loss)
-    keys = jax.random.split(jax.random.PRNGKey(0), ticks)
+    if segment_ticks is None:
+        keys = jax.random.split(jax.random.PRNGKey(0), ticks)
+        row = _census(
+            runner._scenario_scan,
+            state,
+            net.up,
+            net.responsive,
+            jnp.zeros((n,), jnp.int32),
+            compiled.ev_tick,
+            compiled.ev_kind,
+            compiled.ev_node,
+            compiled.p_tick,
+            compiled.p_gid,
+            compiled.loss,
+            keys,
+            params=params,
+            has_revive=compiled.has_revive,
+        )
+        return {"program": "run_scenario", "backend": backend, "n": n,
+                "replicas": 1, "ticks": ticks, **row}
+    s = min(segment_ticks, ticks)
+    keys = jax.random.split(jax.random.PRNGKey(0), s)
     row = _census(
         runner._scenario_scan,
         state,
@@ -134,13 +172,15 @@ def census_scenario(backend: str, n: int, ticks: int, capacity: int) -> dict:
         compiled.ev_node,
         compiled.p_tick,
         compiled.p_gid,
-        compiled.loss,
+        compiled.loss[:s],
         keys,
+        None,  # tr_tensors
+        jnp.int32(0),  # tick0 (traced: any segment offset, same program)
         params=params,
         has_revive=compiled.has_revive,
     )
     return {"program": "run_scenario", "backend": backend, "n": n,
-            "replicas": 1, "ticks": ticks, **row}
+            "replicas": 1, "ticks": ticks, "segment_ticks": s, **row}
 
 
 def census_sweep(
@@ -189,8 +229,14 @@ def run(
     capacity: int = 64,
     replicas: int = 8,
     programs=("run", "scenario", "sweep"),
+    segment_ticks: int | None = None,
 ) -> list[dict]:
-    """Every requested census row (the test entry point)."""
+    """Every requested census row (the test entry point).
+
+    ``segment_ticks`` adds the streamed segment program's row next to
+    every whole-horizon ``run_scenario`` row — the pair that shows the
+    segment footprint flat in total T while the whole-trace output
+    grows with it."""
     rows = []
     for backend in backends:
         for n in ns:
@@ -198,6 +244,13 @@ def run(
                 rows.append(census_run(backend, n, ticks, capacity))
             if "scenario" in programs:
                 rows.append(census_scenario(backend, n, ticks, capacity))
+                if segment_ticks is not None:
+                    rows.append(
+                        census_scenario(
+                            backend, n, ticks, capacity,
+                            segment_ticks=segment_ticks,
+                        )
+                    )
             if "sweep" in programs:
                 rows.append(
                     census_sweep(backend, n, ticks, capacity, replicas)
@@ -220,6 +273,10 @@ def main() -> None:
                     help="sweep replica count (R)")
     ap.add_argument("--programs", default="run,scenario,sweep",
                     help="comma list of run,scenario,sweep")
+    ap.add_argument("--segment-ticks", type=int, default=None, metavar="S",
+                    help="also census the streamed S-tick segment program "
+                         "next to each run_scenario row (its footprint is "
+                         "flat in --ticks; scenarios/stream.py)")
     args = ap.parse_args()
 
     backends = ("dense", "delta") if args.backend == "both" else (args.backend,)
@@ -227,7 +284,7 @@ def main() -> None:
     programs = tuple(args.programs.split(","))
     for row in run(backends=backends, ns=ns, ticks=args.ticks,
                    capacity=args.capacity, replicas=args.replicas,
-                   programs=programs):
+                   programs=programs, segment_ticks=args.segment_ticks):
         print(json.dumps(row), flush=True)
 
 

@@ -29,6 +29,7 @@ SIM_BENCHES = [
     "bench_scenario",  # one-call compiled scenario vs the host loop
     "bench_sweep",  # one vmapped R-replica dispatch vs R sequential
     "bench_lookup",  # batched device ring lookups vs the host loop
+    "bench_stream",  # pipelined segmented soak vs the blocking loop
 ]
 
 
@@ -55,7 +56,7 @@ def main(argv=None) -> int:
         kwargs = {}
         if args.sim_n and name in (
             "bench_sim_convergence", "bench_partition_heal",
-            "bench_scenario", "bench_sweep",
+            "bench_scenario", "bench_sweep", "bench_stream",
         ):
             kwargs["n"] = args.sim_n
         try:

@@ -34,12 +34,31 @@ from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
 # v2: packed view_key/pb/suspect_left state layout
 # v3: + delta backend (DeltaState leaves, resource caps in meta)
 # v4: + telemetry (metrics_log in meta, scenario traces as trace{i}.*)
-FORMAT_VERSION = 4
-_READABLE_VERSIONS = (2, 3, 4)
+# v5: + streaming cursor ("stream" in meta: spec, segment cursor, PRNG
+#     schedule position, traffic cursor — scenarios/stream.py resumes
+#     a killed chunked-scan soak bit-exactly from it)
+FORMAT_VERSION = 5
+_READABLE_VERSIONS = (2, 3, 4, 5)
 
 
-def save(cluster: SimCluster, path: str) -> None:
-    """Write a self-contained checkpoint of the simulation."""
+def save(
+    cluster: SimCluster,
+    path: str,
+    *,
+    stream: dict[str, Any] | None = None,
+    state: Any | None = None,
+    net: Any | None = None,
+) -> None:
+    """Write a self-contained checkpoint of the simulation.
+
+    ``stream`` (a JSON-able cursor dict, scenarios/stream.py) marks
+    the checkpoint as a mid-soak segment boundary.  ``state``/``net``
+    override the cluster's own tensors: the streaming runner donates
+    ``cluster.state`` into the in-flight segment (the buffers are gone
+    from the host's point of view) and checkpoints from the host
+    snapshot it took at the boundary instead."""
+    state = cluster.state if state is None else state
+    net = cluster.net if net is None else net
     meta = {
         "version": FORMAT_VERSION,
         "params": cluster.params._asdict(),
@@ -48,7 +67,7 @@ def save(cluster: SimCluster, path: str) -> None:
         "backend": cluster.backend,
         "caps": {
             "capacity": (
-                cluster.state.capacity if cluster.backend == "delta" else 0
+                state.capacity if cluster.backend == "delta" else 0
             ),
             "wire_cap": cluster.dparams.wire_cap,
             "claim_grid": cluster.dparams.claim_grid,
@@ -58,6 +77,8 @@ def save(cluster: SimCluster, path: str) -> None:
         "metrics_log": cluster.metrics_log,
         "traces": [t.meta() for t in cluster.traces],
     }
+    if stream is not None:
+        meta["stream"] = stream
     arrays: dict[str, np.ndarray] = {
         "meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
         "key": np.asarray(cluster.key),
@@ -65,11 +86,11 @@ def save(cluster: SimCluster, path: str) -> None:
     }
     for i, trace in enumerate(cluster.traces):
         arrays.update(trace.to_arrays(prefix=f"trace{i}."))
-    for name, leaf in cluster.state._asdict().items():
+    for name, leaf in state._asdict().items():
         if leaf is None:  # optional extension tensors (damping)
             continue
         arrays[f"state.{name}"] = np.asarray(leaf)
-    for name, leaf in cluster.net._asdict().items():
+    for name, leaf in net._asdict().items():
         if leaf is None:  # adj=None: healthy fully-connected network
             continue
         arrays[f"net.{name}"] = np.asarray(leaf)
@@ -162,6 +183,9 @@ def load(path: str, device: Any | None = None) -> SimCluster:
             Trace.from_arrays(data, tmeta, prefix=f"trace{i}.")
             for i, tmeta in enumerate(meta.get("traces", []))
         ]
+        # streaming cursor (v5); pre-v5 checkpoints have none — the
+        # attribute defaults to None in SimCluster.__init__
+        cluster.stream_cursor = meta.get("stream")
     if device is not None:
         cluster.state = jax.device_put(cluster.state, device)
         cluster.net = jax.device_put(cluster.net, device)

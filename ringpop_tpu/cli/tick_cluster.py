@@ -374,6 +374,48 @@ class SimCluster(ClusterDriver):
         self.cluster.destroy_all()
 
 
+def _pin_platform() -> None:
+    """Honor JAX_PLATFORMS before any backend initializes.
+
+    The environment may pre-register a TPU plugin and pin
+    jax_platforms at the config level; honor JAX_PLATFORMS if the
+    operator set it (e.g. =cpu to drive the sim without a chip)."""
+    import jax
+
+    platform = os.environ.get("JAX_PLATFORMS")
+    current = getattr(jax.config, "jax_platforms", None)
+    if platform and platform != current:
+        # The config must be restricted BEFORE touching devices() —
+        # otherwise backend discovery initializes every registered
+        # plugin, including a possibly-unreachable TPU tunnel.
+        jax.config.update("jax_platforms", platform)
+        try:
+            # Bare get_backend() (first device_put) can still route to
+            # a pre-registered TPU plugin; pin the default device too.
+            jax.config.update(
+                "jax_default_device", jax.devices(platform.split(",")[0])[0]
+            )
+        except RuntimeError as e:
+            jax.config.update("jax_platforms", current)  # revert
+            print(
+                f"warning: JAX_PLATFORMS={platform!r} failed to"
+                f" initialize ({e}); continuing with {current!r}",
+                file=sys.stderr,
+            )
+
+
+def print_final_checksums(cluster, groups: dict[int, list[str]] | None = None) -> None:
+    """Deterministic end-of-run line: the distinct membership checksums
+    among live nodes, sorted — what the CI soak-resume smoke greps to
+    compare a killed+resumed run against its uninterrupted twin.
+    ``groups`` (a ``checksum_groups()`` result) skips recomputing the
+    per-node checksum pass when the caller already ran it."""
+    sums = sorted(groups) if groups is not None else sorted(
+        set(cluster.checksums().values())
+    )
+    print("final checksums: " + " ".join(str(s) for s in sums))
+
+
 class TpuSimCluster(ClusterDriver):
     """The TPU simulation backend behind the same command surface
     (models/cluster.py SimCluster): tens of thousands of virtual nodes
@@ -384,31 +426,7 @@ class TpuSimCluster(ClusterDriver):
                  damping: bool = False, sparse_cap: int = 0,
                  probe: str = "sweep", layout: str = "dense",
                  capacity: int = 256, stats_out: str | None = None):
-        import jax
-
-        # The environment may pre-register a TPU plugin and pin
-        # jax_platforms at the config level; honor JAX_PLATFORMS if the
-        # operator set it (e.g. =cpu to drive the sim without a chip).
-        platform = os.environ.get("JAX_PLATFORMS")
-        current = getattr(jax.config, "jax_platforms", None)
-        if platform and platform != current:
-            # The config must be restricted BEFORE touching devices() —
-            # otherwise backend discovery initializes every registered
-            # plugin, including a possibly-unreachable TPU tunnel.
-            jax.config.update("jax_platforms", platform)
-            try:
-                # Bare get_backend() (first device_put) can still route to
-                # a pre-registered TPU plugin; pin the default device too.
-                jax.config.update(
-                    "jax_default_device", jax.devices(platform.split(",")[0])[0]
-                )
-            except RuntimeError as e:
-                jax.config.update("jax_platforms", current)  # revert
-                print(
-                    f"warning: JAX_PLATFORMS={platform!r} failed to"
-                    f" initialize ({e}); continuing with {current!r}",
-                    file=sys.stderr,
-                )
+        _pin_platform()
 
         from ringpop_tpu.models import swim_sim as sim
         from ringpop_tpu.models.cluster import SimCluster
@@ -508,12 +526,20 @@ class TpuSimCluster(ClusterDriver):
         sweep_loss_scales: list[float] | None = None,
         sweep_kill_jitter: list[int] | None = None,
         traffic: str | None = None,
+        segment_ticks: int | None = None,
+        checkpoint: str | None = None,
+        checkpoint_every: int = 1,
+        segment_store: str | None = None,
     ) -> None:
         """Run a JSON scenario spec as ONE jitted call (scenarios/);
         with ``sweep=R`` run R replicas in one vmapped dispatch; with
         ``traffic`` co-run a key workload (spec shorthand like
         ``zipf:512``, or a JSON workload file) inside the same
-        compiled program and report the serving counters."""
+        compiled program and report the serving counters; with
+        ``segment_ticks=S`` stream the run as pipelined S-tick segment
+        dispatches (one compile), checkpointing every
+        ``checkpoint_every`` segments when ``checkpoint`` is given —
+        a killed soak continues with ``--resume``."""
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
         spec = ScenarioSpec.load(path)
@@ -524,23 +550,51 @@ class TpuSimCluster(ClusterDriver):
                     "(serve traffic on a single-replica scenario)"
                 )
             self._run_sweep(
-                spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter
+                spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter,
+                segment_ticks=segment_ticks, segment_store=segment_store,
             )
             return
         t0 = time.perf_counter()
-        trace = self.cluster.run_scenario(spec, traffic=traffic)
+        if segment_ticks:
+            trace = self.cluster.run_scenario(
+                spec,
+                traffic=traffic,
+                segment_ticks=segment_ticks,
+                checkpoint_path=checkpoint,
+                checkpoint_every=checkpoint_every,
+                store=segment_store,
+            )
+        else:
+            trace = self.cluster.run_scenario(spec, traffic=traffic)
         wall_ms = (time.perf_counter() - t0) * 1000
         state = (
             "CONVERGED" if trace.converged[-1]
             else f"NOT converged ({int(trace.live[-1])} live)"
         )
-        print(
-            f"scenario: {trace.ticks} ticks, {len(spec.events)} events, "
-            f"one dispatch in {wall_ms:.0f}ms — {state}, first converged "
-            f"tick {trace.first_converged_tick()}, "
-            f"live {int(trace.live[-1])}/{self.cluster.n}"
-        )
-        print(format_groups(self.cluster.checksum_groups(), wall_ms))
+        if segment_ticks:
+            from ringpop_tpu.scenarios.stream import segment_bounds
+
+            segments = len(segment_bounds(trace.ticks, segment_ticks))
+            print(
+                f"scenario: {trace.ticks} ticks streamed as {segments} "
+                f"segments of {segment_ticks} (pipelined, one compile) in "
+                f"{wall_ms:.0f}ms — {state}, first converged tick "
+                f"{trace.first_converged_tick()}, "
+                f"live {int(trace.live[-1])}/{self.cluster.n}"
+            )
+            if checkpoint:
+                print(f"checkpoint (resume with --resume) -> {checkpoint}")
+        else:
+            print(
+                f"scenario: {trace.ticks} ticks, {len(spec.events)} events, "
+                f"one dispatch in {wall_ms:.0f}ms — {state}, first converged "
+                f"tick {trace.first_converged_tick()}, "
+                f"live {int(trace.live[-1])}/{self.cluster.n}"
+            )
+        groups = self.cluster.checksum_groups()
+        print(format_groups(groups, wall_ms))
+        if segment_ticks:
+            print_final_checksums(self.cluster, groups=groups)
         if traffic and "lookups" in trace.metrics:
             m = trace.metrics
             lookups = int(m["lookups"].sum())
@@ -567,11 +621,13 @@ class TpuSimCluster(ClusterDriver):
             print(f"trace ({trace.ticks} ticks x "
                   f"{len(trace.metrics) + 3} series) -> {trace_out}")
 
-    def _run_sweep(self, spec, trace_out, replicas, loss_scales, kill_jitter):
+    def _run_sweep(self, spec, trace_out, replicas, loss_scales, kill_jitter,
+                   segment_ticks=None, segment_store=None):
         t0 = time.perf_counter()
         strace = self.cluster.run_sweep(
             spec, replicas,
             loss_scales=loss_scales, kill_jitter=kill_jitter,
+            segment_ticks=segment_ticks, store=segment_store,
         )
         wall_ms = (time.perf_counter() - t0) * 1000
         summary = strace.summary()
@@ -584,9 +640,13 @@ class TpuSimCluster(ClusterDriver):
             return (f"min={d['min']:.0f} p50={d['median']:.0f} "
                     f"p95={d['p95']:.0f} max={d['max']:.0f}")
 
+        how = (
+            f"streamed in segments of {segment_ticks}"
+            if segment_ticks else "one vmapped dispatch"
+        )
         print(
-            f"sweep: {replicas} replicas x {strace.ticks} ticks, one "
-            f"vmapped dispatch in {wall_ms:.0f}ms — "
+            f"sweep: {replicas} replicas x {strace.ticks} ticks, "
+            f"{how} in {wall_ms:.0f}ms — "
             f"converged {rep['converged_final']}/{replicas}"
         )
         print(f"  detect tick ({rep['detected']}/{replicas} detected): "
@@ -709,6 +769,32 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                              "serving counters (lookup, requestProxy.*, "
                              "misroutes, forward hops) join the trace "
                              "and the --stats-out stream")
+    parser.add_argument("--segment-ticks", type=int, default=None, metavar="S",
+                        help="with --scenario: stream the run as pipelined "
+                             "S-tick segment dispatches of ONE compiled "
+                             "executable (scenarios/stream.py) — per-segment "
+                             "telemetry drain overlaps the next segment's "
+                             "device compute, host trace memory is "
+                             "O(segment), and the run can checkpoint/resume "
+                             "at segment granularity")
+    parser.add_argument("--checkpoint", default=None, metavar="FILE",
+                        help="with --segment-ticks: write a v5 checkpoint "
+                             "(state + stream cursor) every "
+                             "--checkpoint-every segments; segment slabs "
+                             "persist next to it (FILE.segments/) so "
+                             "--resume reproduces the full trace")
+    parser.add_argument("--checkpoint-every", type=int, default=1, metavar="K",
+                        help="with --checkpoint: checkpoint cadence in "
+                             "completed segments (default 1: every segment)")
+    parser.add_argument("--segment-store", default=None, metavar="DIR",
+                        help="with --segment-ticks: write per-segment "
+                             "telemetry slabs (.npz + JSONL manifest) here "
+                             "instead of/as well as the in-memory trace")
+    parser.add_argument("--resume", default=None, metavar="FILE",
+                        help="continue a killed streamed soak from its "
+                             "checkpoint (bit-identical to the "
+                             "uninterrupted run) and print the final "
+                             "summary; no other cluster flags needed")
     parser.add_argument("--sweep", type=int, default=0, metavar="R",
                         help="with --scenario: run R replicas of the "
                              "scenario in ONE vmapped jitted dispatch "
@@ -761,6 +847,34 @@ def main(argv: list[str] | None = None) -> None:
         )
         return
 
+    if args.resume:
+        _pin_platform()
+        import time as _time
+
+        from ringpop_tpu.scenarios import stream as sstream
+
+        t0 = _time.perf_counter()
+        cluster, result = sstream.resume(args.resume)
+        wall_ms = (_time.perf_counter() - t0) * 1000
+        trace = (
+            result if not isinstance(result, sstream.SegmentStore)
+            else result.assemble()
+        )
+        state = (
+            "CONVERGED" if trace.converged[-1]
+            else f"NOT converged ({int(trace.live[-1])} live)"
+        )
+        print(
+            f"resumed soak: {trace.ticks} ticks complete in {wall_ms:.0f}ms "
+            f"— {state}, live {int(trace.live[-1])}/{cluster.n}"
+        )
+        print_final_checksums(cluster)
+        if args.trace_out:
+            trace.save(args.trace_out)
+            print(f"trace ({trace.ticks} ticks x "
+                  f"{len(trace.metrics) + 3} series) -> {args.trace_out}")
+        return
+
     backend = args.backend or ("host-sim" if args.sim else "proc")
     if args.scenario and backend != "tpu-sim":
         parser.error("--scenario needs --backend tpu-sim (the compiled "
@@ -774,6 +888,19 @@ def main(argv: list[str] | None = None) -> None:
     if args.traffic and args.sweep:
         parser.error("--traffic does not compose with --sweep yet "
                      "(serve traffic on a single-replica scenario)")
+    if args.segment_ticks is not None and not args.scenario:
+        parser.error("--segment-ticks needs --scenario (it segments a "
+                     "compiled scenario run)")
+    if args.segment_ticks is not None and args.segment_ticks < 1:
+        # the run_scenario plumbing treats a falsy segment_ticks as
+        # "unsegmented", which would silently drop --checkpoint
+        parser.error("--segment-ticks must be >= 1")
+    if (args.checkpoint or args.segment_store) and args.segment_ticks is None:
+        parser.error("--checkpoint/--segment-store need --segment-ticks "
+                     "(they are streaming-run options)")
+    if args.checkpoint and args.sweep:
+        parser.error("--checkpoint does not compose with --sweep "
+                     "(sweeps are measurement fan-outs; re-run them)")
     if (args.stats_out or args.profile_dir) and backend != "tpu-sim":
         parser.error("--stats-out/--profile-dir need --backend tpu-sim "
                      "(the obs bridge and profiler scopes instrument the "
@@ -814,6 +941,10 @@ def main(argv: list[str] | None = None) -> None:
                     sweep_loss_scales=sweep_scales,
                     sweep_kill_jitter=sweep_jitter,
                     traffic=args.traffic,
+                    segment_ticks=args.segment_ticks,
+                    checkpoint=args.checkpoint,
+                    checkpoint_every=args.checkpoint_every,
+                    segment_store=args.segment_store,
                 )
             elif args.script:
                 run_script(driver, args.script)

@@ -139,6 +139,9 @@ class SimCluster:
         )
         self._device_book = None  # lazy ckdev.DeviceBook (device checksums)
         self._traffic_ring = None  # lazy global DeviceRing (traffic plane)
+        # streaming-soak cursor (checkpoint v5): set by checkpoint.load
+        # when the checkpoint was written mid-stream (scenarios/stream.py)
+        self.stream_cursor: dict[str, Any] | None = None
         if device is not None:
             self.state = jax.device_put(self.state, device)
             self.net = jax.device_put(self.net, device)
@@ -201,7 +204,18 @@ class SimCluster:
             )
         return out
 
-    def run_scenario(self, spec, traffic: Any | None = None) -> Any:
+    def run_scenario(
+        self,
+        spec,
+        traffic: Any | None = None,
+        *,
+        segment_ticks: int | None = None,
+        store: str | None = None,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 1,
+        assemble: bool = True,
+        pipeline: bool = True,
+    ) -> Any:
         """Run a declarative fault timeline as ONE jitted call.
 
         ``spec`` is a ``scenarios.ScenarioSpec`` (or its dict form, or
@@ -222,12 +236,41 @@ class SimCluster:
         from that tick's views, adding lookup/forward/misroute counters
         to the trace.  The workload PRNG is its own stream — the
         protocol trajectory stays bit-identical to a traffic-free run.
+
+        ``segment_ticks=S`` streams the run instead (scenarios/
+        stream.py): ceil(ticks / S) pipelined dispatches of one
+        compiled S-tick segment, telemetry draining per segment into
+        ``store`` / the stats bridge, and a v5 checkpoint every
+        ``checkpoint_every`` segments when ``checkpoint_path`` is
+        given — bit-identical trajectory and trace to the unsegmented
+        call, but host trace memory is O(segment) (``assemble=False``
+        returns the ``SegmentStore`` instead of a whole-run ``Trace``)
+        and a killed soak resumes via ``scenarios.stream.resume``.
         """
         from ringpop_tpu.scenarios import compile as scompile
         from ringpop_tpu.scenarios import runner as srunner
         from ringpop_tpu.scenarios.spec import ScenarioSpec
         from ringpop_tpu.scenarios.trace import Trace
 
+        if segment_ticks is not None:
+            from ringpop_tpu.scenarios import stream as sstream
+
+            return sstream.run_streamed(
+                self,
+                spec,
+                segment_ticks=segment_ticks,
+                traffic=traffic,
+                store=store,
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every,
+                assemble=assemble,
+                pipeline=pipeline,
+            )
+        if store is not None or checkpoint_path is not None or not assemble:
+            raise ValueError(
+                "store/checkpoint_path/assemble are streaming options; "
+                "pass segment_ticks to stream the run"
+            )
         if isinstance(spec, str):
             spec = ScenarioSpec.load(spec)
         elif isinstance(spec, dict):
@@ -239,13 +282,16 @@ class SimCluster:
             spec, self.n, base_loss=self.params.loss
         )
         # static rejections BEFORE drawing keys: a failed call must not
-        # advance self.key (it would silently desynchronize reruns)
-        srunner.precheck(self.state, self.net, compiled)
+        # advance self.key (it would silently desynchronize reruns);
+        # precheck also hands back the normalized adjacency so the
+        # mask-form host sync runs once per run, not again per dispatch
+        adj = srunner.precheck(self.state, self.net, compiled)
         keys = scompile.key_schedule(self._split, compiled)
         params = self.dparams if self.backend == "delta" else self.params
         start_tick = int(self.state.tick)
         self.state, self.net, ys = srunner.run_compiled(
-            self.state, self.net, keys, compiled, params, traffic=traffic
+            self.state, self.net, keys, compiled, params, traffic=traffic,
+            adj=adj,
         )
         self.set_loss(float(compiled.loss[-1]))  # host mirror of the schedule
         stacks = {k: np.asarray(v) for k, v in ys.items()}
@@ -298,6 +344,10 @@ class SimCluster:
         loss_scales: Sequence[float] | None = None,
         kill_jitter: Sequence[int] | None = None,
         shard: bool = False,
+        segment_ticks: int | None = None,
+        store: str | None = None,
+        assemble: bool = True,
+        pipeline: bool = True,
     ) -> Any:
         """Run R replicas of a scenario as ONE vmapped jitted call.
 
@@ -316,11 +366,41 @@ class SimCluster:
         cluster's own trajectory — only the cluster key moves (R
         draws), and nothing is appended to ``metrics_log``/``traces``
         (checkpoints round-trip ``Trace`` objects only).
+
+        ``segment_ticks=S`` streams the sweep (scenarios/stream.py):
+        [R, S] telemetry slabs drain per pipelined segment dispatch
+        into ``store`` — host sweep telemetry O(R x segment) — with
+        every replica still bit-identical to the whole-horizon call;
+        does not compose with ``shard`` yet.
         """
         from ringpop_tpu.scenarios import runner as srunner
         from ringpop_tpu.scenarios import sweep as ssweep
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
+        if segment_ticks is not None:
+            from ringpop_tpu.scenarios import stream as sstream
+
+            if shard:
+                raise NotImplementedError(
+                    "segment_ticks does not compose with shard yet "
+                    "(stream the sweep on one device, or shard whole)"
+                )
+            return sstream.run_sweep_streamed(
+                self,
+                spec,
+                replicas,
+                segment_ticks=segment_ticks,
+                loss_scales=loss_scales,
+                kill_jitter=kill_jitter,
+                store=store,
+                assemble=assemble,
+                pipeline=pipeline,
+            )
+        if store is not None or not assemble:
+            raise ValueError(
+                "store/assemble are streaming options; pass segment_ticks "
+                "to stream the sweep"
+            )
         if isinstance(spec, str):
             spec = ScenarioSpec.load(spec)
         elif isinstance(spec, dict):

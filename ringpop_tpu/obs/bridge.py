@@ -179,6 +179,8 @@ def replay_trace(
     prefix: str = DEFAULT_PREFIX,
     checksum: int | None = None,
     declare_namespace: bool = True,
+    prev_live: int | None = None,
+    checksum_pending: bool = False,
 ) -> int:
     """Replay a ``scenarios.Trace`` tick by tick into ``emitter`` under
     reference-parity keys (see the module key table).  ``checksum``
@@ -195,6 +197,21 @@ def replay_trace(
     touches the ``checksum`` gauge with 0 (documented sentinel for
     "not computed"), keeping the namespace guarantee total.
 
+    ``checksum_pending`` declares the namespace WITHOUT the checksum
+    sentinel: the caller promises to gauge the real checksum itself
+    after the run (the streamed runner, which replays slab by slab
+    with ``checksum=None`` and gauges once at completion — emitting
+    the sentinel here would put a spurious ``checksum:0`` at soak
+    start that the whole-trace replay never emits).
+
+    ``prev_live`` marks a CONTINUATION replay — ``trace`` is a
+    per-segment slab of a streamed run (scenarios/stream.py), not the
+    start of one: the first tick's ``membership-update.alive`` emits
+    the positive delta against the previous segment's final live count
+    instead of the bootstrap baseline, so replaying every slab in
+    order (with ``declare_namespace`` only on the first) produces the
+    exact stat stream the whole-trace replay would.
+
     Returns the total number of stat calls."""
     sink = StatSink(emitter, prefix)
     calls0 = 0
@@ -209,7 +226,7 @@ def replay_trace(
         for key in declared:
             sink.increment(key, 0)
             calls0 += 1
-        if checksum is None:
+        if checksum is None and not checksum_pending:
             sink.gauge("checksum", 0)
             calls0 += 1
     live = np.asarray(trace.live, dtype=np.int64)
@@ -219,7 +236,13 @@ def replay_trace(
     for t in range(trace.ticks):
         tick_metrics = {k: v[t] for k, v in trace.metrics.items()}
         calls += emit_counters(tick_metrics, sink, live=int(live[t]))
-        alive = int(live[t]) if t == 0 else int(live[t]) - int(live[t - 1])
+        if t == 0:
+            alive = (
+                int(live[0]) if prev_live is None
+                else int(live[0]) - int(prev_live)
+            )
+        else:
+            alive = int(live[t]) - int(live[t - 1])
         if alive > 0:
             sink.increment("membership-update.alive", alive)
             calls += 1

@@ -197,6 +197,45 @@ class DispatchLedger:
             return jitted(*args, **static_kwargs)
         import jax
 
+        t0 = time.perf_counter()
+        out, row = self.launch(
+            program, jitted, *args, _meta=_meta, **static_kwargs
+        )
+        out = jax.block_until_ready(out)
+        total = time.perf_counter() - t0
+        # execute_s is enqueue + drain, net of the cold compile phases
+        row["execute_s"] = round(
+            max(total - row["trace_s"] - row["compile_s"], 0.0), 6
+        )
+        self.record(row)
+        return out
+
+    def launch(
+        self,
+        program: str,
+        jitted: Callable[..., Any],
+        *args: Any,
+        _meta: dict[str, Any] | None = None,
+        **static_kwargs: Any,
+    ) -> tuple[Any, dict[str, Any] | None]:
+        """``dispatch`` minus the blocking drain: AOT-compile through
+        the same executable cache (cold rows still record trace/compile
+        and the memory footprint — exactly one XLA compile per
+        signature), enqueue the execution WITHOUT ``block_until_ready``,
+        and return ``(out, row)`` with the row NOT yet recorded.
+
+        The caller owns the drain: it converts the outputs at its own
+        pace — typically after dispatching the NEXT program, so device
+        compute and host-side conversion overlap — then ``record``\\ s
+        the row with its ``dispatch_s`` / ``drain_s`` /
+        ``drain_overlap_s`` fields added (the streaming soak runner,
+        scenarios/stream.py).  Disabled: a plain call-through and a
+        ``None`` row.
+        """
+        if not self.enabled:
+            return jitted(*args, **static_kwargs), None
+        import jax
+
         key = (program, _signature(args, static_kwargs))
         cold = key not in self._compiled
         trace_s = compile_s = 0.0
@@ -209,23 +248,18 @@ class DispatchLedger:
             trace_s, compile_s = t1 - t0, t2 - t1
             self._compiled[key] = (compiled, memory_row(compiled))
         compiled, mem = self._compiled[key]
-        t0 = time.perf_counter()
         out = compiled(*args)
-        out = jax.block_until_ready(out)
-        execute_s = time.perf_counter() - t0
         row = {
             "program": program,
             "platform": jax.default_backend(),
             "cold": cold,
             "trace_s": round(trace_s, 6),
             "compile_s": round(compile_s, 6),
-            "execute_s": round(execute_s, 6),
             **mem,
         }
         if _meta:
             row.update(_meta)
-        self.record(row)
-        return out
+        return out, row
 
     # -- reading back -------------------------------------------------------
 
@@ -300,6 +334,62 @@ def summarize(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
     return out
 
 
+def summarize_runs(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Per-soak pipelining summary: segment rows sharing a ``run_id``
+    (one streamed scenario/sweep each, scenarios/stream.py) aggregate
+    into segment/cold counts, total compile/dispatch/drain seconds, and
+    the pipelining efficiency — the share of drain work that ran while
+    the next segment was already in flight (``drain_overlap_s`` /
+    ``drain_s``; 100% means trace conversion was fully hidden behind
+    device compute)."""
+    runs: dict[str, dict[str, Any]] = {}
+    for row in rows:
+        rid = row.get("run_id")
+        if rid is None:
+            continue
+        g = runs.setdefault(
+            rid,
+            {
+                "run_id": rid,
+                "program": row.get("program"),
+                "backend": row.get("backend"),
+                "platform": row.get("platform"),
+                "n": row.get("n"),
+                "segment_ticks": row.get("segment_ticks"),
+                "segments": 0,
+                "cold": 0,
+                "ticks": 0,
+                "compile_s_total": 0.0,
+                "dispatch_s_total": 0.0,
+                "drain_s_total": 0.0,
+                "drain_overlap_s_total": 0.0,
+            },
+        )
+        g["segments"] += 1
+        g["cold"] += int(bool(row.get("cold")))
+        g["ticks"] += int(row.get("ticks") or 0)
+        for src, dst in (
+            ("compile_s", "compile_s_total"),
+            ("dispatch_s", "dispatch_s_total"),
+            ("drain_s", "drain_s_total"),
+            ("drain_overlap_s", "drain_overlap_s_total"),
+        ):
+            g[dst] += float(row.get(src) or 0.0)
+    out = []
+    for g in runs.values():
+        g["overlap_pct"] = (
+            round(100.0 * g["drain_overlap_s_total"] / g["drain_s_total"], 1)
+            if g["drain_s_total"]
+            else 0.0
+        )
+        for f in ("compile_s_total", "dispatch_s_total", "drain_s_total",
+                  "drain_overlap_s_total"):
+            g[f] = round(g[f], 6)
+        out.append(g)
+    out.sort(key=lambda g: str(g["run_id"]))
+    return out
+
+
 _default = DispatchLedger()
 
 
@@ -322,9 +412,12 @@ def main(argv: list[str] | None = None) -> None:
     args = ap.parse_args(argv)
     rows = DispatchLedger.load_rows(args.path)
     groups = summarize(rows)
+    runs = summarize_runs(rows)
     if args.json:
         for g in groups:
             print(json.dumps(g))
+        for g in runs:
+            print(json.dumps({"kind": "run", **g}))
         return
     print(f"{len(rows)} dispatches in {args.path}")
     for g in groups:
@@ -339,6 +432,17 @@ def main(argv: list[str] | None = None) -> None:
             f"execute p50={ex.get('p50', 0):.4f}s p99={ex.get('p99', 0):.4f}s, "
             f"peak {peak_str}"
         )
+    if runs:
+        print(f"{len(runs)} streamed soaks:")
+        for g in runs:
+            print(
+                f"  {g['run_id']} {g['program']} [{g['backend']}/"
+                f"{g['platform']}] n={g['n']} S={g['segment_ticks']}: "
+                f"{g['segments']} segments ({g['cold']} cold, compile "
+                f"{g['compile_s_total']:.3f}s) over {g['ticks']} ticks, "
+                f"drain {g['drain_s_total']:.3f}s "
+                f"({g['overlap_pct']:.0f}% overlapped with dispatch)"
+            )
 
 
 if __name__ == "__main__":

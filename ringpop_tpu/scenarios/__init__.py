@@ -23,10 +23,15 @@ Layers:
 * ``sweep``   — R replicas of a compiled scenario vmapped into ONE
   jitted dispatch (batch axes: PRNG seed, per-replica loss scale,
   kill-tick jitter), with the stacked ``SweepTrace`` telemetry.
+* ``stream``  — the chunked-scan soak runner: pipelined S-tick
+  segment dispatches of one compiled executable, per-segment
+  ``SegmentStore`` slabs + stats bridging (O(segment) host trace
+  memory), checkpoint-every-segment and bit-exact ``resume``.
 
-Entry points: ``SimCluster.run_scenario(spec)``,
+Entry points: ``SimCluster.run_scenario(spec[, segment_ticks=S])``,
 ``SimCluster.run_sweep(spec, replicas)``, and
-``tick-cluster --backend tpu-sim --scenario FILE [--sweep R]``.
+``tick-cluster --backend tpu-sim --scenario FILE [--sweep R]
+[--segment-ticks S --checkpoint C | --resume C]``.
 """
 
 from ringpop_tpu.scenarios.spec import Event, ScenarioSpec, script_to_spec
@@ -39,6 +44,13 @@ from ringpop_tpu.scenarios.sweep import (
     compile_sweep,
     replica_spec,
     run_sweep_compiled,
+)
+from ringpop_tpu.scenarios.stream import (
+    SegmentStore,
+    StreamInterrupted,
+    resume,
+    run_streamed,
+    run_sweep_streamed,
 )
 
 __all__ = [
@@ -55,4 +67,9 @@ __all__ = [
     "compile_sweep",
     "replica_spec",
     "run_sweep_compiled",
+    "SegmentStore",
+    "StreamInterrupted",
+    "resume",
+    "run_streamed",
+    "run_sweep_streamed",
 ]

@@ -72,18 +72,22 @@ def _normalize_adj(net: NetState, n: int) -> jax.Array:
     )
 
 
-def precheck(state: Any, net: NetState, compiled: CompiledScenario) -> None:
+def precheck(state: Any, net: NetState, compiled: CompiledScenario) -> jax.Array:
     """Every static rejection of ``run_compiled``, callable before any
     PRNG key is drawn — a failed run must not advance the cluster key
     (``SimCluster.run_scenario`` builds the key schedule only after
-    this passes)."""
+    this passes).  Returns the normalized group-id adjacency so the
+    caller can pass it back through ``run_compiled(adj=...)``: the
+    mask-form check costs a host sync (``np.asarray(adj).all()``), and
+    it must run once per run — not once per dispatch, which a streamed
+    soak turns into thousands (scenarios/stream.py)."""
     if compiled.has_revive and isinstance(state, DeltaState):
         raise NotImplementedError(
             "in-scan revive is dense-backend-only (the delta backend's "
             "revive/join are host-side row ops); use run_host_loop or "
             "backend='dense'"
         )
-    _normalize_adj(net, compiled.n)
+    return _normalize_adj(net, compiled.n)
 
 
 def _apply_revives(state, up, resp, m, ev_kind, ev_node):
@@ -134,11 +138,18 @@ def _scenario_scan_impl(
     loss,
     keys,
     tr_tensors=None,
+    tick0=None,
     *,
     params,
     has_revive: bool,
     traffic=None,
 ):
+    # ``tick0`` (traced int32 scalar, or None for 0) offsets the tick
+    # counter the event/partition/traffic comparisons see: a streamed
+    # soak (scenarios/stream.py) runs this same program once per
+    # S-tick segment with tick0 = segment start, so ONE compiled
+    # executable serves the whole run and the in-scan tick numbering
+    # matches the unsegmented scan bit-for-bit.
     n = up.shape[0]
     ticks = keys.shape[0]
     is_delta = isinstance(state, DeltaState)
@@ -201,7 +212,10 @@ def _scenario_scan_impl(
             )
         return (st, u, r, gid), y
 
-    xs = (jnp.arange(ticks, dtype=jnp.int32), keys, loss)
+    t_idx = jnp.arange(ticks, dtype=jnp.int32)
+    if tick0 is not None:
+        t_idx = t_idx + tick0
+    xs = (t_idx, keys, loss)
     (state, up, responsive, adj), ys = jax.lax.scan(
         body, (state, up, responsive, adj), xs
     )
@@ -222,6 +236,7 @@ def run_compiled(
     compiled: CompiledScenario,
     params: SwimParams | DeltaParams,
     traffic: Any | None = None,
+    adj: jax.Array | None = None,
 ) -> tuple[Any, NetState, dict[str, jax.Array]]:
     """One jitted call: (state, net, per-tick telemetry stacks [ticks]).
 
@@ -235,14 +250,18 @@ def run_compiled(
     views that tick produced, adding the serving counters
     (``traffic.engine.counter_names``) to the telemetry stacks without
     touching the protocol key schedule.
+
+    ``adj`` is the normalized group-id adjacency a caller that already
+    ran ``precheck`` passes back in, skipping the repeat host sync of
+    the mask-form check.
     """
     global _dispatches
     if keys.shape[0] != compiled.ticks:
         raise ValueError(
             f"key schedule has {keys.shape[0]} rows for {compiled.ticks} ticks"
         )
-    precheck(state, net, compiled)
-    adj = _normalize_adj(net, compiled.n)
+    if adj is None:
+        adj = precheck(state, net, compiled)
     _dispatches += 1
     meta = {
         "backend": "delta" if isinstance(state, DeltaState) else "dense",

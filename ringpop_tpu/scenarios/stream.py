@@ -1,0 +1,862 @@
+"""Streaming chunked-scan runner: pipelined dispatch/drain, O(segment)
+traces, restartable soaks.
+
+The one-dispatch scenario scan (``runner.run_compiled``) made a chaos
+experiment cheap per dispatch, but the runner AROUND it became the
+bottleneck for long horizons: it blocks on every dispatch, materializes
+the whole ``[ticks]`` telemetry stack on host (a 1M-tick soak cannot
+fit), and a killed multi-hour run restarts from zero.  This module
+restructures that runner around S-tick segments:
+
+* **One compile serves the whole soak.**  A T-tick run becomes
+  ``ceil(T / S)`` dispatches of ONE compiled executable: the segment
+  scan is the same ``runner._scenario_scan`` program with a traced
+  ``tick0`` offset, so every segment shares the [S]-shaped signature
+  (the ragged tail, when ``T % S != 0``, is its own shape) — the
+  dispatch ledger shows exactly one cold row per (backend, segment
+  shape).  The carry (state / net bits / adjacency) is **donated**
+  straight back into the next segment: no host round trip, no
+  per-segment re-allocation.
+
+* **Bit-identical to the unsegmented run.**  The PRNG key schedule is
+  derived ONCE for the full horizon by the same
+  ``compile.key_schedule`` the one-dispatch run uses, and segments just
+  slice it — so a streamed run of ANY segment size reproduces the
+  unsegmented ``run_scenario`` trajectory and trace bit-for-bit
+  (tests/test_stream.py pins it).  Segmentation is an execution
+  strategy, not a semantic change.
+
+* **Pipelined dispatch/drain.**  Segment k+1 is dispatched (jax's
+  async dispatch) BEFORE segment k's telemetry is pulled to host, so
+  device compute and host-side trace conversion / store writes /
+  stats bridging run concurrently.  Per-segment ledger rows (shared
+  ``run_id``) record ``drain_s`` and ``drain_overlap_s`` — the
+  ``obs-ledger`` summarizer reports pipelining efficiency per soak,
+  and ``benchmarks/bench_stream.py`` measures the win over the
+  blocking loop (``pipeline=False``).
+
+* **Stream, don't hoard.**  Each segment's telemetry lands as an
+  S-tick ``Trace`` slab: appended to a ``SegmentStore`` (one ``.npz``
+  per segment + a JSONL manifest — appendable and crash-tolerant) and
+  replayed incrementally through the Trace→stats bridge.  Host-resident
+  trace memory is O(segment); the store's loader lazily iterates or
+  reassembles the full series on demand.
+
+* **Checkpoint every segment.**  ``checkpoint.py`` v5 records the
+  stream cursor — spec, segment size, ticks done, the PRNG key the
+  schedule derives from, and the traffic workload — next to the host
+  snapshot of the carry, so a SIGKILL'd soak resumes from its last
+  completed segment and produces bit-identical final checksums and
+  traces to the uninterrupted run (``resume``; the CI
+  ``soak-resume-smoke`` job kills a live run to prove it).
+
+Entry points: ``SimCluster.run_scenario(spec, segment_ticks=S, ...)``,
+``SimCluster.run_sweep(spec, R, segment_ticks=S, ...)``,
+``tick-cluster --scenario F --segment-ticks S [--checkpoint C
+--checkpoint-every K | --resume C]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models.swim_sim import NetState
+from ringpop_tpu.obs import bridge as obs_bridge
+from ringpop_tpu.obs.ledger import default_ledger
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios import runner as srunner
+from ringpop_tpu.scenarios import sweep as ssweep
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+from ringpop_tpu.scenarios.trace import Trace
+
+STORE_VERSION = 1
+CURSOR_VERSION = 1
+
+
+class StreamInterrupted(RuntimeError):
+    """Raised by the ``interrupt_after`` test/smoke hook: the run stops
+    exactly as a SIGKILL at that segment boundary would — the
+    checkpoint and segment store are left on disk as a crash leaves
+    them, and the cluster object is NOT reusable (its device buffers
+    were donated into the abandoned in-flight segment).  Resume from
+    the checkpoint."""
+
+
+def segment_bounds(ticks: int, segment_ticks: int) -> list[tuple[int, int]]:
+    """[(a, b)) tick ranges of each segment; the tail may be ragged."""
+    if segment_ticks < 1:
+        raise ValueError(f"segment_ticks must be >= 1 (got {segment_ticks})")
+    return [
+        (a, min(a + segment_ticks, ticks))
+        for a in range(0, ticks, segment_ticks)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# SegmentStore: the appendable on-disk slab sequence
+# ---------------------------------------------------------------------------
+
+
+class SegmentStore:
+    """Appendable on-disk store of per-segment telemetry slabs.
+
+    Layout (one directory per streamed run)::
+
+        store.json       # run meta: kind, n, backend, spec, run_id, ...
+        manifest.jsonl   # one line per slab: {segment, tick0, ticks, file}
+        seg-00000.npz    # Trace/SweepTrace slab (atomic .tmp+rename)
+
+    Each slab write is atomic and the manifest is append-only, so a
+    crash mid-run leaves a readable prefix; ``truncate`` drops slabs
+    past a resume cursor (a crash between a slab append and its
+    checkpoint leaves one extra slab, which the resumed run rewrites).
+    ``iter_traces`` holds ONE slab in memory at a time — the
+    O(segment) reader a million-tick soak is analyzed through;
+    ``assemble`` is the explicit opt-in to a full [T] series.
+    """
+
+    MANIFEST = "manifest.jsonl"
+    METAFILE = "store.json"
+
+    def __init__(self, path: str, meta: dict[str, Any],
+                 rows: list[dict[str, Any]]):
+        self.path = path
+        self.meta = meta
+        self.rows = list(rows)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @classmethod
+    def create(cls, path: str, meta: dict[str, Any]) -> "SegmentStore":
+        os.makedirs(path, exist_ok=True)
+        meta_path = os.path.join(path, cls.METAFILE)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                old = json.load(f)
+            if old.get("run_id") != meta.get("run_id"):
+                raise ValueError(
+                    f"segment store {path} already holds run "
+                    f"{old.get('run_id')!r}; refusing to mix runs — pick a "
+                    f"fresh directory or resume from that run's checkpoint"
+                )
+        meta = {"version": STORE_VERSION, **meta}
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=2)
+        os.replace(tmp, meta_path)
+        # fresh manifest: a create() is tick 0 of a new run
+        with open(os.path.join(path, cls.MANIFEST), "w"):
+            pass
+        return cls(path, meta, [])
+
+    @classmethod
+    def open(cls, path: str) -> "SegmentStore":
+        with open(os.path.join(path, cls.METAFILE)) as f:
+            meta = json.load(f)
+        if meta.get("version") != STORE_VERSION:
+            raise ValueError(
+                f"unsupported segment store version {meta.get('version')}"
+            )
+        rows = []
+        manifest = os.path.join(path, cls.MANIFEST)
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+            for i, line in enumerate(lines):
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    if i == len(lines) - 1:
+                        # a power loss mid-append can tear the final
+                        # line; its slab was never checkpointed, so
+                        # resume would truncate it anyway — drop it
+                        # and keep the readable prefix
+                        break
+                    raise
+        rows.sort(key=lambda r: r["tick0"])
+        return cls(path, meta, rows)
+
+    # -- facts --------------------------------------------------------------
+
+    @property
+    def kind(self) -> str:
+        return self.meta.get("kind", "trace")
+
+    @property
+    def segments(self) -> int:
+        return len(self.rows)
+
+    @property
+    def ticks_stored(self) -> int:
+        return sum(int(r["ticks"]) for r in self.rows)
+
+    # -- writing ------------------------------------------------------------
+
+    def append(self, slab: Any, *, segment: int, tick0: int) -> dict[str, Any]:
+        """Write one slab (atomic) and its manifest line (append)."""
+        fname = f"seg-{segment:05d}.npz"
+        slab.save(os.path.join(self.path, fname))
+        row = {
+            "segment": int(segment),
+            "tick0": int(tick0),
+            "ticks": int(slab.ticks),
+            "file": fname,
+        }
+        with open(os.path.join(self.path, self.MANIFEST), "a") as f:
+            f.write(json.dumps(row) + "\n")
+        self.rows.append(row)
+        return row
+
+    def truncate(self, ticks_done: int) -> None:
+        """Drop slabs extending past ``ticks_done`` (the checkpoint
+        cursor a resume continues from): a crash between a slab append
+        and its checkpoint write leaves one uncommitted slab, which the
+        resumed run recomputes and rewrites."""
+        keep = [r for r in self.rows if r["tick0"] + r["ticks"] <= ticks_done]
+        if len(keep) == len(self.rows):
+            return
+        manifest = os.path.join(self.path, self.MANIFEST)
+        tmp = manifest + ".tmp"
+        with open(tmp, "w") as f:
+            for row in keep:
+                f.write(json.dumps(row) + "\n")
+        os.replace(tmp, manifest)
+        self.rows = keep
+
+    # -- reading ------------------------------------------------------------
+
+    def load_segment(self, i: int) -> Any:
+        row = self.rows[i]
+        path = os.path.join(self.path, row["file"])
+        if self.kind == "sweep":
+            return ssweep.SweepTrace.load(path)
+        return Trace.load(path)
+
+    def iter_traces(self) -> Iterator[Any]:
+        """Lazy slab iterator: one segment resident at a time — the
+        O(segment)-memory way to scan a whole soak's telemetry."""
+        for i in range(len(self.rows)):
+            yield self.load_segment(i)
+
+    def assemble(self) -> Any:
+        """The full concatenated series (explicitly O(total ticks))."""
+        if self.kind == "sweep":
+            return ssweep.SweepTrace.concat_ticks(
+                self.iter_traces(), spec=self.meta.get("spec")
+            ).validate()
+        return Trace.concat(
+            self.iter_traces(), spec=self.meta.get("spec")
+        ).validate()
+
+
+# ---------------------------------------------------------------------------
+# the streamed scenario run
+# ---------------------------------------------------------------------------
+
+
+def _schedule_from_start_key(
+    start_key: Any, compiled: scompile.CompiledScenario
+) -> jax.Array:
+    """Re-derive the full key schedule from the cluster key as it was
+    at run start — the identical chained-split sequence
+    ``SimCluster._split`` produced, so a resumed soak replays the very
+    keys the killed run would have used (threefry splits are a pure
+    function of the key)."""
+    kstate = {"key": jnp.asarray(np.asarray(start_key, dtype=np.uint32))}
+
+    def split() -> jax.Array:
+        kstate["key"], sub = jax.random.split(kstate["key"])
+        return sub
+
+    return scompile.key_schedule(split, compiled)
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def run_streamed(
+    cluster: Any,
+    spec: Any,
+    *,
+    segment_ticks: int,
+    traffic: Any | None = None,
+    store: str | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    assemble: bool = True,
+    pipeline: bool = True,
+    interrupt_after: int | None = None,
+) -> Any:
+    """Run a scenario as pipelined S-tick segment dispatches.
+
+    Bit-identical to ``cluster.run_scenario(spec)`` — same key
+    schedule, same trajectory, same trace — but the telemetry streams
+    out per segment and the run checkpoints / resumes at segment
+    granularity.  Returns the assembled ``Trace`` (and performs
+    ``run_scenario``'s bookkeeping: ``cluster.traces`` /
+    ``metrics_log`` / stats bridging), or the ``SegmentStore`` when
+    ``assemble=False`` (host trace memory stays O(segment); requires a
+    store).
+
+    ``checkpoint_path`` writes a v5 checkpoint every
+    ``checkpoint_every`` completed segments (and at completion); the
+    segment slabs then also persist (default store:
+    ``checkpoint_path + ".segments"``) so ``resume`` can finish the
+    trace.  ``pipeline=False`` is the blocking comparison arm
+    (``benchmarks/bench_stream.py``): drain fully before the next
+    dispatch.  ``interrupt_after=k`` simulates a SIGKILL right after
+    the k-th checkpoint is written (tests + the CI smoke).
+    """
+    if isinstance(spec, str):
+        spec = ScenarioSpec.load(spec)
+    elif isinstance(spec, dict):
+        spec = ScenarioSpec.from_dict(spec)
+    spec.validate(cluster.n)
+    if checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1 (got {checkpoint_every})")
+    if traffic is not None:
+        traffic = cluster.compile_traffic(traffic)
+    compiled = scompile.compile_spec(
+        spec, cluster.n, base_loss=cluster.params.loss
+    )
+    # static rejections + the ONE per-run host sync of the adjacency
+    # check (satellite of the streaming rework: never per segment)
+    adj = srunner.precheck(cluster.state, cluster.net, compiled)
+    if checkpoint_path and store is None:
+        # resume must be able to reassemble the full trace, so a
+        # checkpointed run always persists its slabs
+        store = checkpoint_path + ".segments"
+    if not assemble and store is None:
+        raise ValueError(
+            "assemble=False discards nothing only with a segment store "
+            "(pass store=... or checkpoint_path=...)"
+        )
+    spec_dict = spec.to_dict()
+    if traffic is not None:
+        spec_dict["traffic"] = traffic.spec.to_dict()
+    # everything that can raise must precede the key draw: a failed
+    # call may not advance cluster.key (runner.precheck's invariant),
+    # or the next run on this cluster would silently desynchronize
+    # from a cluster that never hit the error
+    segment_bounds(compiled.ticks, int(segment_ticks))
+    start_key = np.asarray(cluster.key).copy()
+    cursor = {
+        "version": CURSOR_VERSION,
+        "run_id": uuid.uuid4().hex[:12],
+        "spec": spec.to_dict(),
+        "traffic": traffic.spec.to_dict() if traffic is not None else None,
+        "segment_ticks": int(segment_ticks),
+        "ticks": compiled.ticks,
+        "ticks_done": 0,
+        "start_key": [int(x) for x in np.asarray(start_key).ravel()],
+        "start_tick": int(cluster.state.tick),
+        "base_loss": float(cluster.params.loss),
+        "store": store,
+        "checkpoint_every": int(checkpoint_every),
+        "prev_live": None,
+        "backend": cluster.backend,
+    }
+    store_obj = None
+    if store is not None:
+        store_obj = SegmentStore.create(
+            store,
+            {
+                "kind": "trace",
+                "run_id": cursor["run_id"],
+                "n": cluster.n,
+                "backend": cluster.backend,
+                "segment_ticks": int(segment_ticks),
+                "ticks": compiled.ticks,
+                "start_tick": cursor["start_tick"],
+                "spec": spec_dict,
+            },
+        )
+    keys = scompile.key_schedule(cluster._split, compiled)
+    return _drive(
+        cluster,
+        compiled,
+        keys,
+        traffic,
+        adj,
+        cursor,
+        store_obj,
+        spec_dict,
+        checkpoint_path=checkpoint_path,
+        assemble=assemble,
+        pipeline=pipeline,
+        interrupt_after=interrupt_after,
+    )
+
+
+def resume(
+    checkpoint_path: str,
+    *,
+    device: Any | None = None,
+    assemble: bool = True,
+    pipeline: bool = True,
+    interrupt_after: int | None = None,
+) -> tuple[Any, Any]:
+    """Continue a killed streamed soak from its last checkpoint.
+
+    Loads the v5 checkpoint, re-derives the key schedule from the
+    recorded start key (so the remaining segments consume the exact
+    keys the uninterrupted run would have), truncates the segment
+    store to the checkpoint cursor, and finishes the run.  Returns
+    ``(cluster, result)`` where ``result`` is the assembled full
+    ``Trace`` (bit-identical to the uninterrupted run's) or the
+    ``SegmentStore`` with ``assemble=False``.  A checkpoint whose
+    cursor is already complete just reopens the store."""
+    from ringpop_tpu import checkpoint as ckpt
+
+    cluster = ckpt.load(checkpoint_path, device=device)
+    cur = cluster.stream_cursor
+    if cur is None:
+        raise ValueError(
+            f"{checkpoint_path} has no stream cursor (not a streamed-run "
+            "checkpoint; plain checkpoints resume via checkpoint.load)"
+        )
+    if cur.get("store") is None:
+        raise ValueError("stream cursor has no segment store to resume into")
+    store_obj = SegmentStore.open(cur["store"])
+    spec = ScenarioSpec.from_dict(cur["spec"])
+    if cur["ticks_done"] >= cur["ticks"]:
+        # the soak already finished; nothing to recompute
+        return cluster, (store_obj.assemble() if assemble else store_obj)
+    store_obj.truncate(cur["ticks_done"])
+    traffic = (
+        cluster.compile_traffic(cur["traffic"])
+        if cur.get("traffic") is not None
+        else None
+    )
+    compiled = scompile.compile_spec(
+        spec, cluster.n, base_loss=cur["base_loss"]
+    )
+    adj = srunner.precheck(cluster.state, cluster.net, compiled)
+    # cluster.key already holds the post-schedule key (the schedule was
+    # fully drawn before the first segment); derive the schedule again
+    # from the recorded start key without touching it
+    keys = _schedule_from_start_key(cur["start_key"], compiled)
+    spec_dict = dict(store_obj.meta.get("spec") or spec.to_dict())
+    result = _drive(
+        cluster,
+        compiled,
+        keys,
+        traffic,
+        adj,
+        dict(cur),
+        store_obj,
+        spec_dict,
+        checkpoint_path=checkpoint_path,
+        assemble=assemble,
+        pipeline=pipeline,
+        interrupt_after=interrupt_after,
+    )
+    return cluster, result
+
+
+def _drive(
+    cluster: Any,
+    compiled: scompile.CompiledScenario,
+    keys: jax.Array,
+    traffic: Any | None,
+    adj: jax.Array,
+    cursor: dict[str, Any],
+    store_obj: SegmentStore | None,
+    spec_dict: dict[str, Any],
+    *,
+    checkpoint_path: str | None,
+    assemble: bool,
+    pipeline: bool,
+    interrupt_after: int | None,
+) -> Any:
+    """The segment loop shared by fresh runs and resumes."""
+    S = int(cursor["segment_ticks"])
+    T = compiled.ticks
+    bounds = segment_bounds(T, S)
+    if cursor["ticks_done"] % S not in (0,) and cursor["ticks_done"] != T:
+        raise ValueError(
+            f"cursor ticks_done={cursor['ticks_done']} is not a segment "
+            f"boundary of S={S}"
+        )
+    start_seg = cursor["ticks_done"] // S
+    led = default_ledger()
+    is_delta = cluster.backend == "delta"
+    params = cluster.dparams if is_delta else cluster.params
+    tr_tensors = traffic.tensors if traffic is not None else None
+    static_traffic = traffic.static if traffic is not None else None
+    sink = cluster.stats_sink
+    carry = (cluster.state, cluster.net.up, cluster.net.responsive, adj)
+    pending: tuple | None = None
+    slabs: list[Trace] = []  # only populated when there is no store
+    state = {"prev_live": cursor.get("prev_live"), "last_slab": None,
+             "ckpts": 0}
+
+    def _launch(seg: int, a: int, b: int, carry: tuple):
+        meta = {
+            "backend": cluster.backend,
+            "n": cluster.n,
+            "ticks": b - a,
+            "replicas": 1,
+            "run_id": cursor["run_id"],
+            "segment": seg,
+            "tick0": a,
+            "segment_ticks": S,
+            "total_ticks": T,
+        }
+        if traffic is not None:
+            meta["traffic_m"] = traffic.static.m
+        args = (
+            *carry,
+            compiled.ev_tick,
+            compiled.ev_kind,
+            compiled.ev_node,
+            compiled.p_tick,
+            compiled.p_gid,
+            compiled.loss[a:b],
+            keys[a:b],
+            tr_tensors,
+            jnp.int32(a),
+        )
+        statics = dict(
+            params=params,
+            has_revive=compiled.has_revive,
+            traffic=static_traffic,
+        )
+        srunner._dispatches += 1
+        t0 = time.perf_counter()
+        if led.enabled:
+            out, row = led.launch(
+                "run_scenario", srunner._scenario_scan, *args,
+                _meta=meta, **statics,
+            )
+        else:
+            out, row = srunner._scenario_scan(*args, **statics), None
+        if row is not None:
+            row["dispatch_s"] = round(time.perf_counter() - t0, 6)
+        return out, row
+
+    def _drain(p: tuple, *, overlapped: bool) -> None:
+        seg, a, b, ys, row = p
+        t0 = time.perf_counter()
+        stacks = {k: np.asarray(v) for k, v in ys.items()}
+        slab = Trace(
+            metrics={
+                k: v
+                for k, v in stacks.items()
+                if k not in ("converged", "live", "loss")
+            },
+            converged=stacks["converged"],
+            live=stacks["live"],
+            loss=stacks["loss"],
+            n=cluster.n,
+            backend=cluster.backend,
+            start_tick=cursor["start_tick"] + a,
+            spec=None,
+        )
+        if store_obj is not None:
+            store_obj.append(slab, segment=seg, tick0=a)
+        else:
+            slabs.append(slab)
+        if sink is not None:
+            obs_bridge.replay_trace(
+                slab,
+                sink.emitter,
+                prefix=sink.prefix,
+                checksum=None,
+                declare_namespace=(seg == start_seg),
+                prev_live=state["prev_live"],
+                checksum_pending=True,
+            )
+        state["prev_live"] = int(stacks["live"][-1])
+        state["last_slab"] = slab
+        drain_s = time.perf_counter() - t0
+        if row is not None:
+            row["drain_s"] = round(drain_s, 6)
+            row["drain_overlap_s"] = round(drain_s if overlapped else 0.0, 6)
+            led.record(row)
+
+    def _write_ckpt(snap_state: Any, snap_net: NetState,
+                    ticks_done: int) -> None:
+        from ringpop_tpu import checkpoint as ckpt
+
+        ckpt.save(
+            cluster,
+            checkpoint_path,
+            stream=dict(
+                cursor, ticks_done=int(ticks_done),
+                prev_live=state["prev_live"],
+            ),
+            state=snap_state,
+            net=snap_net,
+        )
+
+    for seg in range(start_seg, len(bounds)):
+        a, b = bounds[seg]
+        due_prev = (
+            checkpoint_path is not None
+            and seg > start_seg
+            and (seg % cursor["checkpoint_every"] == 0)
+        )
+        snap = None
+        if due_prev:
+            # snapshot BEFORE the carry is donated onward (blocks until
+            # the previous segment's compute lands — the one pipeline
+            # bubble durability costs; drain + checkpoint write below
+            # still overlap this segment's compute)
+            snap = (
+                _to_host(carry[0]),
+                NetState(
+                    up=np.asarray(carry[1]),
+                    responsive=np.asarray(carry[2]),
+                    adj=np.asarray(carry[3]),
+                ),
+            )
+        out, row = _launch(seg, a, b, carry)
+        carry, ys = out[:4], out[4]
+        if pending is not None:
+            _drain(pending, overlapped=True)
+            pending = None
+        if due_prev:
+            _write_ckpt(snap[0], snap[1], bounds[seg - 1][1])
+            state["ckpts"] += 1
+            if interrupt_after is not None and state["ckpts"] >= interrupt_after:
+                raise StreamInterrupted(
+                    f"simulated kill after checkpoint {state['ckpts']} "
+                    f"(ticks_done={bounds[seg - 1][1]})"
+                )
+        pending = (seg, a, b, ys, row)
+        if not pipeline:
+            jax.block_until_ready(carry)
+            _drain(pending, overlapped=False)
+            pending = None
+    if pending is not None:
+        _drain(pending, overlapped=False)
+
+    # the run is whole again: hand the final carry back to the cluster
+    f_state, f_up, f_resp, f_adj = carry
+    cluster.state = f_state
+    cluster.net = NetState(up=f_up, responsive=f_resp, adj=f_adj)
+    cluster.set_loss(float(compiled.loss[-1]))  # host mirror (run_scenario)
+    if checkpoint_path is not None:
+        # final checkpoint: cursor complete, final state — written
+        # BEFORE the assembled trace is attached so a soak's checkpoint
+        # stays O(state), not O(ticks); the trace lives in the store
+        _write_ckpt(_to_host(cluster.state), _to_host(cluster.net), T)
+
+    result: Any
+    if assemble:
+        trace = (
+            store_obj.assemble()
+            if store_obj is not None
+            else Trace.concat(slabs, spec=spec_dict)
+        ).validate()
+        cluster.traces.append(trace)
+        entry = {k: int(v[-1]) for k, v in trace.metrics.items()}
+        result = trace
+    else:
+        last = state["last_slab"]
+        entry = {k: int(v[-1]) for k, v in last.metrics.items()}
+        result = store_obj
+    entry["ticks"] = T
+    cluster.metrics_log.append(entry)
+    if sink is not None:
+        # the per-slab replays already streamed the series; close with
+        # the post-run membership checksum gauge like run_scenario does
+        live = cluster.live_indices()
+        if live.size:
+            first = int(live[0])
+            checksum = cluster.checksums(indices=[first])[
+                cluster.book.addresses[first]
+            ]
+            sink.gauge("checksum", int(checksum))
+        else:
+            # every node dead: keep the namespace total (the slab
+            # replays deferred the sentinel via checksum_pending)
+            sink.gauge("checksum", 0)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# the streamed sweep (R replicas x S-tick segments)
+# ---------------------------------------------------------------------------
+
+
+def run_sweep_streamed(
+    cluster: Any,
+    spec: Any,
+    replicas: int,
+    *,
+    segment_ticks: int,
+    loss_scales: Any | None = None,
+    kill_jitter: Any | None = None,
+    store: str | None = None,
+    assemble: bool = True,
+    pipeline: bool = True,
+) -> Any:
+    """R replicas of a scenario, streamed segment by segment.
+
+    The [R, S] telemetry slabs flow out per segment (SegmentStore kind
+    ``sweep``), so host-resident sweep telemetry is O(R x segment)
+    instead of O(R x ticks) — and every replica stays bit-identical to
+    the whole-horizon ``run_sweep`` (same replica keys, same vmapped
+    scan body, tick0-offset segments slicing the same schedules).
+    Like ``run_sweep``, the cluster does not advance (only its key
+    moves); sweeps do not checkpoint (re-run them — they are
+    measurement fan-outs, not trajectories)."""
+    if isinstance(spec, str):
+        spec = ScenarioSpec.load(spec)
+    elif isinstance(spec, dict):
+        spec = ScenarioSpec.from_dict(spec)
+    spec.validate(cluster.n)
+    if not assemble and store is None:
+        raise ValueError(
+            "assemble=False discards nothing only with a segment store"
+        )
+    cs = ssweep.compile_sweep(
+        spec,
+        cluster.n,
+        replicas=replicas,
+        base_loss=cluster.params.loss,
+        loss_scales=loss_scales,
+        kill_jitter=kill_jitter,
+    )
+    adj = srunner.precheck(cluster.state, cluster.net, cs.base)
+    # raising validation/IO precedes the replica-key draws: a failed
+    # call may not advance cluster.key (see run_streamed)
+    params = cluster.dparams if cluster.backend == "delta" else cluster.params
+    S = int(segment_ticks)
+    T = cs.base.ticks
+    bounds = segment_bounds(T, S)
+    run_id = uuid.uuid4().hex[:12]
+    start_tick = int(cluster.state.tick)
+    led = default_ledger()
+    r = cs.replicas
+    carry = (
+        ssweep._broadcast_replicas(cluster.state, r),
+        ssweep._broadcast_replicas(cluster.net.up, r),
+        ssweep._broadcast_replicas(cluster.net.responsive, r),
+        ssweep._broadcast_replicas(adj, r),
+    )
+    store_obj = None
+    if store is not None:
+        store_obj = SegmentStore.create(
+            store,
+            {
+                "kind": "sweep",
+                "run_id": run_id,
+                "n": cluster.n,
+                "backend": cluster.backend,
+                "segment_ticks": S,
+                "ticks": T,
+                "start_tick": start_tick,
+                "spec": spec.to_dict(),
+            },
+        )
+    replica_keys = [cluster._split() for _ in range(replicas)]
+    keys = ssweep.sweep_key_schedule(replica_keys, cs)
+    rkeys_np = np.stack([np.asarray(k) for k in replica_keys])
+    slabs: list[Any] = []
+    pending: tuple | None = None
+
+    def _launch(seg: int, a: int, b: int, carry: tuple):
+        meta = {
+            "backend": cluster.backend,
+            "n": cs.base.n,
+            "ticks": b - a,
+            "replicas": r,
+            "run_id": run_id,
+            "segment": seg,
+            "tick0": a,
+            "segment_ticks": S,
+            "total_ticks": T,
+        }
+        args = (
+            *carry,
+            cs.ev_tick,
+            cs.ev_kind,
+            cs.ev_node,
+            cs.base.p_tick,
+            cs.base.p_gid,
+            cs.loss[:, a:b],
+            keys[:, a:b],
+            jnp.int32(a),
+        )
+        statics = dict(params=params, has_revive=cs.base.has_revive)
+        ssweep._dispatches += 1
+        t0 = time.perf_counter()
+        if led.enabled:
+            out, row = led.launch(
+                "run_sweep", ssweep._sweep_scan, *args, _meta=meta, **statics
+            )
+        else:
+            out, row = ssweep._sweep_scan(*args, **statics), None
+        if row is not None:
+            row["dispatch_s"] = round(time.perf_counter() - t0, 6)
+        return out, row
+
+    def _drain(p: tuple, *, overlapped: bool) -> None:
+        seg, a, b, ys, row = p
+        t0 = time.perf_counter()
+        stacks = {k: np.asarray(v) for k, v in ys.items()}
+        slab = ssweep.SweepTrace(
+            metrics={
+                k: v
+                for k, v in stacks.items()
+                if k not in ("converged", "live", "loss")
+            },
+            converged=stacks["converged"],
+            live=stacks["live"],
+            loss=stacks["loss"],
+            n=cluster.n,
+            backend=cluster.backend,
+            replica_keys=rkeys_np,
+            loss_scales=cs.loss_scales,
+            kill_jitter=cs.kill_jitter,
+            start_tick=start_tick + a,
+            spec=None,
+        )
+        if store_obj is not None:
+            store_obj.append(slab, segment=seg, tick0=a)
+        else:
+            slabs.append(slab)
+        drain_s = time.perf_counter() - t0
+        if row is not None:
+            row["drain_s"] = round(drain_s, 6)
+            row["drain_overlap_s"] = round(drain_s if overlapped else 0.0, 6)
+            led.record(row)
+
+    for seg, (a, b) in enumerate(bounds):
+        out, row = _launch(seg, a, b, carry)
+        carry, ys = out[:4], out[4]
+        if pending is not None:
+            _drain(pending, overlapped=True)
+            pending = None
+        pending = (seg, a, b, ys, row)
+        if not pipeline:
+            jax.block_until_ready(carry)
+            _drain(pending, overlapped=False)
+            pending = None
+    if pending is not None:
+        _drain(pending, overlapped=False)
+
+    states, up, resp, adj_out = carry
+    nets = NetState(up=up, responsive=resp, adj=adj_out)
+    if not assemble:
+        return store_obj
+    trace = (
+        store_obj.assemble()
+        if store_obj is not None
+        else ssweep.SweepTrace.concat_ticks(slabs, spec=spec.to_dict())
+    ).validate()
+    trace.final_states = states
+    trace.final_nets = nets
+    return trace

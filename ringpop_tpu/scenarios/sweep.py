@@ -314,13 +314,19 @@ def _sweep_scan_impl(
     p_gid,
     loss,
     keys,
+    tick0=None,
     *,
     params,
     has_revive: bool,
 ):
+    # ``tick0`` (traced int32 scalar shared by every replica, or None
+    # for 0) is the segment offset of the streamed sweep
+    # (scenarios/stream.py): closed over rather than batched, so the
+    # vmapped body sees the same global tick numbering per segment.
     return jax.vmap(
         functools.partial(
-            runner._scenario_scan_impl, params=params, has_revive=has_revive
+            runner._scenario_scan_impl, tick0=tick0,
+            params=params, has_revive=has_revive
         ),
         # batched: state/net (leading replica axis), node events (jitter
         # reorders rows), loss (scaled), keys.  Shared: partition rows.
@@ -411,8 +417,7 @@ def run_sweep_compiled(
             f"key schedule is {keys.shape[:2]} for "
             f"({cs.replicas} replicas, {cs.base.ticks} ticks)"
         )
-    runner.precheck(state, net, cs.base)
-    adj = runner._normalize_adj(net, cs.base.n)
+    adj = runner.precheck(state, net, cs.base)
     r = cs.replicas
     batched = [
         _broadcast_replicas(state, r),
@@ -550,6 +555,56 @@ class SweepTrace:
             backend=self.backend,
             start_tick=self.start_tick,
             spec=spec,
+        )
+
+    @classmethod
+    def concat_ticks(
+        cls, slabs, *, spec: dict[str, Any] | None = None
+    ) -> "SweepTrace":
+        """Reassemble contiguous per-segment sweep slabs (a streamed
+        sweep's segment-store content, scenarios/stream.py) along the
+        tick axis — bit-identical to the [R, T] stacks the unsegmented
+        vmapped scan would have produced.  Slabs must share the replica
+        axis (same replica keys and sweep parameters) and be
+        tick-contiguous."""
+        slabs = list(slabs)
+        if not slabs:
+            raise ValueError("no slabs to concatenate")
+        first = slabs[0]
+        expect = first.start_tick
+        for s in slabs:
+            if s.n != first.n or s.backend != first.backend:
+                raise ValueError("slabs disagree on n/backend")
+            if set(s.metrics) != set(first.metrics):
+                raise ValueError("slabs disagree on metric series")
+            if (
+                s.replicas != first.replicas
+                or not np.array_equal(s.replica_keys, first.replica_keys)
+                or s.loss_scales != first.loss_scales
+                or s.kill_jitter != first.kill_jitter
+            ):
+                raise ValueError("slabs disagree on the replica axis")
+            if s.start_tick != expect:
+                raise ValueError(
+                    f"slab at start_tick {s.start_tick} is not contiguous "
+                    f"(expected {expect})"
+                )
+            expect += s.ticks
+        return cls(
+            metrics={
+                k: np.concatenate([s.metrics[k] for s in slabs], axis=1)
+                for k in first.metrics
+            },
+            converged=np.concatenate([s.converged for s in slabs], axis=1),
+            live=np.concatenate([s.live for s in slabs], axis=1),
+            loss=np.concatenate([s.loss for s in slabs], axis=1),
+            n=first.n,
+            backend=first.backend,
+            replica_keys=first.replica_keys,
+            loss_scales=first.loss_scales,
+            kill_jitter=first.kill_jitter,
+            start_tick=first.start_tick,
+            spec=spec if spec is not None else first.spec,
         )
 
     # -- per-replica outcome ticks (the sweep's headline statistics) --------

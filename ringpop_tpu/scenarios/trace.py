@@ -97,6 +97,43 @@ class Trace:
         }
         return out
 
+    @classmethod
+    def concat(cls, slabs, *, spec: dict[str, Any] | None = None) -> "Trace":
+        """Reassemble contiguous per-segment slabs (a streamed run's
+        segment-store content, scenarios/stream.py) into one
+        full-series trace — bit-identical to the trace the unsegmented
+        scan would have stacked.  Slabs must be tick-contiguous
+        (``start_tick`` ordering) and agree on n/backend/series."""
+        slabs = list(slabs)
+        if not slabs:
+            raise ValueError("no slabs to concatenate")
+        first = slabs[0]
+        expect = first.start_tick
+        for s in slabs:
+            if s.n != first.n or s.backend != first.backend:
+                raise ValueError("slabs disagree on n/backend")
+            if set(s.metrics) != set(first.metrics):
+                raise ValueError("slabs disagree on metric series")
+            if s.start_tick != expect:
+                raise ValueError(
+                    f"slab at start_tick {s.start_tick} is not contiguous "
+                    f"(expected {expect})"
+                )
+            expect += s.ticks
+        return cls(
+            metrics={
+                k: np.concatenate([s.metrics[k] for s in slabs])
+                for k in first.metrics
+            },
+            converged=np.concatenate([s.converged for s in slabs]),
+            live=np.concatenate([s.live for s in slabs]),
+            loss=np.concatenate([s.loss for s in slabs]),
+            n=first.n,
+            backend=first.backend,
+            start_tick=first.start_tick,
+            spec=spec if spec is not None else first.spec,
+        )
+
     # -- npz round trip (shared with checkpoint.py via the dict forms) ------
 
     def to_arrays(self, prefix: str = "") -> dict[str, np.ndarray]:

@@ -69,6 +69,35 @@ def test_census_pins_dense_vs_delta_peak_ordering(rows):
 
 
 @pytest.mark.slow
+def test_census_segmented_scenario_peak_flat_in_total_ticks():
+    """The streamed runner's CPU-side footprint deliverable (ROADMAP
+    item 2 / the streaming rework): the S-tick segment program's peak
+    bytes are a function of (backend, n, S) ONLY — censusing it under
+    a 4x longer total horizon reports byte-identical footprints, while
+    the whole-trace program's output bytes grow linearly with T (the
+    stacked telemetry).  This is what makes a 1M-tick soak
+    memory-feasible: the host holds O(segment), the device holds one
+    segment's program."""
+    # small n so the [T]-stacked telemetry dominates the fixed-size
+    # final state in the output accounting (at large n the N^2 state
+    # swamps it and the T term would hide in the noise)
+    n, s = 32, 8
+    seg_short = mc.census_scenario("dense", n, 64, 64, segment_ticks=s)
+    seg_long = mc.census_scenario("dense", n, 1024, 64, segment_ticks=s)
+    for field in ("argument_bytes", "output_bytes", "temp_bytes",
+                  "peak_bytes"):
+        assert seg_short[field] == seg_long[field], field
+    whole_short = mc.census_scenario("dense", n, 64, 64)
+    whole_long = mc.census_scenario("dense", n, 1024, 64)
+    # the whole-trace program hoards [T]-stacked outputs: 16x the
+    # ticks grows the output bytes severalfold (plus the T-shaped
+    # key/loss inputs), while the segment program never saw T at all
+    assert whole_long["output_bytes"] > 2 * whole_short["output_bytes"]
+    assert whole_long["argument_bytes"] > whole_short["argument_bytes"]
+    assert seg_long["output_bytes"] < whole_long["output_bytes"]
+
+
+@pytest.mark.slow
 def test_census_sweep_arguments_scale_with_replicas(rows):
     """The sweep's donated carry is R x the single-scenario state (the
     broadcast replica axis), so its argument bytes must be ~R x the

@@ -1,0 +1,478 @@
+"""Streaming chunked-scan runner (scenarios/stream.py): segmented
+dispatch parity, the segment store, pipelined-drain ledger rows, and
+kill-a-soak-mid-flight resume.
+
+The two contracts everything here pins:
+
+* a streamed run of ANY segment size is bit-identical to the
+  unsegmented ``run_scenario`` — same key schedule, same trajectory,
+  same trace (segmentation is an execution strategy, not semantics);
+* a SIGKILL'd streamed soak resumed from its last checkpoint produces
+  bit-identical final checksums and traces to the uninterrupted run
+  (checkpoint v5 cursor + segment-exact key schedule re-derivation).
+
+Fast lane: tiny-n dense + delta (three scan compiles total — the
+dense whole-run arm, the dense segment program, the delta segment
+program; every other fast test reuses those executables or is
+host-only).  The extended grid — partitions + ramps, traffic co-runs,
+streamed sweeps, multi-point interrupts with checkpoint cadence > 1 —
+rides the slow lane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.obs.emitters import CaptureEmitter
+from ringpop_tpu.scenarios import runner as srunner
+from ringpop_tpu.scenarios import stream as sstream
+from ringpop_tpu.scenarios.trace import Trace
+
+FAST = sim.SwimParams(suspicion_ticks=5)
+# IDENTICAL shapes/params to test_scenario's fast smoke (n=6, T=4, one
+# kill, suspicion_ticks=5): under the tier-1 run the whole-horizon
+# scan program is already jit-cached by that module, so the parity
+# test here pays only the segment program's compile.  Richer specs
+# (loss events, partitions, ramps, traffic) ride the slow grid.
+N, TICKS, SEG = 6, 4, 2
+SPEC = {"ticks": TICKS, "events": [{"at": 1, "op": "kill", "node": 5}]}
+# the delta fast shapes (one segment-program compile serves both the
+# uninterrupted and the resumed run)
+DN, DTICKS, DSEG = 8, 8, 4
+DSPEC = {"ticks": DTICKS, "events": [{"at": 2, "op": "kill", "node": 7}]}
+
+
+def _dense(seed: int = 3) -> SimCluster:
+    return SimCluster(N, FAST, seed=seed)
+
+
+def _delta(seed: int = 3) -> SimCluster:
+    return SimCluster(
+        DN, FAST, seed=seed, backend="delta",
+        capacity=DN, wire_cap=DN, claim_grid=2 * DN,
+    )
+
+
+def _traces_equal(a: Trace, b: Trace) -> None:
+    assert set(a.metrics) == set(b.metrics)
+    np.testing.assert_array_equal(a.converged, b.converged)
+    np.testing.assert_array_equal(a.live, b.live)
+    np.testing.assert_array_equal(a.loss, b.loss)
+    for k in a.metrics:
+        np.testing.assert_array_equal(a.metrics[k], b.metrics[k], err_msg=k)
+
+
+# -- fast: streamed == unsegmented (the semantic-identity contract) ---------
+
+
+def test_streamed_matches_whole_run_dense():
+    a = _dense()
+    whole = a.run_scenario(SPEC)
+    before = srunner.dispatch_count()
+    b = _dense()
+    streamed = b.run_scenario(SPEC, segment_ticks=SEG)
+    assert srunner.dispatch_count() - before == TICKS // SEG
+    _traces_equal(whole, streamed)
+    assert a.checksums() == b.checksums()
+    # the cluster key advanced identically: reruns stay in lockstep
+    np.testing.assert_array_equal(np.asarray(a.key), np.asarray(b.key))
+    # run_scenario bookkeeping holds on the streamed path too
+    assert b.traces[-1] is streamed
+    assert b.metrics_log[-1]["ticks"] == TICKS
+
+
+# -- fast: the segment store (host-only) ------------------------------------
+
+
+def _slab(start_tick: int, ticks: int, base: int = 0) -> Trace:
+    rng = np.arange(ticks, dtype=np.int32) + base
+    return Trace(
+        metrics={"pings_sent": rng, "acks": rng * 2},
+        converged=(rng % 2 == 0),
+        live=np.full(ticks, 5, np.int32),
+        loss=np.zeros(ticks, np.float32),
+        n=6,
+        backend="dense",
+        start_tick=start_tick,
+    )
+
+
+def test_segment_store_roundtrip_and_lazy_iter(tmp_path):
+    path = str(tmp_path / "store")
+    meta = {"kind": "trace", "run_id": "r1", "n": 6, "backend": "dense",
+            "segment_ticks": 4, "ticks": 10, "start_tick": 0,
+            "spec": {"ticks": 10, "events": []}}
+    store = sstream.SegmentStore.create(path, meta)
+    store.append(_slab(0, 4, 0), segment=0, tick0=0)
+    store.append(_slab(4, 4, 4), segment=1, tick0=4)
+    store.append(_slab(8, 2, 8), segment=2, tick0=8)
+
+    back = sstream.SegmentStore.open(path)
+    assert back.segments == 3 and back.ticks_stored == 10
+    # the lazy reader hands back one bounded slab at a time — the
+    # O(segment) loader the memory contract is asserted through
+    for slab in back.iter_traces():
+        assert slab.ticks <= 4
+    full = back.assemble()
+    assert full.ticks == 10
+    np.testing.assert_array_equal(
+        full.metrics["pings_sent"], np.arange(10, dtype=np.int32)
+    )
+    assert full.spec == meta["spec"]
+
+    # truncate to a checkpoint cursor: the uncommitted tail drops
+    back.truncate(8)
+    assert back.ticks_stored == 8
+    reopened = sstream.SegmentStore.open(path)
+    assert reopened.ticks_stored == 8
+
+    # a different run may not reuse the directory
+    with pytest.raises(ValueError, match="refusing to mix runs"):
+        sstream.SegmentStore.create(path, {**meta, "run_id": "r2"})
+
+
+def test_trace_concat_rejects_gaps_and_mismatch():
+    with pytest.raises(ValueError, match="not contiguous"):
+        Trace.concat([_slab(0, 4), _slab(6, 4)])
+    odd = _slab(4, 4)
+    odd.metrics["extra"] = np.zeros(4, np.int32)
+    with pytest.raises(ValueError, match="metric series"):
+        Trace.concat([_slab(0, 4), odd])
+    with pytest.raises(ValueError, match="no slabs"):
+        Trace.concat([])
+
+
+def test_stream_api_validation(tmp_path):
+    c = _dense()
+    with pytest.raises(ValueError, match="streaming options"):
+        c.run_scenario(SPEC, store=str(tmp_path / "s"))
+    with pytest.raises(ValueError, match="segment store"):
+        c.run_scenario(SPEC, segment_ticks=4, assemble=False)
+    with pytest.raises(ValueError, match="segment_ticks"):
+        sstream.segment_bounds(8, 0)
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        sstream.run_streamed(c, SPEC, segment_ticks=4, checkpoint_every=0)
+
+
+def test_failed_stream_does_not_advance_key(tmp_path):
+    """A raising streamed call (here: store refusal) may not advance
+    cluster.key — the rerun-lockstep invariant runner.precheck
+    documents for the unsegmented path."""
+    store = str(tmp_path / "st")
+    c0 = _dense()
+    c0.run_scenario(SPEC, segment_ticks=SEG, store=store)
+    c1 = _dense(seed=4)
+    before = np.asarray(c1.key).copy()
+    with pytest.raises(ValueError, match="refusing to mix runs"):
+        c1.run_scenario(SPEC, segment_ticks=SEG, store=store)
+    np.testing.assert_array_equal(before, np.asarray(c1.key))
+    with pytest.raises(ValueError, match="refusing to mix runs"):
+        c1.run_sweep(SPEC, 2, segment_ticks=SEG, store=store)
+    np.testing.assert_array_equal(before, np.asarray(c1.key))
+
+
+# -- fast: kill-a-soak-mid-flight resume (dense + delta) --------------------
+
+
+def test_kill_resume_bit_identical_dense(tmp_path):
+    # the uninterrupted twin, streamed with checkpoints (same segment
+    # executable as test_streamed_matches_whole_run_dense — warm)
+    a = _dense()
+    ckpt_a = str(tmp_path / "a.npz")
+    whole = a.run_scenario(SPEC, segment_ticks=SEG, checkpoint_path=ckpt_a)
+
+    # the killed run: SIGKILL simulated right after the first
+    # checkpoint lands (the in-flight segment is abandoned)
+    b = _dense()
+    ckpt_b = str(tmp_path / "b.npz")
+    with pytest.raises(sstream.StreamInterrupted):
+        sstream.run_streamed(
+            b, SPEC, segment_ticks=SEG, checkpoint_path=ckpt_b,
+            interrupt_after=1,
+        )
+    cur = sstream.SegmentStore.open(ckpt_b + ".segments")
+    assert cur.ticks_stored >= SEG  # the completed prefix persisted
+
+    b2, resumed = sstream.resume(ckpt_b)
+    _traces_equal(whole, resumed)
+    assert a.checksums() == b2.checksums()
+    np.testing.assert_array_equal(np.asarray(a.key), np.asarray(b2.key))
+    # the resumed cluster's bookkeeping matches the uninterrupted one's
+    assert b2.metrics_log[-1] == a.metrics_log[-1]
+
+    # the finished checkpoint's cursor is complete: resume is a no-op
+    # reload that reassembles from the store
+    a2, again = sstream.resume(ckpt_a)
+    _traces_equal(whole, again)
+
+    # checkpoint v5 cursor shape (what a resume runs on)
+    from ringpop_tpu import checkpoint
+
+    mid = checkpoint.load(ckpt_b)
+    cur = mid.stream_cursor
+    assert cur is not None and cur["ticks_done"] == TICKS
+    for field in ("run_id", "spec", "segment_ticks", "start_key",
+                  "base_loss", "store", "checkpoint_every"):
+        assert field in cur, field
+
+
+def test_streamed_store_memory_contract(tmp_path):
+    """assemble=False never materializes a whole-run series: the
+    result is the store handle, and every slab the loader yields is
+    segment-bounded (the acceptance's O(segment) assertion)."""
+    c = _dense()
+    store = c.run_scenario(
+        SPEC, segment_ticks=SEG, store=str(tmp_path / "st"), assemble=False
+    )
+    assert isinstance(store, sstream.SegmentStore)
+    seen = 0
+    for slab in store.iter_traces():
+        assert slab.ticks <= SEG
+        seen += slab.ticks
+    assert seen == TICKS
+    # metrics_log still records the run (from the last slab)
+    assert c.metrics_log[-1]["ticks"] == TICKS
+
+
+def test_kill_resume_bit_identical_delta(tmp_path):
+    a = _delta()
+    ckpt_a = str(tmp_path / "a.npz")
+    whole = a.run_scenario(DSPEC, segment_ticks=DSEG, checkpoint_path=ckpt_a)
+
+    b = _delta()
+    ckpt_b = str(tmp_path / "b.npz")
+    with pytest.raises(sstream.StreamInterrupted):
+        sstream.run_streamed(
+            b, DSPEC, segment_ticks=DSEG, checkpoint_path=ckpt_b,
+            interrupt_after=1,
+        )
+    b2, resumed = sstream.resume(ckpt_b)
+    assert b2.backend == "delta"
+    _traces_equal(whole, resumed)
+    assert a.checksums() == b2.checksums()
+    np.testing.assert_array_equal(np.asarray(a.key), np.asarray(b2.key))
+
+
+# -- fast: ledger segment rows + pipelining summary -------------------------
+
+
+def test_ledger_segment_rows_and_run_summary():
+    from ringpop_tpu.obs.ledger import default_ledger, summarize_runs
+
+    led = default_ledger()
+    led.enable(None)
+    led.clear()
+    try:
+        c = _dense()
+        c.run_scenario(SPEC, segment_ticks=SEG)  # warm executable
+        rows = [r for r in led.rows if r.get("run_id")]
+        assert len(rows) == TICKS // SEG
+        assert len({r["run_id"] for r in rows}) == 1
+        assert [r["segment"] for r in rows] == list(range(TICKS // SEG))
+        # exactly one cold row per (backend, segment shape) — here zero
+        # or one depending on whether the AOT cache saw the shape yet
+        assert sum(r["cold"] for r in rows) <= 1
+        for r in rows:
+            assert r["ticks"] == SEG and r["segment_ticks"] == SEG
+            assert "dispatch_s" in r and "drain_s" in r
+            assert "drain_overlap_s" in r
+        # every drain except the last overlapped the next dispatch
+        assert all(r["drain_overlap_s"] > 0 for r in rows[:-1])
+        assert rows[-1]["drain_overlap_s"] == 0.0
+        runs = summarize_runs(led.rows)
+        assert len(runs) == 1
+        assert runs[0]["segments"] == TICKS // SEG
+        assert runs[0]["ticks"] == TICKS
+        assert 0.0 < runs[0]["overlap_pct"] <= 100.0
+    finally:
+        led.disable()
+        led.clear()
+
+
+def test_ledger_launch_disabled_is_passthrough():
+    from ringpop_tpu.obs.ledger import DispatchLedger
+
+    led = DispatchLedger()
+    out, row = led.launch("x", lambda v: v + 1, 1)
+    assert out == 2 and row is None
+    assert led.rows == []
+
+
+# -- fast: bridge continuation (host-only) ----------------------------------
+
+
+def test_replay_trace_prev_live_continuation():
+    """Slab-by-slab replay (declare once, prev_live threaded) emits the
+    exact stat stream the whole-trace replay does."""
+    from ringpop_tpu.obs import bridge as obs_bridge
+
+    full = Trace(
+        metrics={"pings_sent": np.array([3, 3, 3, 3, 3, 3], np.int32)},
+        converged=np.ones(6, bool),
+        live=np.array([4, 4, 5, 5, 6, 6], np.int32),
+        loss=np.zeros(6, np.float32),
+        n=6,
+        backend="dense",
+    )
+    whole = CaptureEmitter()
+    obs_bridge.replay_trace(full, whole, checksum=None)
+
+    slabs = [
+        Trace(
+            metrics={"pings_sent": full.metrics["pings_sent"][a:b]},
+            converged=full.converged[a:b],
+            live=full.live[a:b],
+            loss=full.loss[a:b],
+            n=6,
+            backend="dense",
+            start_tick=a,
+        )
+        for a, b in ((0, 2), (2, 4), (4, 6))
+    ]
+    seg = CaptureEmitter()
+    prev = None
+    for i, slab in enumerate(slabs):
+        obs_bridge.replay_trace(
+            slab, seg, checksum=None,
+            declare_namespace=(i == 0), prev_live=prev,
+        )
+        prev = int(slab.live[-1])
+    assert whole.calls == seg.calls
+
+
+def test_checkpoint_v4_loads_without_cursor(tmp_path):
+    """Pre-v5 checkpoints (no stream meta) load with a None cursor and
+    resume() rejects them with a clear error."""
+    from ringpop_tpu import checkpoint
+
+    c = _dense()  # no tick: the version shim needs no compiled program
+    path = str(tmp_path / "old.npz")
+    checkpoint.save(c, path)
+    data = dict(np.load(path, allow_pickle=False))
+    meta = json.loads(bytes(data["meta"]).decode())
+    meta["version"] = 4
+    data["meta"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez_compressed(path, **data)
+
+    back = checkpoint.load(path)
+    assert back.stream_cursor is None
+    with pytest.raises(ValueError, match="no stream cursor"):
+        sstream.resume(path)
+
+
+# -- slow: the extended grid ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_streamed_grid_partitions_ramps_and_checkpoint_cadence(tmp_path):
+    """Dense acceptance scenario (kill + partition + heal + loss ramp)
+    streamed at a ragged segment size, with checkpoint_every=2 and a
+    late interrupt — still bit-identical to the unsegmented run."""
+    n, ticks = 12, 40
+    spec = {
+        "ticks": ticks,
+        "events": [
+            {"at": 5, "op": "kill", "node": 3},
+            {"at": 10, "op": "partition",
+             "groups": [list(range(6)), list(range(6, 12))]},
+            {"at": 10, "op": "loss", "p": 0.08},
+            {"at": 20, "op": "heal"},
+            {"at": 25, "op": "loss_ramp", "until": 30, "to": 0.0},
+        ],
+    }
+    params = sim.SwimParams(suspicion_ticks=8)
+    a = SimCluster(n, params, seed=7)
+    whole = a.run_scenario(spec)
+
+    b = SimCluster(n, params, seed=7)
+    streamed = b.run_scenario(spec, segment_ticks=7)  # ragged tail of 5
+    _traces_equal(whole, streamed)
+    assert a.checksums() == b.checksums()
+
+    c = SimCluster(n, params, seed=7)
+    ckpt = str(tmp_path / "grid.npz")
+    with pytest.raises(sstream.StreamInterrupted):
+        sstream.run_streamed(
+            c, spec, segment_ticks=7, checkpoint_path=ckpt,
+            checkpoint_every=2, interrupt_after=2,
+        )
+    c2, resumed = sstream.resume(ckpt)
+    _traces_equal(whole, resumed)
+    assert a.checksums() == c2.checksums()
+
+
+@pytest.mark.slow
+def test_streamed_traffic_rides_the_same_path(tmp_path):
+    """A chaos+traffic soak streams too: serving counters in every
+    slab, the assembled trace bit-identical to the unsegmented
+    traffic co-run, and a kill+resume preserving it all."""
+    n, ticks = 12, 24
+    spec = {"ticks": ticks,
+            "events": [{"at": 4, "op": "kill", "node": 11}]}
+    traffic = {"kind": "uniform", "keys_per_tick": 8, "pool": 32}
+    params = sim.SwimParams(suspicion_ticks=8)
+
+    a = SimCluster(n, params, seed=5)
+    whole = a.run_scenario(spec, traffic=traffic)
+    assert "lookups" in whole.metrics
+
+    b = SimCluster(n, params, seed=5)
+    streamed = b.run_scenario(spec, traffic=traffic, segment_ticks=8)
+    _traces_equal(whole, streamed)
+    assert a.checksums() == b.checksums()
+
+    c = SimCluster(n, params, seed=5)
+    ckpt = str(tmp_path / "traffic.npz")
+    with pytest.raises(sstream.StreamInterrupted):
+        sstream.run_streamed(
+            c, spec, traffic=traffic, segment_ticks=8,
+            checkpoint_path=ckpt, interrupt_after=1,
+        )
+    c2, resumed = sstream.resume(ckpt)
+    _traces_equal(whole, resumed)
+    assert a.checksums() == c2.checksums()
+    # the resumed run recompiled the workload from the cursor
+    assert resumed.metrics["lookups"].sum() == whole.metrics["lookups"].sum()
+
+
+@pytest.mark.slow
+def test_sweep_streamed_matches_whole(tmp_path):
+    """A streamed sweep (R replicas x S-tick segments) reproduces the
+    whole-horizon vmapped sweep bit-for-bit, and its slabs land in a
+    kind='sweep' store that reassembles."""
+    n, ticks, r = 8, 9, 2
+    spec = {"ticks": ticks, "events": [{"at": 2, "op": "kill", "node": 7}]}
+    params = sim.SwimParams(suspicion_ticks=5)
+
+    a = SimCluster(n, params, seed=9)
+    whole = a.run_sweep(spec, r)
+    b = SimCluster(n, params, seed=9)
+    streamed = b.run_sweep(spec, r, segment_ticks=4)  # ragged tail of 1
+    assert streamed.replicas == r and streamed.ticks == ticks
+    np.testing.assert_array_equal(whole.converged, streamed.converged)
+    np.testing.assert_array_equal(whole.live, streamed.live)
+    np.testing.assert_array_equal(whole.replica_keys, streamed.replica_keys)
+    for k in whole.metrics:
+        np.testing.assert_array_equal(
+            whole.metrics[k], streamed.metrics[k], err_msg=k
+        )
+    # final per-replica states ride along like run_sweep's
+    assert streamed.final_states is not None
+
+    c = SimCluster(n, params, seed=9)
+    store = str(tmp_path / "sweepstore")
+    handle = c.run_sweep(
+        spec, r, segment_ticks=4, store=store, assemble=False
+    )
+    assert isinstance(handle, sstream.SegmentStore)
+    assert handle.kind == "sweep"
+    for slab in handle.iter_traces():
+        assert slab.ticks <= 4 and slab.replicas == r
+    back = handle.assemble()
+    np.testing.assert_array_equal(whole.converged, back.converged)
