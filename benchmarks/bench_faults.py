@@ -1,0 +1,207 @@
+"""Failure-model sweeps: detection/heal distributions per family.
+
+The SWIM paper's own evaluation method — multi-trial distributions of
+detection and dissemination time — applied to the failure families
+real deployments die from (scenarios/faults.py): one-way link loss,
+flap storms, gray failures, rolling deploys, per-link latency.  Each
+family runs as ONE vmapped ``run_sweep`` dispatch of R replicas
+(per-replica PRNG seeds; the flap family also staggers its storm
+phase via the ``flap_jitter`` batch axis) and prints the
+detection-tick / heal-tick distributions — the tables BASELINE.md
+records.
+
+``--relay-ab`` runs the VERDICT-item-5 experiment instead: ticks to
+re-convergence on a divergence-heavy scenario (kill + burst loss + a
+one-way blackhole that forces probes through the ping-req relay) with
+``SwimParams.relay_full_sync`` off vs on — bounding what the relay's
+historical full-sync omission costs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _fam_specs(n: int, ticks: int):
+    half = list(range(n // 2, n))
+    quarter = list(range(n // 4))
+    # the rolling wave restarts as much of the upper half as fits the
+    # horizon (last revive at 10 + (len-1)*2 + 2 must stay < ticks), so
+    # run_all --sim-n overrides scale instead of failing validation
+    wave = half[: max(1, (ticks - 14) // 2)]
+    return {
+        "link_loss": {
+            "ticks": ticks,
+            "events": [
+                {"at": 10, "op": "kill", "node": n - 1},
+                {"at": 12, "op": "link_loss", "src": quarter,
+                 "dst": [n - 2, n - 3], "p": 0.9,
+                 "until": int(ticks * 0.7)},
+            ],
+        },
+        "flap_storm": {
+            "ticks": ticks,
+            "events": [
+                {"at": 10, "op": "flap",
+                 "nodes": [n - 2, n - 3, n - 4], "until": int(ticks * 0.6),
+                 "down": 3, "up": 4, "stagger": 2},
+            ],
+        },
+        "gray": {
+            "ticks": ticks,
+            "events": [
+                {"at": 8, "op": "gray", "nodes": quarter, "factor": 6,
+                 "until": int(ticks * 0.7)},
+                {"at": 12, "op": "kill", "node": n - 1},
+            ],
+        },
+        "rolling_restart": {
+            "ticks": ticks,
+            "events": [
+                {"at": 10, "op": "rolling_restart", "nodes": wave,
+                 "down": 2, "every": 2},
+            ],
+        },
+        "delay": {
+            "ticks": ticks,
+            "events": [
+                {"at": 8, "op": "delay", "src": quarter,
+                 "dst": half, "delay": 2, "jitter": 3,
+                 "until": int(ticks * 0.7)},
+                {"at": 12, "op": "kill", "node": n - 1},
+            ],
+        },
+    }
+
+
+def run_family_sweeps(n: int, ticks: int, replicas: int, seed: int):
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.models.swim_sim import SwimParams
+
+    rows = []
+    for fam, spec in _fam_specs(n, ticks).items():
+        c = SimCluster(n, SwimParams(suspicion_ticks=12), seed=seed)
+        kw = {}
+        if fam == "flap_storm":
+            kw["flap_jitter"] = [2 * (r % 4) for r in range(replicas)]
+        t0 = time.perf_counter()
+        strace = c.run_sweep(spec, replicas, **kw)
+        wall = time.perf_counter() - t0
+        rep = strace.summary()
+        det, heal = strace.detect_ticks(), strace.heal_ticks()
+        # first-suspect tick: fast flaps (down < suspicion timeout)
+        # never escalate to faulty — the suspect column is where a
+        # storm that evades detection still shows up
+        sus = strace.detect_ticks(metric="suspects_declared")
+        row = {
+            "family": fam,
+            "n": n,
+            "ticks": ticks,
+            "replicas": replicas,
+            "wall_s": round(wall, 2),
+            "suspected": int((sus >= 0).sum()),
+            "detected": rep["replicas"]["detected"],
+            "healed": rep["replicas"]["healed"],
+            "converged_final": rep["replicas"]["converged_final"],
+            "suspect": _dist(sus),
+            "detect": _dist(det),
+            "heal": _dist(heal),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    print("\n| family | suspect p50 | detected | detect p50/p95 | healed | "
+          "heal p50/p95 | converged |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(
+            f"| {r['family']} | {r['suspect']['p50']} "
+            f"| {r['detected']}/{r['replicas']} "
+            f"| {r['detect']['p50']}/{r['detect']['p95']} "
+            f"| {r['healed']}/{r['replicas']} "
+            f"| {r['heal']['p50']}/{r['heal']['p95']} "
+            f"| {r['converged_final']}/{r['replicas']} |"
+        )
+    return rows
+
+
+def _dist(ticks: np.ndarray) -> dict:
+    got = ticks[ticks >= 0]
+    if not got.size:
+        return {"p50": -1, "p95": -1, "min": -1, "max": -1}
+    return {
+        "min": int(got.min()),
+        "p50": int(np.percentile(got, 50)),
+        "p95": int(np.percentile(got, 95)),
+        "max": int(got.max()),
+    }
+
+
+def run_relay_ab(n: int, ticks: int, seeds: int):
+    """Heal-tick A/B of SwimParams.relay_full_sync on a scenario that
+    drives probes through the relay while views diverge."""
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.models.swim_sim import SwimParams
+
+    spec = {
+        "ticks": ticks,
+        "events": [
+            {"at": 2, "op": "kill", "node": n - 1},
+            {"at": 4, "op": "loss", "p": 0.3},
+            {"at": 8, "op": "link_loss",
+             "src": list(range(n // 3)),
+             "dst": list(range(2 * (n // 3), n - 1)), "p": 0.95,
+             "until": int(ticks * 0.66)},
+            {"at": int(ticks * 0.66), "op": "loss", "p": 0.0},
+        ],
+    }
+    out = {}
+    for label, flag in (("off", False), ("on", True)):
+        heals, fs = [], []
+        for s in range(seeds):
+            c = SimCluster(
+                n,
+                SwimParams(suspicion_ticks=12, relay_full_sync=flag),
+                seed=100 + s,
+            )
+            trace = c.run_scenario(spec)
+            conv = trace.converged
+            # first tick from which converged holds through the end
+            rev = conv[::-1]
+            suffix = len(conv) if rev.all() else int(np.argmax(~rev))
+            heals.append(ticks - suffix if suffix > 0 else -1)
+            fs.append(int(trace.metrics["relay_full_syncs"].sum()))
+        out[label] = {"heal_ticks": heals, "relay_full_syncs": fs}
+        print(json.dumps({"relay_full_sync": label, "n": n, **out[label]}),
+              flush=True)
+    return out
+
+
+def run(n: int = 32, ticks: int = 60, replicas: int = 4):
+    """run_all entry point: the family sweeps at a CI-sized config."""
+    for row in run_family_sweeps(n, ticks, replicas, seed=7):
+        yield row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", type=int, default=48)
+    ap.add_argument("--ticks", type=int, default=80)
+    ap.add_argument("--replicas", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--relay-ab", action="store_true",
+                    help="run the relay full-sync A/B instead of the "
+                         "family sweeps")
+    ap.add_argument("--relay-seeds", type=int, default=3)
+    args = ap.parse_args(argv)
+    if args.relay_ab:
+        run_relay_ab(args.n, args.ticks, args.relay_seeds)
+    else:
+        run_family_sweeps(args.n, args.ticks, args.replicas, args.seed)
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,7 @@ def census_scenario(
             net.up,
             net.responsive,
             jnp.zeros((n,), jnp.int32),
+            None,  # period (no gray events in the census spec)
             compiled.ev_tick,
             compiled.ev_kind,
             compiled.ev_node,
@@ -167,6 +168,7 @@ def census_scenario(
         net.up,
         net.responsive,
         jnp.zeros((n,), jnp.int32),
+        None,  # period (no gray events in the census spec)
         compiled.ev_tick,
         compiled.ev_kind,
         compiled.ev_node,
@@ -207,6 +209,7 @@ def census_sweep(
         ssweep._broadcast_replicas(net.up, replicas),
         ssweep._broadcast_replicas(net.responsive, replicas),
         ssweep._broadcast_replicas(jnp.zeros((n,), jnp.int32), replicas),
+        None,  # period (no gray events in the census spec)
         cs.ev_tick,
         cs.ev_kind,
         cs.ev_node,

@@ -30,6 +30,7 @@ SIM_BENCHES = [
     "bench_sweep",  # one vmapped R-replica dispatch vs R sequential
     "bench_lookup",  # batched device ring lookups vs the host loop
     "bench_stream",  # pipelined segmented soak vs the blocking loop
+    "bench_faults",  # failure-model family sweeps: detect/heal tables
 ]
 
 
@@ -57,6 +58,7 @@ def main(argv=None) -> int:
         if args.sim_n and name in (
             "bench_sim_convergence", "bench_partition_heal",
             "bench_scenario", "bench_sweep", "bench_stream",
+            "bench_faults",
         ):
             kwargs["n"] = args.sim_n
         try:

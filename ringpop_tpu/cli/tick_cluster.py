@@ -525,6 +525,7 @@ class TpuSimCluster(ClusterDriver):
         sweep: int = 0,
         sweep_loss_scales: list[float] | None = None,
         sweep_kill_jitter: list[int] | None = None,
+        sweep_flap_jitter: list[int] | None = None,
         traffic: str | None = None,
         segment_ticks: int | None = None,
         checkpoint: str | None = None,
@@ -551,6 +552,7 @@ class TpuSimCluster(ClusterDriver):
                 )
             self._run_sweep(
                 spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter,
+                flap_jitter=sweep_flap_jitter,
                 segment_ticks=segment_ticks, segment_store=segment_store,
             )
             return
@@ -622,11 +624,12 @@ class TpuSimCluster(ClusterDriver):
                   f"{len(trace.metrics) + 3} series) -> {trace_out}")
 
     def _run_sweep(self, spec, trace_out, replicas, loss_scales, kill_jitter,
-                   segment_ticks=None, segment_store=None):
+                   flap_jitter=None, segment_ticks=None, segment_store=None):
         t0 = time.perf_counter()
         strace = self.cluster.run_sweep(
             spec, replicas,
             loss_scales=loss_scales, kill_jitter=kill_jitter,
+            flap_jitter=flap_jitter,
             segment_ticks=segment_ticks, store=segment_store,
         )
         wall_ms = (time.perf_counter() - t0) * 1000
@@ -808,6 +811,12 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                         help="with --sweep: comma list of R per-replica "
                              "tick offsets applied to the spec's kill "
                              "events")
+    parser.add_argument("--sweep-flap-jitter", default=None, metavar="J,J,...",
+                        help="with --sweep: comma list of R per-replica "
+                             "tick offsets applied to the spec's flap "
+                             "windows (at AND until move together, so "
+                             "every replica keeps the same duty cycle at "
+                             "a different storm phase)")
     parser.add_argument("--stats-out", default=None, metavar="SPEC",
                         help="tpu-sim: stream protocol stats under "
                              "reference statsd keys (obs/bridge.py key "
@@ -906,11 +915,13 @@ def main(argv: list[str] | None = None) -> None:
                      "(the obs bridge and profiler scopes instrument the "
                      "tensor simulation; proc nodes inject a statsd "
                      "emitter via RingPop(statsd=...))")
-    sweep_scales = sweep_jitter = None
+    sweep_scales = sweep_jitter = sweep_fjitter = None
     if args.sweep_loss_scales is not None:
         sweep_scales = [float(x) for x in args.sweep_loss_scales.split(",")]
     if args.sweep_kill_jitter is not None:
         sweep_jitter = [int(x) for x in args.sweep_kill_jitter.split(",")]
+    if args.sweep_flap_jitter is not None:
+        sweep_fjitter = [int(x) for x in args.sweep_flap_jitter.split(",")]
     if backend == "host-sim":
         driver: ClusterDriver = SimCluster(args.size, args.base_port,
                                            seed=args.seed)
@@ -940,6 +951,7 @@ def main(argv: list[str] | None = None) -> None:
                     args.scenario, args.trace_out, sweep=args.sweep,
                     sweep_loss_scales=sweep_scales,
                     sweep_kill_jitter=sweep_jitter,
+                    sweep_flap_jitter=sweep_fjitter,
                     traffic=args.traffic,
                     segment_ticks=args.segment_ticks,
                     checkpoint=args.checkpoint,

@@ -281,13 +281,13 @@ class SimCluster:
         compiled = scompile.compile_spec(
             spec, self.n, base_loss=self.params.loss
         )
+        params = self.dparams if self.backend == "delta" else self.params
         # static rejections BEFORE drawing keys: a failed call must not
         # advance self.key (it would silently desynchronize reruns);
         # precheck also hands back the normalized adjacency so the
         # mask-form host sync runs once per run, not again per dispatch
-        adj = srunner.precheck(self.state, self.net, compiled)
+        adj = srunner.precheck(self.state, self.net, compiled, params)
         keys = scompile.key_schedule(self._split, compiled)
-        params = self.dparams if self.backend == "delta" else self.params
         start_tick = int(self.state.tick)
         self.state, self.net, ys = srunner.run_compiled(
             self.state, self.net, keys, compiled, params, traffic=traffic,
@@ -343,6 +343,7 @@ class SimCluster:
         *,
         loss_scales: Sequence[float] | None = None,
         kill_jitter: Sequence[int] | None = None,
+        flap_jitter: Sequence[int] | None = None,
         shard: bool = False,
         segment_ticks: int | None = None,
         store: str | None = None,
@@ -370,8 +371,12 @@ class SimCluster:
         ``segment_ticks=S`` streams the sweep (scenarios/stream.py):
         [R, S] telemetry slabs drain per pipelined segment dispatch
         into ``store`` — host sweep telemetry O(R x segment) — with
-        every replica still bit-identical to the whole-horizon call;
-        does not compose with ``shard`` yet.
+        every replica still bit-identical to the whole-horizon call,
+        and composes with ``shard=True`` (the carry stays sharded
+        across segments; bit-identical to the unsegmented sharded
+        sweep).  ``flap_jitter`` shifts replica r's flap windows by
+        ``flap_jitter[r]`` ticks (per-replica storm phases in one
+        compiled program).
         """
         from ringpop_tpu.scenarios import runner as srunner
         from ringpop_tpu.scenarios import sweep as ssweep
@@ -380,11 +385,6 @@ class SimCluster:
         if segment_ticks is not None:
             from ringpop_tpu.scenarios import stream as sstream
 
-            if shard:
-                raise NotImplementedError(
-                    "segment_ticks does not compose with shard yet "
-                    "(stream the sweep on one device, or shard whole)"
-                )
             return sstream.run_sweep_streamed(
                 self,
                 spec,
@@ -392,9 +392,11 @@ class SimCluster:
                 segment_ticks=segment_ticks,
                 loss_scales=loss_scales,
                 kill_jitter=kill_jitter,
+                flap_jitter=flap_jitter,
                 store=store,
                 assemble=assemble,
                 pipeline=pipeline,
+                shard=shard,
             )
         if store is not None or not assemble:
             raise ValueError(
@@ -413,14 +415,15 @@ class SimCluster:
             base_loss=self.params.loss,
             loss_scales=loss_scales,
             kill_jitter=kill_jitter,
+            flap_jitter=flap_jitter,
         )
+        params = self.dparams if self.backend == "delta" else self.params
         # static rejections BEFORE drawing keys (run_scenario contract)
-        srunner.precheck(self.state, self.net, cs.base)
+        srunner.precheck(self.state, self.net, cs.base, params)
         if shard:
             ssweep.precheck_shard(replicas)
         replica_keys = [self._split() for _ in range(replicas)]
         keys = ssweep.sweep_key_schedule(replica_keys, cs)
-        params = self.dparams if self.backend == "delta" else self.params
         states, nets, ys = ssweep.run_sweep_compiled(
             self.state, self.net, keys, cs, params, shard=shard
         )
@@ -439,6 +442,7 @@ class SimCluster:
             replica_keys=np.stack([np.asarray(k) for k in replica_keys]),
             loss_scales=cs.loss_scales,
             kill_jitter=cs.kill_jitter,
+            flap_jitter=cs.flap_jitter,
             start_tick=int(self.state.tick),
             spec=spec.to_dict(),
         ).validate()
@@ -744,6 +748,107 @@ class SimCluster:
     def set_loss(self, p: float) -> None:
         self.params = self.params._replace(loss=float(p))
         self.dparams = self.dparams._replace(swim=self.params)
+
+    # -- failure model (scenarios/faults.py: asymmetric links, latency,
+    # gray periods — the host surface the scenario host-loop oracle
+    # drives and operators script directly) ---------------------------------
+
+    def set_link_rules(
+        self,
+        src,
+        dst,
+        p,
+        d=None,
+        j=None,
+    ) -> None:
+        """Install K directed link rules: messages from a node with
+        ``src[k]`` to a node with ``dst[k]`` drop with extra
+        probability ``p[k]`` (composing over rules) and are delayed
+        ``d[k] + U{0..j[k]}`` ticks (dense backend, needs
+        ``enable_delay`` first).  ``src``/``dst`` are bool[K, N];
+        ``None`` d/j install loss-only rules.  Asymmetry is the point:
+        a rule severs src->dst while dst->src flows freely."""
+        src = jnp.asarray(src, dtype=bool)
+        dst = jnp.asarray(dst, dtype=bool)
+        p = jnp.asarray(p, dtype=jnp.float32)
+        if src.ndim != 2 or src.shape != dst.shape or p.shape != src.shape[:1]:
+            raise ValueError(
+                "link rules need src/dst bool[K, N] and p float[K] "
+                f"(got {src.shape}, {dst.shape}, {p.shape})"
+            )
+        if src.shape[1] != self.n:
+            raise ValueError(f"link rule masks are not n={self.n} wide")
+        kw = {}
+        if d is not None or j is not None:
+            d = np.zeros(src.shape[0], np.int32) if d is None else np.asarray(d)
+            j = np.zeros(src.shape[0], np.int32) if j is None else np.asarray(j)
+            if self.backend == "delta":
+                raise NotImplementedError(
+                    "per-link delay is dense-backend-only"
+                )
+            depth = (
+                0 if self.state.pending is None else self.state.pending.shape[0]
+            )
+            if int(d.max(initial=0) + j.max(initial=0)) >= max(depth, 1):
+                raise ValueError(
+                    f"delay rules need enable_delay(depth > max(d + j)) "
+                    f"first (depth={depth})"
+                )
+            kw = {
+                "link_d": jnp.asarray(d, jnp.int32),
+                "link_j": jnp.asarray(j, jnp.int32),
+            }
+        else:
+            kw = {"link_d": None, "link_j": None}
+        self.net = self.net._replace(
+            link_src=src, link_dst=dst, link_p=p, **kw
+        )
+
+    def clear_link_rules(self) -> None:
+        self.net = self.net._replace(
+            link_src=None, link_dst=None, link_p=None, link_d=None, link_j=None
+        )
+
+    def set_period(self, period) -> None:
+        """Per-node protocol periods (int[N]; the gray-failure model):
+        node i initiates probes every ``period[i]``-th tick but answers
+        pings and witness duties every tick.  ``None`` restores
+        lockstep.  Subsumes ``SwimParams.phase_mod`` (a row of P is
+        phase_mod=P, both backends)."""
+        if period is None:
+            self.net = self.net._replace(period=None)
+            return
+        period = jnp.asarray(period, dtype=jnp.int32)
+        if period.shape != (self.n,):
+            raise ValueError(f"period must be int[{self.n}]")
+        if self.params.phase_mod > 1:
+            raise ValueError(
+                "per-node periods do not compose with phase_mod > 1 "
+                "(a period row of P subsumes it)"
+            )
+        self.net = self.net._replace(period=period)
+
+    def enable_delay(self, depth: int) -> None:
+        """Install the in-flight claim ring buffer (dense backend) so
+        per-link delay rules can defer claims up to ``depth - 1``
+        ticks.  Must run before the first delayed tick: the buffer's
+        presence widens the per-tick PRNG split, so the compiled-scan
+        and host-loop sides both install it at run start
+        (scenarios/faults.py HostPlan / runner.prepare_faults)."""
+        if self.backend == "delta":
+            raise NotImplementedError("per-link delay is dense-backend-only")
+        if depth < 2:
+            raise ValueError(f"delay depth must be >= 2 (got {depth})")
+        if self.state.pending is not None:
+            if self.state.pending.shape[0] != depth:
+                raise ValueError(
+                    f"an in-flight buffer of depth "
+                    f"{self.state.pending.shape[0]} is already installed"
+                )
+            return
+        self.state = self.state._replace(
+            pending=jnp.zeros((depth, self.n, self.n), jnp.int32)
+        )
 
     # -- delta maintenance (no-ops on the dense backend) ---------------------
 

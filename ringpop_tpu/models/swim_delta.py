@@ -116,7 +116,9 @@ from ringpop_tpu.models.swim_sim import (
     _apply_mask,
     _check_inc,
     _distinct_ranks,
-    _drop,
+    _drop_net,
+    _stagger_send_gate,
+    _sweep_divisor,
     _validate_params,
 )
 
@@ -783,6 +785,16 @@ def _selection(
     wit = picks[:, 1:]
     wit_valid = valid[:, 1:]
 
+    # staggered protocol periods (the swim_sim phase-1 port, VERDICT
+    # item 4): static phase_mod = P gates probe initiation to one
+    # residue class per tick; the per-node NetState.period tensor (the
+    # gray-failure model, scenarios/faults.py) generalizes the divisor
+    # and phase per node — a row of P reproduces phase_mod = P value
+    # for value.  P == 1 with no period tensor traces the literal
+    # lockstep program (bit-parity with the pre-port backend).
+    per = (
+        jnp.maximum(net.period, 1) if net.period is not None else None
+    )
     if sw.probe == "sweep":
         import math
 
@@ -790,7 +802,13 @@ def _selection(
         while math.gcd(mult, n) != 1:
             mult += 1
         start = (ids * jnp.int32(mult)) % jnp.int32(n)
-        swept = (start + state.tick) % jnp.int32(n)
+        div = _sweep_divisor(sw.phase_mod, per)
+        if div is not None:
+            swept = (start + state.tick // div) % jnp.int32(n)
+        else:
+            # literal lockstep expression: bit-parity with the
+            # pre-phase_mod-port delta program
+            swept = (start + state.tick) % jnp.int32(n)
         swept_key = view_lookup(state, swept)
         sst = swept_key & 7
         ok = ((sst == ALIVE) | (sst == SUSPECT)) & (swept != ids)
@@ -800,7 +818,9 @@ def _selection(
     elif sw.probe != "uniform":
         raise ValueError(f"unknown probe policy: {sw.probe!r}")
 
-    sends = gossiping & has_target
+    sends = _stagger_send_gate(
+        gossiping & has_target, state.tick, n, sw.phase_mod, per
+    )
     t_safe = jnp.where(sends, target, 0)
     return gossiping, sends, t_safe, wit, wit_valid
 
@@ -1257,11 +1277,21 @@ def delta_step_impl(
         )
     if sw.sparse_cap:
         raise ValueError("sparse_cap is a dense-backend knob; use wire_cap here")
-    if sw.phase_mod != 1:
+    if sw.relay_full_sync:
         raise ValueError(
-            "phase_mod staggering is the dense-step fidelity experiment "
-            "(benchmarks/bench_phase_offset.py); the delta backend runs "
-            "lockstep periods"
+            "relay_full_sync is the dense-step fidelity experiment "
+            "(SwimParams docstring); the delta relay carries changes only"
+        )
+    if net.link_d is not None:
+        raise NotImplementedError(
+            "per-link delay needs the dense in-flight claim buffer "
+            "(ClusterState.pending); the delta backend supports the "
+            "loss-only link rules and per-node periods"
+        )
+    if net.period is not None and sw.phase_mod != 1:
+        raise ValueError(
+            "per-node periods (NetState.period) do not compose with the "
+            "static phase_mod stagger: a row of P subsumes phase_mod=P"
         )
     n = state.n
     w = params.wire_cap
@@ -1323,7 +1353,7 @@ def delta_step_impl(
     fwd_ok = (
         sends
         & _adj(net, ids, t_safe)
-        & ~_drop(k_loss1, (n,), sw.loss)
+        & ~_drop_net(k_loss1, (n,), sw.loss, net, ids, t_safe)
         & resp[t_safe]
     )
     sent_valid = (send_subj < SENTINEL) & fwd_ok[:, None]
@@ -1393,7 +1423,11 @@ def delta_step_impl(
     rep_subj, rep_key = _windowed_changes(state, within_rep, w)
 
     # ack claims for sender s = reply list of its receiver (pure gather)
-    ack = fwd_ok & _adj(net, t_safe, ids) & ~_drop(k_loss2, (n,), sw.loss)
+    ack = (
+        fwd_ok
+        & _adj(net, t_safe, ids)
+        & ~_drop_net(k_loss2, (n,), sw.loss, net, t_safe, ids)
+    )
     a_subj = rep_subj[t_safe]  # [N, W]
     a_key = rep_key[t_safe]
     a_subj_q = jnp.where(a_subj < SENTINEL, a_subj, 0)
@@ -1612,24 +1646,24 @@ def delta_step_impl(
         failed[:, None]
         & wit_valid
         & _adj(net, ids[:, None], wit_safe)
-        & ~_drop(k_a, kshape, sw.loss)
+        & ~_drop_net(k_a, kshape, sw.loss, net, ids[:, None], wit_safe)
         & resp[wit_safe]
     )
     ping_del = (
         req_del
         & _adj(net, wit_safe, t_safe[:, None])
-        & ~_drop(k_b, kshape, sw.loss)
+        & ~_drop_net(k_b, kshape, sw.loss, net, wit_safe, t_safe[:, None])
         & resp[t_safe][:, None]
     )
     ack_del = (
         ping_del
         & _adj(net, t_safe[:, None], wit_safe)
-        & ~_drop(k_c, kshape, sw.loss)
+        & ~_drop_net(k_c, kshape, sw.loss, net, t_safe[:, None], wit_safe)
     )
     resp_del = (
         req_del
         & _adj(net, wit_safe, ids[:, None])
-        & ~_drop(k_d, kshape, sw.loss)
+        & ~_drop_net(k_d, kshape, sw.loss, net, wit_safe, ids[:, None])
     )
     any_success = jnp.any(ack_del & resp_del, axis=1)
     definite_fail = jnp.any(req_del & ~ack_del & resp_del, axis=1)

@@ -86,7 +86,8 @@ defined order):
   ping-req-handler.js:37-59), as four sequential stage merges inside
   the probing tick; see ``_phase5_pingreq`` for the stage conventions
   (one issue set per stage, counters advance by requests served,
-  anti-echo on the reply hops, no full-sync inside the relay).
+  anti-echo on the reply hops, no full-sync inside the relay unless
+  ``relay_full_sync`` is set).
   ``benchmarks/bench_pingreq_deviation.py`` pins kill-detection-latency
   agreement with the host library (which runs the same exchange over
   real sockets) as a regression check.
@@ -163,6 +164,19 @@ class SwimParams(NamedTuple):
     # reshuffled round-robin, but a member can go unprobed for many
     # rounds — the coupon-collector tail the reference iterator avoids).
     probe: str = "sweep"
+    # Relay full-sync (VERDICT item 5 — the one knowing omission in the
+    # ping-req relay): when True, stage 5c's ack from the target to a
+    # witness falls back to the target's ENTIRE view row when the
+    # target has no non-echo claims to issue but its post-5b view hash
+    # differs from the witness's period-start hash — the same
+    # nothing-to-say-but-checksums-disagree rule the regular ping reply
+    # applies (dissemination.js:100-118 via server/ping-req-handler.js:
+    # 43-50, whose inner ping goes through the full receiver path).
+    # Off (the historical convention) the relay only carries changes
+    # and phase-4 pings repair the divergence; benchmarks/bench_faults
+    # A/Bs the heal-time cost and BASELINE.md records the bound.
+    # Dense backend only.
+    relay_full_sync: bool = False
     # Per-node staggered protocol periods (gossip.js:38-51: each node's
     # first tick lands randomly in [0, minProtocolPeriod) and periods
     # self-schedule per node; the sims' default is lockstep).  When
@@ -196,6 +210,19 @@ class ClusterState(NamedTuple):
     # score for j and the hysteresis "currently damped" bit (damping.py).
     damp: jax.Array | None = None  # float16[N, N]
     damped: jax.Array | None = None  # bool[N, N]
+    # Latency extension (None = disabled, zero cost): the in-flight
+    # claim ring buffer for per-link delay (NetState.link_d/link_j —
+    # scenarios/faults.py).  Slot ``tick % D`` matures at the START of
+    # tick ``tick`` (merged at every up-and-responsive receiver, then
+    # cleared); a claim row delayed by d scatters into slot
+    # ``(tick + d) % D`` keyed by its receiver, folding colliding
+    # senders by the lattice max exactly like the in-tick receiver
+    # merge.  Presence also widens the per-tick key split (two jitter
+    # streams), so it is installed from tick 0 of a delayed run on both
+    # the compiled-scan and host-loop sides (runner.run_compiled /
+    # SimCluster.enable_delay).  Network-resident: kill/revive do NOT
+    # clear it — messages already in flight still land.
+    pending: jax.Array | None = None  # int32[D, N, N]
 
     @property
     def n(self) -> int:
@@ -225,11 +252,37 @@ class NetState(NamedTuple):
     group: the memory-free form for block netsplits, see ``_adj``).
     ``adj=None`` means fully connected — the healthy-network case never
     ships an all-ones N x N mask through HBM (1 GB at 32k nodes).
+
+    Failure-model extension (all None-default, zero cost when absent;
+    scenarios/faults.py):
+
+    * ``link_src``/``link_dst``/``link_p`` — K DIRECTED block loss
+      rules: a message from s to r is additionally dropped with the
+      composed probability ``1 - prod_k(1 - link_p[k])`` over rules
+      with ``link_src[k, s] & link_dst[k, r]``.  O(K * N) memory —
+      never an [N, N] matrix — evaluated at the same gathered index
+      pairs as ``adj`` (``_drop_net``).  Asymmetry is the point: a
+      rule drops src->dst while dst->src flows freely.
+    * ``link_d``/``link_j`` — per-rule base delay and jitter bound in
+      ticks: claims on a hit link land ``max_k(link_d) + U{0..max_k(
+      link_j)}`` ticks later via ``ClusterState.pending``.  Their
+      PRESENCE (not value) routes the step through the delay path, so
+      they stay None unless the run really delays.
+    * ``period`` — int32[N] per-node protocol period: node i initiates
+      its probe only on ticks with ``tick % period[i] == phase_i``
+      (the gray-failure / phase_mod generalization; timers, witness
+      service and deliveries stay per-tick).
     """
 
     up: jax.Array  # bool[N]
     responsive: jax.Array  # bool[N]
     adj: jax.Array | None = None  # bool[N, N] | int32[N] gid | None
+    link_src: jax.Array | None = None  # bool[K, N]
+    link_dst: jax.Array | None = None  # bool[K, N]
+    link_p: jax.Array | None = None  # float32[K]
+    link_d: jax.Array | None = None  # int32[K]
+    link_j: jax.Array | None = None  # int32[K]
+    period: jax.Array | None = None  # int32[N]
 
 
 def make_net(n: int, *, partitioned: bool = False) -> NetState:
@@ -502,6 +555,103 @@ def _drop(key: jax.Array, shape: tuple, loss: float | jax.Array) -> jax.Array:
     return jax.random.uniform(key, shape) < loss
 
 
+def _link_hit_p(net: NetState, rows, cols) -> jax.Array:
+    """float32 per-message extra drop probability from the directed
+    link rules, evaluated at gathered (sender, receiver) index pairs
+    (the ``_adj`` convention — O(K) per pair, no [N, N] tensor).
+    Overlapping rules compose independently: keep = prod(1 - p_k)."""
+    hit = net.link_src[:, rows] & net.link_dst[:, cols]  # [K, *shape]
+    pk = net.link_p.reshape((-1,) + (1,) * (hit.ndim - 1))
+    keep = jnp.prod(jnp.where(hit, 1.0 - pk, 1.0), axis=0)
+    return (1.0 - keep).astype(jnp.float32)
+
+
+def _drop_net(
+    key: jax.Array,
+    shape: tuple,
+    loss: float | jax.Array,
+    net: NetState,
+    rows,
+    cols,
+) -> jax.Array:
+    """``_drop`` composed with the per-link rules: ONE uniform draw per
+    message compared against ``loss + (1 - loss) * p_link``.  With no
+    rules installed this IS ``_drop`` (same draw from the same key), so
+    rule-free programs and rules-with-zero-p ticks make bit-identical
+    decisions — the basis of the host-loop parity for link scenarios
+    (the host installs the full masked rule table per segment,
+    scenarios/faults.py HostPlan)."""
+    if net.link_src is None:
+        return _drop(key, shape, loss)
+    lp = _link_hit_p(net, rows, cols)
+    base = loss if isinstance(loss, jax.Array) else jnp.float32(loss)
+    return jax.random.uniform(key, shape) < base + (1.0 - base) * lp
+
+
+def _link_delay_bounds(
+    net: NetState, rows, cols
+) -> tuple[jax.Array, jax.Array]:
+    """(base int32, jitter bound int32) per message: the maxima over
+    the rules hitting the (sender, receiver) pair (inactive rules are
+    masked to zero by the caller's schedule, so they contribute 0)."""
+    if net.link_d is None:
+        z = jnp.zeros(jnp.broadcast_shapes(jnp.shape(rows), jnp.shape(cols)),
+                      jnp.int32)
+        return z, z
+    hit = net.link_src[:, rows] & net.link_dst[:, cols]
+    dk = net.link_d.reshape((-1,) + (1,) * (hit.ndim - 1))
+    jk = net.link_j.reshape((-1,) + (1,) * (hit.ndim - 1))
+    base = jnp.max(jnp.where(hit, dk, 0), axis=0)
+    bound = jnp.max(jnp.where(hit, jk, 0), axis=0)
+    return base, bound
+
+
+def _message_delay(
+    net: NetState, key: jax.Array, rows, cols, shape: tuple
+) -> jax.Array:
+    """int32 per-message latency: rule base + uniform in {0..jitter}.
+    One uniform draw per message regardless of rule activity, so the
+    delayed program's PRNG consumption is schedule-independent (the
+    draw exists iff ``ClusterState.pending`` exists)."""
+    base, bound = _link_delay_bounds(net, rows, cols)
+    u = jax.random.uniform(key, shape)
+    extra = jnp.minimum(
+        (u * (bound + 1).astype(jnp.float32)).astype(jnp.int32), bound
+    )
+    return base + extra
+
+
+def _sweep_divisor(phase_mod: int, per: jax.Array | None) -> jax.Array | None:
+    """Per-node sweep-advance divisor for staggered protocol periods,
+    or None for the literal lockstep path.  ONE definition shared by
+    both backends' selections: the bit-for-bit phase_mod-subsumption
+    contract (a period row of P == phase_mod=P, VERDICT item 4) rests
+    on the dense and delta arms staying value-identical."""
+    if per is not None:
+        return per
+    if phase_mod > 1:
+        return jnp.int32(phase_mod)
+    return None
+
+
+def _stagger_send_gate(
+    sends: jax.Array, tick: jax.Array, n: int, phase_mod: int,
+    per: jax.Array | None,
+) -> jax.Array:
+    """Probe-initiation gate for staggered periods (both backends):
+    node i initiates only when ``tick mod divisor`` hits its affine
+    phase — the same ``(i * 0x9E37|1) mod d`` assignment for the
+    static phase_mod and the per-node period tensor, which is what
+    makes a row of P reproduce phase_mod=P bit for bit.  Everything
+    else (timers, witness service, deliveries) stays per-tick."""
+    div = _sweep_divisor(phase_mod, per)
+    if div is None:
+        return sends
+    ids_p = jnp.arange(n, dtype=jnp.int32)
+    phase = (ids_p * jnp.int32(0x9E37 | 1)) % div
+    return sends & (tick % div == phase)
+
+
 class _Merge(NamedTuple):
     """Result of applying a batch of incoming changes at each receiver."""
 
@@ -688,6 +838,13 @@ def _phase01_select(
     gossiping = (
         net.up & net.responsive & ((own_status == ALIVE) | (own_status == SUSPECT))
     )
+    if net.period is not None and params.phase_mod > 1:
+        raise ValueError(
+            "per-node periods (NetState.period, the gray-failure model) "
+            "do not compose with the static phase_mod stagger: a row of "
+            "P in the period tensor subsumes phase_mod=P exactly"
+        )
+    per = jnp.maximum(net.period, 1) if net.period is not None else None
     target, has_target, wit, wit_valid = _choose_targets_and_witnesses(
         pingable, params.ping_req_size, k_sel
     )
@@ -714,7 +871,16 @@ def _phase01_select(
         # P=4).  Per-period advance is the reference iterator's
         # semantics (one target per period per node) and is
         # bit-identical at P=1.
-        swept = (start + state.tick // jnp.int32(params.phase_mod)) % jnp.int32(n)
+        # per-node periods (gray model) generalize the static divisor:
+        # a node with period f advances its sweep once per f ticks —
+        # per = full(P) IS phase_mod = P, value for value.  The dense
+        # step always divides (P=1 divides by 1, the historical
+        # program); the delta selection keeps its literal lockstep
+        # expression at div=None — both via the shared _sweep_divisor.
+        div = _sweep_divisor(params.phase_mod, per)
+        swept = (
+            start + state.tick // (div if div is not None else jnp.int32(1))
+        ) % jnp.int32(n)
         ok = pingable[ids, swept]
         target = jnp.where(ok, swept, target)
         has_target = has_target | ok
@@ -730,14 +896,9 @@ def _phase01_select(
     target, has_target, wit, wit_valid = jax.lax.optimization_barrier(
         (target, has_target, wit, wit_valid)
     )
-    sends = gossiping & has_target
-    if params.phase_mod > 1:
-        # staggered periods: only the in-phase residue class initiates
-        # probes this tick; everything else (timers, witness service,
-        # deliveries) stays per-tick — see SwimParams.phase_mod
-        ids_p = jnp.arange(n, dtype=jnp.int32)
-        phase = (ids_p * jnp.int32(0x9E37 | 1)) % jnp.int32(params.phase_mod)
-        sends = sends & (state.tick % jnp.int32(params.phase_mod) == phase)
+    sends = _stagger_send_gate(
+        gossiping & has_target, state.tick, n, params.phase_mod, per
+    )
     t_safe = jnp.where(sends, target, 0)
     return _Selection(
         gossiping, sends, t_safe, wit, wit_valid, maxpb.astype(jnp.int8)[:, None], h_pre
@@ -754,6 +915,7 @@ class _PingReq(NamedTuple):
     was_alive_at_target: jax.Array  # bool[N]
     changes_applied: jax.Array  # int32[] — exchange merges, all 4 stages
     flapped: jax.Array  # bool[N, N] | bool[] — exchange flaps (damping)
+    relay_full_syncs: jax.Array  # int32[] — 5c full rows (relay_full_sync)
 
 
 def _stage_issue(
@@ -804,9 +966,14 @@ def _phase5_pingreq(
       end at the lattice maximum).
     * Reply stages apply the value-form anti-echo (drop claims equal to
       what the peer provably already delivered this stage).
-    * The relay's inner ping omits the full-sync fallback — regular
-      pings (phase 4) repair checksum divergence; the relay only
-      carries changes.
+    * By default the relay's inner ping omits the full-sync fallback —
+      regular pings (phase 4) repair checksum divergence; the relay
+      only carries changes.  ``params.relay_full_sync`` closes the
+      omission: stage 5c's ack answers a witness with the target's
+      entire row when the target has nothing non-echo to issue but its
+      post-5b hash differs from the witness's period-start hash (the
+      phase-4 rule at the relay hop; measured cost bound in
+      BASELINE.md round 6).
 
     The exchange runs under ``lax.cond``: a tick with every probe acked
     pays nothing for it.
@@ -825,24 +992,24 @@ def _phase5_pingreq(
         failed[:, None]
         & sel.wit_valid
         & _adj(net, ids[:, None], wit_safe)
-        & ~_drop(k_a, kshape, params.loss)
+        & ~_drop_net(k_a, kshape, params.loss, net, ids[:, None], wit_safe)
         & resp[wit_safe]
     )
     ping_del = (
         req_del
         & _adj(net, wit_safe, t_safe[:, None])
-        & ~_drop(k_b, kshape, params.loss)
+        & ~_drop_net(k_b, kshape, params.loss, net, wit_safe, t_safe[:, None])
         & resp[t_safe][:, None]
     )
     ack_del = (
         ping_del
         & _adj(net, t_safe[:, None], wit_safe)
-        & ~_drop(k_c, kshape, params.loss)
+        & ~_drop_net(k_c, kshape, params.loss, net, t_safe[:, None], wit_safe)
     )
     resp_del = (
         req_del
         & _adj(net, wit_safe, ids[:, None])
-        & ~_drop(k_d, kshape, params.loss)
+        & ~_drop_net(k_d, kshape, params.loss, net, wit_safe, ids[:, None])
     )
     any_success = jnp.any(ack_del & resp_del, axis=1)
     # all witnesses answered "target unreachable" and none succeeded ->
@@ -948,8 +1115,36 @@ def _phase5_pingreq(
         st, issue_tgt = _stage_issue(st, ntgt, maxpb8)
         nwit_ack = _slot_counts(wit_safe, ack_del)
 
+        fs_slots = None
+        relay_fs = jnp.int32(0)
+        if params.relay_full_sync:
+            # the relay's inner full sync (SwimParams.relay_full_sync):
+            # a target with nothing non-echo to issue to a witness but a
+            # diverged view hash answers that witness with its ENTIRE
+            # row — the exact phase-4 nothing-to-say rule, evaluated at
+            # the ack hop (post-5b views vs the witness's period-start
+            # hash, mirroring h_post vs the sender's h_pre)
+            h_mid = _view_hash(st)
+            rows0 = jnp.where(issue_tgt, st.view_key, 0)[t_safe]
+            fs_cols = []
+            for m in range(kk):
+                w_m = wit_safe[:, m]
+                echo0 = deliv_wit[w_m] & (rows0 == st.view_key[w_m])
+                has_claim = jnp.any(
+                    ack_del[:, m][:, None] & issue_tgt[t_safe] & ~echo0,
+                    axis=1,
+                )
+                fs_cols.append(
+                    ack_del[:, m]
+                    & ~has_claim
+                    & (h_mid[t_safe] != sel.h_pre[w_m])
+                )
+            fs_slots = jnp.stack(fs_cols, axis=1)  # bool[N, kk]
+            relay_fs = jnp.sum(fs_slots, dtype=jnp.int32)
+
         def in_c(st2):
             claims_tgt = jnp.where(issue_tgt, st2.view_key, 0)
+            full_rows = st2.view_key[t_safe]
             acc_in = jnp.zeros((n, n), jnp.int32)
             for m in range(kk):
                 w_m = wit_safe[:, m]
@@ -957,16 +1152,22 @@ def _phase5_pingreq(
                 # anti-echo: drop claims equal to what the witness itself
                 # delivered to this target in 5b
                 echo = deliv_wit[w_m] & (rows == st2.view_key[w_m])
-                slot_in, _ = _receiver_merge(
-                    w_m,
-                    ack_del[:, m],
-                    jnp.where(ack_del[:, m][:, None] & ~echo, rows, 0),
-                )
+                send = jnp.where(ack_del[:, m][:, None] & ~echo, rows, 0)
+                if fs_slots is not None:
+                    send = jnp.where(
+                        fs_slots[:, m][:, None] & (full_rows > 0),
+                        full_rows,
+                        send,
+                    )
+                slot_in, _ = _receiver_merge(w_m, ack_del[:, m], send)
                 acc_in = jnp.maximum(acc_in, slot_in)
             return acc_in
 
+        pred_c = jnp.any(issue_tgt)
+        if fs_slots is not None:
+            pred_c = pred_c | jnp.any(fs_slots)
         st, acc = _stage_merge(
-            st, acc, jnp.any(issue_tgt), in_c, nwit_ack > 0, "swim.pingreq_5c"
+            st, acc, pred_c, in_c, nwit_ack > 0, "swim.pingreq_5c"
         )
 
         # -- 5d: the witness response carries its (fresh) changes ---------
@@ -990,20 +1191,26 @@ def _phase5_pingreq(
         st, acc = _stage_merge(
             st, acc, jnp.any(issue_wit2), in_d, any_resp, "swim.pingreq_5d"
         )
-        return st, acc[0], acc[1]
+        return st, acc[0], acc[1], relay_fs
 
     def no_exchange(st: ClusterState):
         return (
             st,
             jnp.int32(0),
             jnp.zeros((n, n), dtype=bool) if damp_on else jnp.zeros((), dtype=bool),
+            jnp.int32(0),
         )
 
     # With zero active changes cluster-wide the whole exchange is a
     # proven no-op (no claims -> no merges -> no refutations) — the
     # converged-steady-state common case skips even the bookkeeping.
-    state, xch_applied, xch_flapped = jax.lax.cond(
-        jnp.any(req_del) & jnp.any(state.pb >= 0), exchange, no_exchange, state
+    # (Under relay_full_sync the no-claims shortcut is unsound: a
+    # diverged-but-quiet target must still answer full rows.)
+    xch_pred = jnp.any(req_del)
+    if not params.relay_full_sync:
+        xch_pred = xch_pred & jnp.any(state.pb >= 0)
+    state, xch_applied, xch_flapped, relay_fs_total = jax.lax.cond(
+        xch_pred, exchange, no_exchange, state
     )
 
     # the declaration sees the post-exchange view (the reference's
@@ -1018,6 +1225,7 @@ def _phase5_pingreq(
         was_alive_at_target,
         xch_applied,
         xch_flapped,
+        relay_fs_total,
     )
 
 
@@ -1187,11 +1395,57 @@ def swim_step_impl(
       6. suspicion countdowns fire -> faulty  (suspicion.js:66-69)
     """
     if params.sparse_cap:
+        if state.pending is not None:
+            raise NotImplementedError(
+                "sparse_cap does not compose with the latency model "
+                "(ClusterState.pending); run delay scenarios dense"
+            )
         return _swim_step_sparse(state, net, key, params)
     n = state.n
-    k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
+    has_delay = state.pending is not None
+    if has_delay:
+        # the two extra streams draw the per-message jitter; the split
+        # width is keyed on the BUFFER's presence (not rule activity),
+        # so every tick of a delayed run — host-loop or compiled scan —
+        # consumes keys identically (scenarios/faults.py HostPlan)
+        k_sel, k_loss1, k_loss2, k_loss3, k_j1, k_j2 = jax.random.split(key, 6)
+    else:
+        k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
     ids = jnp.arange(n, dtype=jnp.int32)
     sl_start = _validate_params(n, params)
+
+    # -- in-flight claims mature (latency model) ----------------------------
+    # Slot ``tick % D`` lands at the START of the tick, before the
+    # period-start views are derived: matured claims are "arrivals
+    # overnight" — they shape this tick's selection, hashes, and
+    # refutations exactly like claims merged last tick.
+    mat_applied = jnp.int32(0)
+    mat_flapped: jax.Array | None = None
+    if has_delay:
+        dd = state.pending.shape[0]
+        slot0 = state.tick % jnp.int32(dd)
+        mature = state.pending[slot0]
+        can_recv = net.up & net.responsive
+
+        def _arrive(st):
+            mrg = _merge_incoming(st, mature, can_recv, sl_start)
+            return mrg.state, jnp.sum(mrg.applied, dtype=jnp.int32), mrg.flapped
+
+        def _no_arrive(st):
+            return (
+                st,
+                jnp.int32(0),
+                jnp.zeros((n, n), dtype=bool)
+                if st.damp is not None
+                else jnp.zeros((), dtype=bool),
+            )
+
+        state, mat_applied, mat_flapped = jax.lax.cond(
+            jnp.any(mature > 0), _arrive, _no_arrive, state
+        )
+        # the slot is consumed either way (a suspended receiver's
+        # matured claims are lost, like any packet at a stopped process)
+        state = state._replace(pending=state.pending.at[slot0].set(0))
 
     # -- phases 0-1: derived views + probe/witness selection ----------------
     sel = _phase01_select(state, net, k_sel, params)
@@ -1216,15 +1470,43 @@ def swim_step_impl(
     fwd_ok = (
         sends
         & _adj(net, ids, t_safe)
-        & ~_drop(k_loss1, (n,), params.loss)
+        & ~_drop_net(k_loss1, (n,), params.loss, net, ids, t_safe)
         & resp[t_safe]
     )
     # delivered[s, j]: sender s issued-and-delivered a claim about j this
     # tick (the anti-echo reference — a pred, not a 4 GB key snapshot).
+    # A delayed claim still counts as delivered: it is in the network,
+    # and the value-form anti-echo only needs "the sender provably sent
+    # this exact value".
     delivered = issued_s & fwd_ok[:, None]
-    in_key, inbound = _receiver_merge(
-        t_safe, fwd_ok, jnp.where(delivered, state.view_key, 0)
-    )
+    if has_delay:
+        # Latency convention (docs/simulation.md): the ping/ack RTT
+        # completes in-tick regardless of delay — the simulation
+        # compresses probe round-trips into the probing tick, and
+        # latency models slow INFORMATION, not lost liveness — so
+        # ``inbound``/acks keep counting every delivered ping, while
+        # the claim payload of a delayed link detours through the
+        # in-flight buffer and merges d ticks later.
+        d3 = _message_delay(net, k_j1, ids, t_safe, (n,))
+        dly3 = fwd_ok & (d3 > 0)
+        imm3 = fwd_ok & ~dly3
+        in_key, _ = _receiver_merge(
+            t_safe, imm3, jnp.where(issued_s & imm3[:, None], state.view_key, 0)
+        )
+        inbound = _inbound_counts(t_safe, fwd_ok)
+        dd = state.pending.shape[0]
+        slot3 = jnp.where(dly3, (state.tick + d3) % jnp.int32(dd), jnp.int32(dd))
+        state = state._replace(
+            pending=state.pending.at[slot3, t_safe].max(
+                jnp.where(issued_s & dly3[:, None], state.view_key, 0),
+                mode="drop",
+            )
+        )
+    else:
+        dly3 = jnp.zeros((n,), dtype=bool)
+        in_key, inbound = _receiver_merge(
+            t_safe, fwd_ok, jnp.where(delivered, state.view_key, 0)
+        )
     got_ping = inbound > 0
 
     merged = _merge_incoming(state, in_key, got_ping, sl_start)
@@ -1265,11 +1547,34 @@ def swim_step_impl(
     full_sync = fwd_ok & ~jnp.any(rep_row, axis=1) & (h_post[t_safe] != h_pre)
     send_row = jnp.where(full_sync[:, None], reply_key > 0, rep_row)
 
-    ack = fwd_ok & _adj(net, t_safe, ids) & ~_drop(k_loss2, (n,), params.loss)
+    ack = (
+        fwd_ok
+        & _adj(net, t_safe, ids)
+        & ~_drop_net(k_loss2, (n,), params.loss, net, t_safe, ids)
+    )
 
     in2_key = jnp.where(send_row & ack[:, None], reply_key, 0)
-    merged2 = _merge_incoming(state, in2_key, ack, sl_start)
-    state = merged2.state
+    if has_delay:
+        # the reply claims ride the receiver->sender link: a delayed
+        # reply (full syncs included) detours through the buffer keyed
+        # by its sender row; the ack itself still lands in-tick
+        d4 = _message_delay(net, k_j2, t_safe, ids, (n,))
+        dly4 = ack & (d4 > 0)
+        imm4 = ack & ~dly4
+        merged2 = _merge_incoming(
+            state, jnp.where(imm4[:, None], in2_key, 0), imm4, sl_start
+        )
+        dd = state.pending.shape[0]
+        slot4 = jnp.where(dly4, (state.tick + d4) % jnp.int32(dd), jnp.int32(dd))
+        state = merged2.state._replace(
+            pending=merged2.state.pending.at[slot4, ids].max(
+                jnp.where(dly4[:, None], in2_key, 0), mode="drop"
+            )
+        )
+    else:
+        dly4 = jnp.zeros((n,), dtype=bool)
+        merged2 = _merge_incoming(state, in2_key, ack, sl_start)
+        state = merged2.state
     ack_applied = jnp.sum(merged2.applied, dtype=jnp.int32)
 
     # -- phase 5: ping-req for failed probes --------------------------------
@@ -1285,6 +1590,8 @@ def swim_step_impl(
     n_damped = jnp.int32(0)
     if state.damp is not None:
         flaps = merged.flapped | merged2.flapped | pr.flapped
+        if mat_flapped is not None:
+            flaps = flaps | mat_flapped
         # a viewer that itself declares alive->suspect flaps too (the host
         # library scores these via the membership 'updated' event)
         declare_flap = declared & was_alive_at_target
@@ -1313,7 +1620,13 @@ def swim_step_impl(
         "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
         "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
         "damped_pairs": n_damped,
+        "relay_full_syncs": pr.relay_full_syncs,
     }
+    if has_delay:
+        metrics["delayed_claims"] = jnp.sum(dly3, dtype=jnp.int32) + jnp.sum(
+            dly4, dtype=jnp.int32
+        )
+        metrics["matured_applied"] = mat_applied
     return state, metrics
 
 
@@ -1542,7 +1855,7 @@ def _swim_step_sparse(
     fwd_ok = (
         sends
         & _adj(net, ids, t_safe)
-        & ~_drop(k_loss1, (n,), params.loss)
+        & ~_drop_net(k_loss1, (n,), params.loss, net, ids, t_safe)
         & resp[t_safe]
     )
     subj = _compact_rows(issued_s, cap)  # int32[N, cap], -1 padded
@@ -1607,7 +1920,11 @@ def _swim_step_sparse(
     )
     rep_any = rep_count[t_safe] > jnp.sum(echo_issuable, axis=1, dtype=jnp.int32)
     full_sync = fwd_ok & ~rep_any & (h_post[t_safe] != h_pre)
-    ack = fwd_ok & _adj(net, t_safe, ids) & ~_drop(k_loss2, (n,), params.loss)
+    ack = (
+        fwd_ok
+        & _adj(net, t_safe, ids)
+        & ~_drop_net(k_loss2, (n,), params.loss, net, t_safe, ids)
+    )
 
     def dense_reply(st):
         reply_key = st.view_key[t_safe]
@@ -1653,6 +1970,7 @@ def _swim_step_sparse(
         "suspects_declared": jnp.sum(declare_suspect, dtype=jnp.int32),
         "faulty_declared": jnp.sum(expired, dtype=jnp.int32),
         "damped_pairs": jnp.int32(0),
+        "relay_full_syncs": pr.relay_full_syncs,
     }
     return state, metrics
 

@@ -16,6 +16,10 @@ Layers:
   the ``--script`` mini-DSL compiler into it.
 * ``compile`` — ``ScenarioSpec -> CompiledScenario`` event tensors +
   the segment-exact PRNG key schedule.
+* ``faults``  — the failure-model compiler: asymmetric per-link loss,
+  latency/jitter (an in-flight claim ring buffer), flap storms,
+  gray-failure per-node periods, and rolling restarts as compiled
+  scenario events, with the host plan the parity oracle applies.
 * ``runner``  — the single-dispatch jitted scan over both backends,
   plus the host-loop equivalent (the parity/benchmark baseline).
 * ``trace``   — the stacked telemetry, npz export, and the
@@ -36,6 +40,15 @@ Entry points: ``SimCluster.run_scenario(spec[, segment_ticks=S])``,
 
 from ringpop_tpu.scenarios.spec import Event, ScenarioSpec, script_to_spec
 from ringpop_tpu.scenarios.compile import CompiledScenario, compile_spec
+from ringpop_tpu.scenarios.faults import (
+    FaultTensors,
+    HostPlan,
+    LinkRule,
+    compile_faults,
+    delay_depth,
+    link_rules,
+    period_switches,
+)
 from ringpop_tpu.scenarios.trace import Trace
 from ringpop_tpu.scenarios.runner import run_compiled, run_host_loop
 from ringpop_tpu.scenarios.sweep import (
@@ -59,6 +72,13 @@ __all__ = [
     "script_to_spec",
     "CompiledScenario",
     "compile_spec",
+    "FaultTensors",
+    "HostPlan",
+    "LinkRule",
+    "compile_faults",
+    "delay_depth",
+    "link_rules",
+    "period_switches",
     "Trace",
     "run_compiled",
     "run_host_loop",

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.models.cluster import groups_to_gid
-from ringpop_tpu.scenarios.spec import ScenarioSpec
+from ringpop_tpu.scenarios import faults as sfaults
+from ringpop_tpu.scenarios.spec import ScenarioSpec, expand_fault_primitives
 
 # node-event kinds (ev_kind values)
 EV_KILL = 0
@@ -35,6 +36,15 @@ EV_RESUME = 2
 EV_REVIVE = 3
 _KIND = {"kill": EV_KILL, "suspend": EV_SUSPEND, "resume": EV_RESUME,
          "revive": EV_REVIVE}
+
+# Canonical intra-tick apply order, shared by the scan and the host
+# loop: bit edits first (order-free among themselves), then revives
+# (whose bootstrap join reads the post-edit live set), then partition
+# rows; loss/faultcfg touch neither substrate, so their rank only
+# needs to be deterministic.  The sort is stable, so same-kind ops
+# keep their expansion order (the revive-vs-revive order).
+_OP_RANK = {"kill": 0, "suspend": 1, "resume": 2, "revive": 3,
+            "partition": 4, "heal": 4, "loss": 5, "faultcfg": 6}
 
 
 class CompiledScenario(NamedTuple):
@@ -50,14 +60,23 @@ class CompiledScenario(NamedTuple):
     loss: jax.Array  # float32[ticks] per-tick loss in force
     has_revive: bool  # static: trace the in-scan revive path at all?
     boundaries: tuple[int, ...]  # distinct event ticks in (0, ticks)
+    # failure-model extension (scenarios/faults.py); None = the spec
+    # has no link/gray/delay events and the program is the legacy one
+    faults: Any | None = None  # faults.FaultTensors | None
+    has_delay: bool = False  # static: route through the in-flight buffer?
+    has_gray: bool = False  # static: carry the per-node period row?
+    delay_depth: int = 0  # static ring-buffer depth (0 = no delay)
 
 
 def expand_events(
     spec: ScenarioSpec, base_loss: float
 ) -> list[tuple[int, str, Any]]:
     """The spec as concrete per-tick ops, ramps unrolled to stepwise
-    ``loss`` ops — the single source of truth shared by the tensor
-    compiler and the host-loop equivalent (``runner.run_host_loop``)."""
+    ``loss`` ops, flap/rolling-restart cycles unrolled to kill/revive
+    primitives, and a ``faultcfg`` marker at every tick the link-rule /
+    period configuration changes — the single source of truth shared by
+    the tensor compiler and the host-loop equivalent
+    (``runner.run_host_loop``)."""
     out: list[tuple[int, str, Any]] = []
     loss = float(base_loss)
     for e in sorted(spec.events, key=lambda e: e.at):
@@ -73,8 +92,18 @@ def expand_events(
             out.append((e.at, "partition", e.groups))
         elif e.op == "heal":
             out.append((e.at, "heal", None))
+        elif e.op in ("flap", "rolling_restart"):
+            out.extend(
+                (pe.at, pe.op, pe.node)
+                for pe in expand_fault_primitives(e, spec.ticks)
+            )
+        elif e.op in ("link_loss", "delay", "gray"):
+            pass  # lowered below via the marker ticks (faults.py)
         else:
             out.append((e.at, e.op, e.node))
+    out.extend(
+        (t, "faultcfg", None) for t in sfaults.fault_marker_ticks(spec)
+    )
     return out
 
 
@@ -90,10 +119,11 @@ def compile_spec(
     loss_tl = np.full(spec.ticks, float(base_loss), dtype=np.float32)
     # tick order, NOT event order: a ramp's unrolled ops interleave
     # with later loss events, and each loss write covers [at:] — the
-    # host loop applies them per tick, so the timeline must too
-    # (stable, so same-tick ops keep their expand order, like the
-    # host loop's sequential set_loss calls)
-    for at, op, arg in sorted(ops, key=lambda x: x[0]):
+    # host loop applies them per tick, so the timeline must too.
+    # Within a tick, the canonical _OP_RANK order (stable, so same-kind
+    # ops keep their expand order, like the host loop's sequential
+    # set_loss calls / revive order).
+    for at, op, arg in sorted(ops, key=lambda x: (x[0], _OP_RANK[x[1]])):
         if op == "loss":
             loss_tl[at:] = arg
         elif op == "partition":
@@ -102,11 +132,14 @@ def compile_spec(
         elif op == "heal":
             p_tick.append(at)
             p_gid.append(np.zeros(n, dtype=np.int32))
+        elif op == "faultcfg":
+            pass  # boundary marker only; tensors come from compile_faults
         else:
             ev_tick.append(at)
             ev_kind.append(_KIND[op])
             ev_node.append(arg)
     boundaries = tuple(sorted({at for at, _, _ in ops if 0 < at < spec.ticks}))
+    ft = sfaults.compile_faults(spec, n)
     return CompiledScenario(
         ticks=spec.ticks,
         n=n,
@@ -120,6 +153,10 @@ def compile_spec(
         loss=jnp.asarray(loss_tl),
         has_revive=any(k == EV_REVIVE for k in ev_kind),
         boundaries=boundaries,
+        faults=ft,
+        has_delay=ft is not None and ft.lr_d is not None,
+        has_gray=ft is not None and bool(ft.pe_tick.shape[0]),
+        delay_depth=sfaults.delay_depth(spec),
     )
 
 

@@ -1,0 +1,264 @@
+"""Failure-model compiler: asymmetric links, latency, gray periods.
+
+The scenario engine's first-generation events (kill / revive / suspend /
+partition / loss) are all SYMMETRIC: the network drops every message
+with one scalar probability and a partition severs both directions.
+Real SWIM incidents are not — one-way link loss (A hears B, B never
+hears A), per-link latency and jitter, and lagging-but-alive processes
+are exactly the failure modes the reference stack dies from in
+production.  This module lowers those families into device tensors the
+compiled scenario scan evaluates per tick, plus the host-side plan the
+parity oracle (``runner.run_host_loop``) applies at segment boundaries.
+
+Three representations, all O(N) or O(K * N) — never an [N, N] matrix:
+
+* **Link rules** (``link_loss`` / ``delay`` events): K directed block
+  rules, each ``(src bool[N], dst bool[N], p, delay, jitter)`` active
+  during ``[start, end)``.  A message from s to r is governed by every
+  active rule with ``src[s] & dst[r]``: drop probabilities compose as
+  ``1 - prod(1 - p_k)`` and delays take the per-pair maximum.  The
+  scan evaluates activity from the traced tick (``start <= t < end``),
+  so rules cost no carry and stream (tick0-offset segments) for free.
+* **Period rows** (``gray`` events): an int32[N] per-node protocol
+  period, switched at event boundaries exactly like partition gid rows
+  (``pe_tick``/``pe_row``) and carried through the scan.  A gray node
+  answers pings and witness duties every tick but initiates its own
+  probes once per ``factor`` ticks — the per-node generalization of
+  ``SwimParams.phase_mod`` (and its delta-backend port: a constant row
+  of P reproduces phase_mod bit for bit on both backends).
+* **Delay depth**: the static ring-buffer length ``max(delay + jitter)
+  + 1`` for the in-flight claim buffer (``ClusterState.pending``,
+  models/swim_sim.py) that carries delayed messages across ticks.
+
+``flap``/``rolling_restart`` need nothing here: they expand to the
+existing kill/revive primitives in ``spec.expand_fault_primitives``
+(shared by the tensor compiler and the host loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.scenarios.spec import Event, ScenarioSpec
+
+
+class LinkRule(NamedTuple):
+    """One directed block rule (host form; windows in spec ticks)."""
+
+    start: int
+    end: int
+    src: tuple[int, ...]
+    dst: tuple[int, ...]
+    p: float  # extra drop probability on the link
+    delay: int  # base latency in ticks
+    jitter: int  # uniform extra latency in {0..jitter}
+
+
+class FaultTensors(NamedTuple):
+    """Device tensors for the scan (shapes static per compile).
+
+    ``lr_d``/``lr_j`` are None when the spec has no delay rules — their
+    presence is the static fact that routes the step through the
+    in-flight buffer (and widens the per-tick key split), so a
+    loss-only scenario compiles the exact non-delay program.
+    """
+
+    lr_src: jax.Array  # bool[K, N]
+    lr_dst: jax.Array  # bool[K, N]
+    lr_p: jax.Array  # float32[K]
+    lr_start: jax.Array  # int32[K]
+    lr_end: jax.Array  # int32[K]
+    lr_d: jax.Array | None  # int32[K] | None (no delay rules)
+    lr_j: jax.Array | None  # int32[K] | None
+    pe_tick: jax.Array  # int32[G] period-switch ticks
+    pe_row: jax.Array  # int32[G, N] per-node period rows
+
+
+def link_rules(spec: ScenarioSpec) -> list[LinkRule]:
+    """The spec's link_loss/delay events as rules, in (at, spec-order)
+    — the deterministic order both the compiler and the host plan use
+    (rule order matters only for float reproducibility of the composed
+    drop product, so it must simply be THE SAME everywhere)."""
+    rules = []
+    for e in sorted(
+        (e for e in spec.events if e.op in ("link_loss", "delay")),
+        key=lambda e: e.at,
+    ):
+        until = e.until if e.until is not None else spec.ticks
+        rules.append(
+            LinkRule(
+                start=e.at,
+                end=until,
+                src=tuple(e.src),
+                dst=tuple(e.dst),
+                p=float(e.p) if e.p is not None else 0.0,
+                delay=int(e.delay or 0) if e.op == "delay" else 0,
+                jitter=int(e.jitter or 0) if e.op == "delay" else 0,
+            )
+        )
+    return rules
+
+
+def delay_depth(spec: ScenarioSpec) -> int:
+    """Static ring-buffer depth for the in-flight claim buffer: the
+    largest possible per-message latency plus one (slot ``t % D`` is
+    maturing while ``t + d`` lands ahead of it), or 0 without delay.
+
+    Overlapping rules combine as ``max_k(delay) + U{0..max_k(jitter)}``
+    (``swim_sim._link_delay_bounds`` takes the maxima SEPARATELY), so
+    the bound must too — a per-rule ``max(d + j)`` would under-size the
+    buffer when one rule contributes the base and another the jitter,
+    wrapping the ring and delivering early."""
+    rules = [r for r in link_rules(spec) if r.delay + r.jitter]
+    if not rules:
+        return 0
+    return max(r.delay for r in rules) + max(r.jitter for r in rules) + 1
+
+
+def period_switches(spec: ScenarioSpec, n: int) -> list[tuple[int, np.ndarray]]:
+    """``(tick, int32[N] period row)`` at every tick the per-node
+    period vector changes, in tick order (gray windows set the factor
+    at ``at`` and restore 1 at ``until``; validate rejects overlapping
+    windows per node, so the fold is order-free)."""
+    edits: list[tuple[int, tuple[int, ...], int]] = []
+    for e in spec.events:
+        if e.op != "gray":
+            continue
+        until = e.until if e.until is not None else spec.ticks
+        edits.append((e.at, e.target_nodes(), int(e.factor)))
+        if until < spec.ticks:
+            edits.append((until, e.target_nodes(), 1))
+    if not edits:
+        return []
+    period = np.ones(n, dtype=np.int32)
+    out = []
+    # same-tick restores apply BEFORE sets: adjacent windows on one
+    # node ([10, 20) factor 4, then [20, 30) factor 6) share tick 20 as
+    # one window's end and the next's start — the new factor must win
+    # regardless of the order the spec lists the events (everywhere
+    # else in the engine, event-list order is immaterial)
+    edits.sort(key=lambda e: e[2] != 1)
+    for tick in sorted({t for t, _, _ in edits}):
+        for t, nodes, val in edits:
+            if t == tick:
+                period[list(nodes)] = val
+        out.append((tick, period.copy()))
+    return out
+
+
+def fault_marker_ticks(spec: ScenarioSpec) -> list[int]:
+    """Every tick at which the network/timing configuration changes —
+    link-rule window edges and period switches.  These become key-
+    schedule segment boundaries (``compile.expand_events`` emits a
+    ``faultcfg`` op per tick) so the host loop can re-apply the
+    configuration between ``tick()`` segments."""
+    ticks: set[int] = set()
+    for r in link_rules(spec):
+        ticks.add(r.start)
+        if r.end < spec.ticks:
+            ticks.add(r.end)
+    for e in spec.events:
+        if e.op == "gray":
+            ticks.add(e.at)
+            until = e.until if e.until is not None else spec.ticks
+            if until < spec.ticks:
+                ticks.add(until)
+    return sorted(t for t in ticks if 0 <= t < spec.ticks)
+
+
+def rules_arrays(
+    rules: list[LinkRule], n: int, at: int | None = None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The rule table as ``(src[K, N], dst[K, N], p[K], d[K], j[K])``
+    numpy arrays.  ``at`` masks p/d/j of rules inactive at that tick to
+    zero — the host-loop form: the step then computes byte-identical
+    drop products to the scan's in-program activity mask (inactive
+    rules contribute an exact 1.0 factor either way)."""
+    k = len(rules)
+    src = np.zeros((k, n), dtype=bool)
+    dst = np.zeros((k, n), dtype=bool)
+    p = np.zeros(k, dtype=np.float32)
+    d = np.zeros(k, dtype=np.int32)
+    j = np.zeros(k, dtype=np.int32)
+    for i, r in enumerate(rules):
+        src[i, list(r.src)] = True
+        dst[i, list(r.dst)] = True
+        active = at is None or r.start <= at < r.end
+        if active:
+            p[i] = r.p
+            d[i] = r.delay
+            j[i] = r.jitter
+    return src, dst, p, d, j
+
+
+def compile_faults(spec: ScenarioSpec, n: int) -> FaultTensors | None:
+    """Lower the spec's failure-model events to device tensors, or
+    None when the spec has none (the compiled program is then exactly
+    the pre-failure-model one)."""
+    rules = link_rules(spec)
+    switches = period_switches(spec, n)
+    if not rules and not switches:
+        return None
+    src, dst, p, d, j = rules_arrays(rules, n)
+    has_delay = bool((d + j).any())
+    return FaultTensors(
+        lr_src=jnp.asarray(src),
+        lr_dst=jnp.asarray(dst),
+        lr_p=jnp.asarray(p),
+        lr_start=jnp.asarray(
+            np.array([r.start for r in rules], dtype=np.int32)
+        ),
+        lr_end=jnp.asarray(np.array([r.end for r in rules], dtype=np.int32)),
+        lr_d=jnp.asarray(d) if has_delay else None,
+        lr_j=jnp.asarray(j) if has_delay else None,
+        pe_tick=jnp.asarray(
+            np.array([t for t, _ in switches], dtype=np.int32)
+        ),
+        pe_row=jnp.asarray(
+            np.stack([row for _, row in switches])
+            if switches
+            else np.zeros((0, n), np.int32)
+        ),
+    )
+
+
+class HostPlan:
+    """The host-loop side of the failure model: what ``run_host_loop``
+    applies at each ``faultcfg`` boundary so that ``cluster.tick()``
+    steps see the same per-tick network/timing configuration the
+    compiled scan computes in-program."""
+
+    def __init__(self, spec: ScenarioSpec, n: int):
+        self.spec = spec
+        self.n = n
+        self.rules = link_rules(spec)
+        self.switches = period_switches(spec, n)
+        self.delay_depth = delay_depth(spec)
+        self.has_delay = self.delay_depth > 0
+
+    def prepare(self, cluster: Any) -> None:
+        """Pre-run setup: install the in-flight buffer when the spec
+        delays messages (it must exist from tick 0 on BOTH sides — its
+        presence widens the per-tick key split)."""
+        if self.has_delay:
+            cluster.enable_delay(self.delay_depth)
+
+    def apply(self, cluster: Any, at: int) -> None:
+        """Install the configuration in force at spec tick ``at``."""
+        if self.rules:
+            src, dst, p, d, j = rules_arrays(self.rules, self.n, at=at)
+            cluster.set_link_rules(
+                src, dst, p,
+                d=d if self.has_delay else None,
+                j=j if self.has_delay else None,
+            )
+        if self.switches:
+            row = np.ones(self.n, dtype=np.int32)
+            for t, r in self.switches:
+                if t <= at:
+                    row = r
+            cluster.set_period(row)

@@ -72,7 +72,14 @@ def _normalize_adj(net: NetState, n: int) -> jax.Array:
     )
 
 
-def precheck(state: Any, net: NetState, compiled: CompiledScenario) -> jax.Array:
+def precheck(
+    state: Any,
+    net: NetState,
+    compiled: CompiledScenario,
+    params: Any | None = None,
+    *,
+    standing_ok: bool = False,
+) -> jax.Array:
     """Every static rejection of ``run_compiled``, callable before any
     PRNG key is drawn — a failed run must not advance the cluster key
     (``SimCluster.run_scenario`` builds the key schedule only after
@@ -87,6 +94,68 @@ def precheck(state: Any, net: NetState, compiled: CompiledScenario) -> jax.Array
             "revive/join are host-side row ops); use run_host_loop or "
             "backend='dense'"
         )
+    if compiled.has_delay:
+        if isinstance(state, DeltaState):
+            raise NotImplementedError(
+                "per-link delay is dense-backend-only (the in-flight "
+                "claim buffer is an [D, N, N] dense tensor); use "
+                "run_host_loop on the dense backend or drop the delay "
+                "events"
+            )
+        sw = getattr(params, "swim", params)
+        if sw is not None and getattr(sw, "sparse_cap", 0):
+            raise NotImplementedError(
+                "per-link delay does not compose with sparse_cap"
+            )
+        if (
+            state.pending is not None
+            and state.pending.shape[0] != compiled.delay_depth
+        ):
+            raise ValueError(
+                f"the cluster carries an in-flight buffer of depth "
+                f"{state.pending.shape[0]} but this scenario needs "
+                f"{compiled.delay_depth}; drain it (tick past the old "
+                "horizon) or start from a fresh cluster"
+            )
+    if compiled.has_gray:
+        sw = getattr(params, "swim", params)
+        if sw is not None and getattr(sw, "phase_mod", 1) > 1:
+            raise ValueError(
+                "gray events (per-node periods) do not compose with the "
+                "static phase_mod stagger: a period row of P subsumes it"
+            )
+    if not standing_ok:
+        # The compiled scan derives its per-tick network configuration
+        # from the SPEC alone: operator-installed standing config that
+        # the spec does not model would be silently ignored in-scan
+        # (while the host-loop oracle keeps applying it) — reject the
+        # ambiguity instead of diverging.  ``standing_ok=True`` is the
+        # resume path's opt-out: a resumed run's net carries this very
+        # spec's own mirrored rules / mid-window period row.
+        if net.link_src is not None:
+            active = np.asarray(net.link_p).any() or (
+                net.link_d is not None
+                and (np.asarray(net.link_d).any() or np.asarray(net.link_j).any())
+            )
+            if bool(active):
+                raise ValueError(
+                    "the cluster carries active standing link rules "
+                    "(set_link_rules): a compiled scenario applies only "
+                    "spec-declared link_loss/delay events — "
+                    "clear_link_rules() first, or express the rules as "
+                    "spec events (run_host_loop drives standing rules)"
+                )
+        if (
+            compiled.has_gray
+            and net.period is not None
+            and bool((np.asarray(net.period) != 1).any())
+        ):
+            raise ValueError(
+                "gray events rebuild the period plane from lockstep, "
+                "which would clobber the standing set_period row mid-run "
+                "— set_period(None) first, or encode the standing row "
+                "as gray events"
+            )
     return _normalize_adj(net, compiled.n)
 
 
@@ -130,6 +199,7 @@ def _scenario_scan_impl(
     up,
     responsive,
     adj,
+    period,
     ev_tick,
     ev_kind,
     ev_node,
@@ -139,6 +209,7 @@ def _scenario_scan_impl(
     keys,
     tr_tensors=None,
     tick0=None,
+    faults=None,
     *,
     params,
     has_revive: bool,
@@ -157,7 +228,7 @@ def _scenario_scan_impl(
     oob = jnp.int32(n)  # masked events scatter out of bounds -> dropped
 
     def body(carry, xs):
-        st, u, r, gid = carry
+        st, u, r, gid, per = carry
         t, key, loss_t = xs
         if ev_tick.shape[0]:
             m = ev_tick == t
@@ -175,7 +246,27 @@ def _scenario_scan_impl(
         if p_tick.shape[0]:
             pm = p_tick == t
             gid = jnp.where(jnp.any(pm), p_gid[jnp.argmax(pm)], gid)
-        net = NetState(up=u, responsive=r, adj=gid)
+        # failure-model events (scenarios/faults.py): period-row
+        # switches ride the carry like partitions; link rules need no
+        # carry at all — each rule's [start, end) window is evaluated
+        # against the (tick0-offset) tick, so the same program streams
+        if faults is not None and faults.pe_tick.shape[0]:
+            gm = faults.pe_tick == t
+            per = jnp.where(jnp.any(gm), faults.pe_row[jnp.argmax(gm)], per)
+        link_kw = {}
+        if faults is not None and faults.lr_p.shape[0]:
+            active = (t >= faults.lr_start) & (t < faults.lr_end)
+            link_kw = dict(
+                link_src=faults.lr_src,
+                link_dst=faults.lr_dst,
+                link_p=jnp.where(active, faults.lr_p, jnp.float32(0)),
+            )
+            if faults.lr_d is not None:
+                link_kw.update(
+                    link_d=jnp.where(active, faults.lr_d, 0),
+                    link_j=jnp.where(active, faults.lr_j, 0),
+                )
+        net = NetState(up=u, responsive=r, adj=gid, period=per, **link_kw)
         if is_delta:
             sp = params._replace(swim=params.swim._replace(loss=loss_t))
             st, metrics = sdelta.delta_step_impl(st, net, key, sp)
@@ -210,16 +301,16 @@ def _scenario_scan_impl(
                     damped=getattr(st, "damped", None),
                 )
             )
-        return (st, u, r, gid), y
+        return (st, u, r, gid, per), y
 
     t_idx = jnp.arange(ticks, dtype=jnp.int32)
     if tick0 is not None:
         t_idx = t_idx + tick0
     xs = (t_idx, keys, loss)
-    (state, up, responsive, adj), ys = jax.lax.scan(
-        body, (state, up, responsive, adj), xs
+    (state, up, responsive, adj, period), ys = jax.lax.scan(
+        body, (state, up, responsive, adj, period), xs
     )
-    return state, up, responsive, adj, ys
+    return state, up, responsive, adj, period, ys
 
 
 _scenario_scan = jax.jit(
@@ -261,7 +352,8 @@ def run_compiled(
             f"key schedule has {keys.shape[0]} rows for {compiled.ticks} ticks"
         )
     if adj is None:
-        adj = precheck(state, net, compiled)
+        adj = precheck(state, net, compiled, params)
+    state, period = prepare_faults(state, net, compiled)
     _dispatches += 1
     meta = {
         "backend": "delta" if isinstance(state, DeltaState) else "dense",
@@ -274,13 +366,14 @@ def run_compiled(
     # ledger-off (the default): dispatch() is a plain call-through; on,
     # the dispatch is recorded with its compile/execute split and AOT
     # memory footprint (obs/ledger.py)
-    state, up, resp, adj, ys = default_ledger().dispatch(
+    state, up, resp, adj, period, ys = default_ledger().dispatch(
         "run_scenario",
         _scenario_scan,
         state,
         net.up,
         net.responsive,
         adj,
+        period,
         compiled.ev_tick,
         compiled.ev_kind,
         compiled.ev_node,
@@ -289,12 +382,65 @@ def run_compiled(
         compiled.loss,
         keys,
         traffic.tensors if traffic is not None else None,
+        None,
+        compiled.faults,
         params=params,
         has_revive=compiled.has_revive,
         traffic=traffic.static if traffic is not None else None,
         _meta=meta,
     )
-    return state, NetState(up=up, responsive=resp, adj=adj), ys
+    return state, final_net(up, resp, adj, period, compiled), ys
+
+
+def prepare_faults(
+    state: Any, net: NetState, compiled: CompiledScenario
+) -> tuple[Any, jax.Array | None]:
+    """Pre-scan failure-model setup shared by the one-dispatch runner,
+    the sweep, and the streamed runner: install the in-flight claim
+    buffer when the spec delays messages (from tick 0 — its presence
+    widens the step's key split, mirroring ``HostPlan.prepare``), and
+    produce the initial per-node period carry row (the cluster's
+    standing row, or all-ones when the scenario introduces gray
+    periods to a lockstep cluster)."""
+    if compiled.has_delay and state.pending is None:
+        state = state._replace(
+            pending=jnp.zeros(
+                (compiled.delay_depth, compiled.n, compiled.n), jnp.int32
+            )
+        )
+    period = net.period
+    if compiled.has_gray and period is None:
+        period = jnp.ones((compiled.n,), jnp.int32)
+    return state, period
+
+
+def final_net(
+    up: jax.Array,
+    resp: jax.Array,
+    adj: jax.Array,
+    period: jax.Array | None,
+    compiled: CompiledScenario,
+) -> NetState:
+    """The post-run NetState, link rules mirrored to their state at the
+    final tick — exactly what the host loop's last ``faultcfg`` apply
+    leaves in force, so the parity contract covers the net too and
+    follow-on ``tick()`` calls keep the end-of-scenario configuration."""
+    kw = {}
+    ft = compiled.faults
+    if ft is not None and ft.lr_p.shape[0]:
+        last = jnp.int32(compiled.ticks - 1)
+        active = (last >= ft.lr_start) & (last < ft.lr_end)
+        kw = dict(
+            link_src=ft.lr_src,
+            link_dst=ft.lr_dst,
+            link_p=jnp.where(active, ft.lr_p, jnp.float32(0)),
+        )
+        if ft.lr_d is not None:
+            kw.update(
+                link_d=jnp.where(active, ft.lr_d, 0),
+                link_j=jnp.where(active, ft.lr_j, 0),
+            )
+    return NetState(up=up, responsive=resp, adj=adj, period=period, **kw)
 
 
 def run_host_loop(cluster, spec: ScenarioSpec):
@@ -303,16 +449,30 @@ def run_host_loop(cluster, spec: ScenarioSpec):
     segment to the next boundary.  Consumes the cluster key exactly as
     ``compile.key_schedule`` does, so from equal starting state and
     key the trajectory is bit-identical to ``run_compiled`` — the
-    parity oracle (tests/test_scenario.py) and the many-dispatch arm
-    of ``benchmarks/bench_scenario.py``."""
+    parity oracle (tests/test_scenario.py, test_faults.py) and the
+    many-dispatch arm of ``benchmarks/bench_scenario.py``.
+
+    Intra-tick events apply in the canonical order the scan uses
+    (``compile._OP_RANK``): node bit edits, then revives (whose
+    bootstrap join reads the post-edit live set, in expansion order),
+    then partitions/loss/fault configuration."""
+    from ringpop_tpu.scenarios import compile as scompile
+    from ringpop_tpu.scenarios import faults as sfaults
+
     spec.validate(cluster.n)
+    plan = sfaults.HostPlan(spec, cluster.n)
+    plan.prepare(cluster)
     by_tick: dict[int, list[tuple[str, Any]]] = defaultdict(list)
     for at, op, arg in expand_events(spec, cluster.params.loss):
         by_tick[at].append((op, arg))
     boundaries = sorted(t for t in by_tick if 0 < t < spec.ticks)
     pts = [0, *boundaries, spec.ticks]
     for a, b in zip(pts, pts[1:]):
-        for op, arg in by_tick.get(a, ()):
+        ops = sorted(
+            by_tick.get(a, ()), key=lambda x: scompile._OP_RANK[x[0]]
+        )
+        cfg_done = False
+        for op, arg in ops:
             if op == "kill":
                 cluster.kill(arg)
             elif op == "suspend":
@@ -327,5 +487,8 @@ def run_host_loop(cluster, spec: ScenarioSpec):
                 cluster.heal_partition()
             elif op == "loss":
                 cluster.set_loss(arg)
+            elif op == "faultcfg" and not cfg_done:
+                plan.apply(cluster, a)
+                cfg_done = True
         cluster.tick(b - a)
     return cluster

@@ -36,6 +36,40 @@ Ops:
 * ``loss_ramp`` — stepwise-linear ramp from the loss in force at
   ``at`` to ``to``, reaching ``to`` at tick ``until - 1`` (compiled
   into one per-tick ``loss`` step per tick of the ramp).
+
+Failure-model ops (the asymmetric-incident families; scenarios/faults.py
+compiles them, docs/simulation.md documents the host conventions):
+
+* ``link_loss`` — DIRECTED extra drop probability ``p`` on every link
+  from a ``src`` node set to a ``dst`` node set during ``[at, until)``
+  (``until`` defaults to the end of the run): ``{"op": "link_loss",
+  "at": 10, "src": [0,1], "dst": [4,5], "p": 0.9}`` makes dst hear src
+  only 10% of the time while src still hears dst perfectly — the
+  one-way-loss incident a symmetric ``loss`` cannot express.
+* ``delay`` — per-link message latency: claims sent over src->dst
+  links land ``delay + U{0..jitter}`` ticks later (0 = immediate)
+  during ``[at, until)``; the ping/ack RTT itself still completes
+  in-tick (the simulation's time-compression convention — latency
+  slows information, not liveness).  Dense backend only.
+* ``flap`` — kill/revive duty cycles: each node in ``nodes`` (offset
+  ``stagger`` ticks apart) is killed for ``down`` ticks then up for
+  ``up`` ticks, cycling while the kill tick is < ``until``; every kill
+  emits its matching revive, so the storm always heals itself.
+* ``gray`` — slow-process failure: the node's protocol period becomes
+  ``factor`` ticks during ``[at, until)`` — it still answers pings and
+  witness duties every tick (stays alive in others' views) but
+  initiates its own probes only every ``factor``-th tick.
+* ``rolling_restart`` — a staggered deploy wave: node k of ``nodes``
+  is killed at ``at + k * every`` and revived (fresh incarnation,
+  bootstrap re-join) ``down`` ticks later.
+
+``flap``/``rolling_restart`` expand to the kill/revive primitives at
+compile time (one shared expansion, so the compiled scan and the host
+loop see identical timelines).  Same-tick mixes of revives and other
+node events apply in a canonical order — kill/suspend/resume bit edits
+first, then revives in (tick, node-expansion) order, then partitions —
+on both the scan and the host loop; only two events on the same
+(tick, node) remain rejected as ambiguous.
 """
 
 from __future__ import annotations
@@ -44,7 +78,11 @@ import json
 from typing import Any, NamedTuple
 
 _NODE_OPS = ("kill", "revive", "suspend", "resume")
-_OPS = _NODE_OPS + ("partition", "heal", "loss", "loss_ramp")
+_FAULT_OPS = ("link_loss", "delay", "flap", "gray", "rolling_restart")
+_OPS = _NODE_OPS + ("partition", "heal", "loss", "loss_ramp") + _FAULT_OPS
+
+# ops that take a p value under the JSON key "p" (loss_ramp uses "to")
+_P_OPS = ("loss", "link_loss", "delay")
 
 
 class Event(NamedTuple):
@@ -53,7 +91,18 @@ class Event(NamedTuple):
     node: int | None = None
     groups: tuple[tuple[int, ...], ...] | None = None
     p: float | None = None
-    until: int | None = None  # loss_ramp end tick (exclusive)
+    until: int | None = None  # window end tick (exclusive)
+    # failure-model fields (None unless the op uses them)
+    nodes: tuple[int, ...] | None = None  # flap/gray/rolling targets
+    src: tuple[int, ...] | None = None  # link rule: sender set
+    dst: tuple[int, ...] | None = None  # link rule: receiver set
+    down: int | None = None  # flap/rolling: ticks spent dead
+    up: int | None = None  # flap: ticks spent alive per cycle
+    every: int | None = None  # rolling: ticks between node starts
+    stagger: int | None = None  # flap: per-node cycle offset
+    factor: int | None = None  # gray: protocol-period multiplier
+    delay: int | None = None  # delay: base latency ticks
+    jitter: int | None = None  # delay: uniform extra latency bound
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {"at": self.at, "op": self.op}
@@ -62,9 +111,18 @@ class Event(NamedTuple):
         if self.groups is not None:
             d["groups"] = [list(g) for g in self.groups]
         if self.p is not None:
-            d["p" if self.op == "loss" else "to"] = self.p
+            d["p" if self.op in _P_OPS else "to"] = self.p
         if self.until is not None:
             d["until"] = self.until
+        for name in ("nodes", "src", "dst"):
+            v = getattr(self, name)
+            if v is not None:
+                d[name] = list(v)
+        for name in ("down", "up", "every", "stagger", "factor",
+                     "delay", "jitter"):
+            v = getattr(self, name)
+            if v is not None:
+                d[name] = v
         return d
 
     @classmethod
@@ -73,6 +131,12 @@ class Event(NamedTuple):
         if op not in _OPS:
             raise ValueError(f"unknown scenario op {op!r} (one of {_OPS})")
         groups = d.get("groups")
+
+        def _ints(name):
+            return (
+                tuple(int(m) for m in d[name]) if name in d else None
+            )
+
         return cls(
             at=int(d["at"]),
             op=op,
@@ -84,7 +148,49 @@ class Event(NamedTuple):
                 float(d["to"]) if "to" in d else None
             ),
             until=int(d["until"]) if "until" in d else None,
+            nodes=_ints("nodes"),
+            src=_ints("src"),
+            dst=_ints("dst"),
+            down=int(d["down"]) if "down" in d else None,
+            up=int(d["up"]) if "up" in d else None,
+            every=int(d["every"]) if "every" in d else None,
+            stagger=int(d["stagger"]) if "stagger" in d else None,
+            factor=int(d["factor"]) if "factor" in d else None,
+            delay=int(d["delay"]) if "delay" in d else None,
+            jitter=int(d["jitter"]) if "jitter" in d else None,
         )
+
+    def target_nodes(self) -> tuple[int, ...]:
+        """The node set of a flap/gray/rolling event (``nodes`` or the
+        singular ``node``)."""
+        if self.nodes is not None:
+            return self.nodes
+        if self.node is not None:
+            return (self.node,)
+        return ()
+
+
+def expand_fault_primitives(e: Event, ticks: int) -> list[Event]:
+    """``flap``/``rolling_restart`` as their primitive kill/revive
+    events — the ONE expansion shared by the event-tensor compiler and
+    the host-loop oracle (``compile.expand_events``), so both sides see
+    identical timelines by construction.  Emission order (per node, per
+    cycle) is deterministic; it is the intra-tick revive order."""
+    out: list[Event] = []
+    if e.op == "flap":
+        cycle = e.down + e.up
+        for idx, node in enumerate(e.target_nodes()):
+            t = e.at + idx * (e.stagger or 0)
+            while t < e.until:
+                out.append(Event(at=t, op="kill", node=node))
+                out.append(Event(at=t + e.down, op="revive", node=node))
+                t += cycle
+    elif e.op == "rolling_restart":
+        for k, node in enumerate(e.target_nodes()):
+            t = e.at + k * e.every
+            out.append(Event(at=t, op="kill", node=node))
+            out.append(Event(at=t + e.down, op="revive", node=node))
+    return out
 
 
 class ScenarioSpec(NamedTuple):
@@ -123,8 +229,38 @@ class ScenarioSpec(NamedTuple):
             raise ValueError(f"ticks must be >= 1 (got {self.ticks})")
         seen_node_tick: set[tuple[int, int]] = set()
         seen_part_tick: set[int] = set()
-        node_event_ticks: set[int] = set()
-        revive_ticks: set[int] = set()
+
+        def claim_node_tick(at: int, node: int, op: str) -> None:
+            # two events touching one (tick, node) are genuinely
+            # ambiguous (kill+revive of the same node, say); same-tick
+            # events on DIFFERENT nodes apply in the canonical order
+            # shared by the scan and the host loop (module docstring)
+            if (at, node) in seen_node_tick:
+                raise ValueError(
+                    f"conflicting node events at tick {at} on node "
+                    f"{node} ({op}): apply order inside one tick on one "
+                    "node is undefined"
+                )
+            seen_node_tick.add((at, node))
+
+        def check_window(e: Event, what: str) -> int:
+            until = e.until if e.until is not None else self.ticks
+            if not e.at < until <= self.ticks:
+                raise ValueError(
+                    f"{what} needs at < until <= ticks "
+                    f"(got at={e.at}, until={until}, ticks={self.ticks})"
+                )
+            return until
+
+        def check_nodes(e: Event, what: str) -> tuple[int, ...]:
+            targets = e.target_nodes()
+            if not targets or not all(0 <= m < n for m in targets):
+                raise ValueError(
+                    f"{what} needs nodes in [0, {n}) (got {targets})"
+                )
+            return targets
+
+        gray_windows: dict[int, list[tuple[int, int]]] = {}
         for e in self.events:
             if not 0 <= e.at < self.ticks:
                 raise ValueError(
@@ -135,28 +271,86 @@ class ScenarioSpec(NamedTuple):
                     raise ValueError(
                         f"event {e.op!r} needs a node in [0, {n}) (got {e.node})"
                     )
-                if (e.at, e.node) in seen_node_tick:
+                claim_node_tick(e.at, e.node, e.op)
+            elif e.op == "flap":
+                if not (e.down and e.down >= 1 and e.up and e.up >= 1):
                     raise ValueError(
-                        f"conflicting node events at tick {e.at} on node "
-                        f"{e.node}: apply order inside one tick is undefined"
+                        f"flap needs down >= 1 and up >= 1 "
+                        f"(got down={e.down}, up={e.up})"
                     )
-                seen_node_tick.add((e.at, e.node))
-                if e.op == "revive":
-                    revive_ticks.add(e.at)
+                if (e.stagger or 0) < 0:
+                    raise ValueError(f"flap stagger must be >= 0 (got {e.stagger})")
+                until = check_window(e, "flap")
+                check_nodes(e, "flap")
+                if until + e.down > self.ticks:
+                    raise ValueError(
+                        f"flap window ending at {until} needs until + down "
+                        f"<= ticks so its last revive lands inside the run "
+                        f"(down={e.down}, ticks={self.ticks})"
+                    )
+            elif e.op == "rolling_restart":
+                if not (e.down and e.down >= 1 and e.every and e.every >= 1):
+                    raise ValueError(
+                        f"rolling_restart needs down >= 1 and every >= 1 "
+                        f"(got down={e.down}, every={e.every})"
+                    )
+                targets = check_nodes(e, "rolling_restart")
+                last = e.at + (len(targets) - 1) * e.every + e.down
+                if last >= self.ticks:
+                    raise ValueError(
+                        f"rolling_restart's last revive at tick {last} falls "
+                        f"outside [0, {self.ticks})"
+                    )
+            elif e.op == "gray":
+                if not (e.factor and e.factor >= 1):
+                    raise ValueError(f"gray needs factor >= 1 (got {e.factor})")
+                until = check_window(e, "gray")
+                for node in check_nodes(e, "gray"):
+                    for a, b in gray_windows.get(node, ()):
+                        if e.at < b and a < until:
+                            raise ValueError(
+                                f"gray windows overlap on node {node} "
+                                f"([{a}, {b}) and [{e.at}, {until})): which "
+                                "factor wins would be order-dependent"
+                            )
+                    gray_windows.setdefault(node, []).append((e.at, until))
+            elif e.op in ("link_loss", "delay"):
+                check_window(e, e.op)
+                for name in ("src", "dst"):
+                    side = getattr(e, name)
+                    if not side or not all(0 <= m < n for m in side):
+                        raise ValueError(
+                            f"{e.op} needs {name} nodes in [0, {n}) (got {side})"
+                        )
+                if e.op == "link_loss":
+                    if e.p is None or not 0.0 <= e.p < 1.0:
+                        raise ValueError(
+                            f"link_loss needs p in [0, 1) (got {e.p})"
+                        )
                 else:
-                    node_event_ticks.add(e.at)
-        # a revive's bootstrap join reads the live set, so same-tick
-        # kill/suspend/resume (any node) would make the outcome depend
-        # on intra-tick apply order — the scan applies bit edits before
-        # revives while the host oracle applies spec order; reject the
-        # ambiguity instead of silently breaking the parity contract
-        clash = revive_ticks & node_event_ticks
-        if clash:
-            raise ValueError(
-                f"revive shares tick {min(clash)} with another node event: "
-                "a revive's join reads the live set, so same-tick apply "
-                "order would be ambiguous — put the revive on its own tick"
-            )
+                    d, j = e.delay or 0, e.jitter or 0
+                    if d < 0 or j < 0 or d + j < 1:
+                        raise ValueError(
+                            f"delay needs delay >= 0, jitter >= 0 and "
+                            f"delay + jitter >= 1 (got delay={e.delay}, "
+                            f"jitter={e.jitter})"
+                        )
+                    if e.p is not None and not 0.0 <= e.p < 1.0:
+                        raise ValueError(
+                            f"delay's optional p must be in [0, 1) (got {e.p})"
+                        )
+        # the expanded flap/rolling kill/revive primitives join the
+        # (tick, node) conflict check — two flaps on one node, or a flap
+        # colliding with an explicit kill, are caught here
+        for e in self.events:
+            if e.op in ("flap", "rolling_restart"):
+                for pe in expand_fault_primitives(e, self.ticks):
+                    if not 0 <= pe.at < self.ticks:  # pragma: no cover
+                        raise ValueError(
+                            f"{e.op} expansion places {pe.op!r} at tick "
+                            f"{pe.at} outside [0, {self.ticks})"
+                        )
+                    claim_node_tick(pe.at, pe.node, f"{e.op} expansion")
         for e in self.events:
             if e.op == "partition":
                 if not e.groups:

@@ -330,7 +330,10 @@ def run_streamed(
     )
     # static rejections + the ONE per-run host sync of the adjacency
     # check (satellite of the streaming rework: never per segment)
-    adj = srunner.precheck(cluster.state, cluster.net, compiled)
+    params_pre = (
+        cluster.dparams if cluster.backend == "delta" else cluster.params
+    )
+    adj = srunner.precheck(cluster.state, cluster.net, compiled, params_pre)
     if checkpoint_path and store is None:
         # resume must be able to reassemble the full trace, so a
         # checkpointed run always persists its slabs
@@ -440,7 +443,14 @@ def resume(
     compiled = scompile.compile_spec(
         spec, cluster.n, base_loss=cur["base_loss"]
     )
-    adj = srunner.precheck(cluster.state, cluster.net, compiled)
+    adj = srunner.precheck(
+        cluster.state, cluster.net, compiled,
+        cluster.dparams if cluster.backend == "delta" else cluster.params,
+        # the checkpointed net carries this spec's OWN mirrored link
+        # rules / mid-window period row — standing-config rejection is
+        # for fresh runs
+        standing_ok=True,
+    )
     # cluster.key already holds the post-schedule key (the schedule was
     # fully drawn before the first segment); derive the schedule again
     # from the recorded start key without touching it
@@ -494,7 +504,8 @@ def _drive(
     tr_tensors = traffic.tensors if traffic is not None else None
     static_traffic = traffic.static if traffic is not None else None
     sink = cluster.stats_sink
-    carry = (cluster.state, cluster.net.up, cluster.net.responsive, adj)
+    f_state, period0 = srunner.prepare_faults(cluster.state, cluster.net, compiled)
+    carry = (f_state, cluster.net.up, cluster.net.responsive, adj, period0)
     pending: tuple | None = None
     slabs: list[Trace] = []  # only populated when there is no store
     state = {"prev_live": cursor.get("prev_live"), "last_slab": None,
@@ -525,6 +536,7 @@ def _drive(
             keys[a:b],
             tr_tensors,
             jnp.int32(a),
+            compiled.faults,
         )
         statics = dict(
             params=params,
@@ -618,10 +630,13 @@ def _drive(
                     up=np.asarray(carry[1]),
                     responsive=np.asarray(carry[2]),
                     adj=np.asarray(carry[3]),
+                    period=(
+                        np.asarray(carry[4]) if carry[4] is not None else None
+                    ),
                 ),
             )
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:4], out[4]
+        carry, ys = out[:5], out[5]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -642,9 +657,9 @@ def _drive(
         _drain(pending, overlapped=False)
 
     # the run is whole again: hand the final carry back to the cluster
-    f_state, f_up, f_resp, f_adj = carry
+    f_state, f_up, f_resp, f_adj, f_per = carry
     cluster.state = f_state
-    cluster.net = NetState(up=f_up, responsive=f_resp, adj=f_adj)
+    cluster.net = srunner.final_net(f_up, f_resp, f_adj, f_per, compiled)
     cluster.set_loss(float(compiled.loss[-1]))  # host mirror (run_scenario)
     if checkpoint_path is not None:
         # final checkpoint: cursor complete, final state — written
@@ -698,9 +713,11 @@ def run_sweep_streamed(
     segment_ticks: int,
     loss_scales: Any | None = None,
     kill_jitter: Any | None = None,
+    flap_jitter: Any | None = None,
     store: str | None = None,
     assemble: bool = True,
     pipeline: bool = True,
+    shard: bool = False,
 ) -> Any:
     """R replicas of a scenario, streamed segment by segment.
 
@@ -711,7 +728,11 @@ def run_sweep_streamed(
     scan body, tick0-offset segments slicing the same schedules).
     Like ``run_sweep``, the cluster does not advance (only its key
     moves); sweeps do not checkpoint (re-run them — they are
-    measurement fan-outs, not trajectories)."""
+    measurement fan-outs, not trajectories).  ``shard=True`` splits
+    the replica axis across the local devices exactly like the
+    unstreamed sweep — the sharded carry stays device-resident across
+    segments, so a streamed sharded sweep is bit-identical to the
+    unsegmented sharded (and unsharded) run."""
     if isinstance(spec, str):
         spec = ScenarioSpec.load(spec)
     elif isinstance(spec, dict):
@@ -728,11 +749,14 @@ def run_sweep_streamed(
         base_loss=cluster.params.loss,
         loss_scales=loss_scales,
         kill_jitter=kill_jitter,
+        flap_jitter=flap_jitter,
     )
-    adj = srunner.precheck(cluster.state, cluster.net, cs.base)
+    params = cluster.dparams if cluster.backend == "delta" else cluster.params
+    adj = srunner.precheck(cluster.state, cluster.net, cs.base, params)
     # raising validation/IO precedes the replica-key draws: a failed
     # call may not advance cluster.key (see run_streamed)
-    params = cluster.dparams if cluster.backend == "delta" else cluster.params
+    if shard:
+        ssweep.precheck_shard(replicas)
     S = int(segment_ticks)
     T = cs.base.ticks
     bounds = segment_bounds(T, S)
@@ -740,12 +764,22 @@ def run_sweep_streamed(
     start_tick = int(cluster.state.tick)
     led = default_ledger()
     r = cs.replicas
+    f_state, period0 = srunner.prepare_faults(cluster.state, cluster.net, cs.base)
     carry = (
-        ssweep._broadcast_replicas(cluster.state, r),
+        ssweep._broadcast_replicas(f_state, r),
         ssweep._broadcast_replicas(cluster.net.up, r),
         ssweep._broadcast_replicas(cluster.net.responsive, r),
         ssweep._broadcast_replicas(adj, r),
+        ssweep._broadcast_replicas(period0, r),
     )
+    sharding = ssweep._replica_sharding() if shard else None
+    if sharding is not None:
+        # the carry is device_put ONCE; segment outputs inherit the
+        # sharding, so every later segment stays sharded for free
+        carry = tuple(
+            jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), t)
+            for t in carry
+        )
     store_obj = None
     if store is not None:
         store_obj = SegmentStore.create(
@@ -763,6 +797,8 @@ def run_sweep_streamed(
         )
     replica_keys = [cluster._split() for _ in range(replicas)]
     keys = ssweep.sweep_key_schedule(replica_keys, cs)
+    if sharding is not None:
+        keys = jax.device_put(keys, sharding)
     rkeys_np = np.stack([np.asarray(k) for k in replica_keys])
     slabs: list[Any] = []
     pending: tuple | None = None
@@ -789,6 +825,7 @@ def run_sweep_streamed(
             cs.loss[:, a:b],
             keys[:, a:b],
             jnp.int32(a),
+            cs.base.faults,
         )
         statics = dict(params=params, has_revive=cs.base.has_revive)
         ssweep._dispatches += 1
@@ -821,6 +858,7 @@ def run_sweep_streamed(
             replica_keys=rkeys_np,
             loss_scales=cs.loss_scales,
             kill_jitter=cs.kill_jitter,
+            flap_jitter=cs.flap_jitter,
             start_tick=start_tick + a,
             spec=None,
         )
@@ -836,7 +874,7 @@ def run_sweep_streamed(
 
     for seg, (a, b) in enumerate(bounds):
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:4], out[4]
+        carry, ys = out[:5], out[5]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -848,8 +886,8 @@ def run_sweep_streamed(
     if pending is not None:
         _drain(pending, overlapped=False)
 
-    states, up, resp, adj_out = carry
-    nets = NetState(up=up, responsive=resp, adj=adj_out)
+    states, up, resp, adj_out, per_out = carry
+    nets = NetState(up=up, responsive=resp, adj=adj_out, period=per_out)
     if not assemble:
         return store_obj
     trace = (

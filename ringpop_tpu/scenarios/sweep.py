@@ -19,7 +19,10 @@ What may vary per replica (the restricted batch axes):
   with every loss value (base + events + ramp targets) scaled by
   ``loss_scales[r]``;
 * a **kill-tick jitter** — replica r's ``kill`` events shift by
-  ``kill_jitter[r]`` ticks.
+  ``kill_jitter[r]`` ticks;
+* a **flap jitter** — replica r's ``flap`` windows (at AND until, so
+  the duty cycle keeps its expansion count) shift by
+  ``flap_jitter[r]`` ticks: R storm phases in one compiled program.
 
 Everything else (tick count, partitions, suspend/resume/revive
 timing, cluster size, protocol params) is shared: those change tensor
@@ -98,10 +101,16 @@ _register_optimization_barrier_batcher()
 
 
 def replica_spec(
-    spec: ScenarioSpec, *, kill_jitter: int = 0, loss_scale: float = 1.0
+    spec: ScenarioSpec,
+    *,
+    kill_jitter: int = 0,
+    loss_scale: float = 1.0,
+    flap_jitter: int = 0,
 ) -> ScenarioSpec:
     """Replica r's effective spec: ``kill`` events shifted by
-    ``kill_jitter`` ticks, every loss value scaled by ``loss_scale``.
+    ``kill_jitter`` ticks, ``flap`` windows (at AND until, so the duty
+    cycle keeps its length and expansion count) shifted by
+    ``flap_jitter`` ticks, every loss value scaled by ``loss_scale``.
 
     This is the spec a standalone ``run_scenario`` must be given to
     reproduce replica r bit-for-bit (together with the replica key and
@@ -109,7 +118,7 @@ def replica_spec(
     each replica THROUGH this function, so parity is by construction,
     not by re-implementation.
     """
-    if kill_jitter == 0 and loss_scale == 1.0:
+    if kill_jitter == 0 and loss_scale == 1.0 and flap_jitter == 0:
         return spec
     events = []
     for e in spec.events:
@@ -121,6 +130,15 @@ def replica_spec(
                     f"{e.at} outside [0, {spec.ticks})"
                 )
             e = e._replace(at=at)
+        if e.op == "flap" and flap_jitter:
+            at = e.at + flap_jitter
+            until = (e.until if e.until is not None else spec.ticks) + flap_jitter
+            if not 0 <= at < until <= spec.ticks:
+                raise ValueError(
+                    f"flap jitter {flap_jitter:+d} pushes the flap window "
+                    f"[{e.at}, {e.until}) outside [0, {spec.ticks})"
+                )
+            e = e._replace(at=at, until=until)
         if e.op in ("loss", "loss_ramp") and loss_scale != 1.0:
             e = e._replace(p=e.p * loss_scale)
         events.append(e)
@@ -147,6 +165,7 @@ class CompiledSweep(NamedTuple):
     boundaries: tuple[tuple[int, ...], ...]  # per-replica segment ticks
     loss_scales: tuple[float, ...]
     kill_jitter: tuple[int, ...]
+    flap_jitter: tuple[int, ...] = ()
 
 
 def _norm_axis(
@@ -171,6 +190,7 @@ def compile_sweep(
     base_loss: float = 0.0,
     loss_scales: Sequence[float] | None = None,
     kill_jitter: Sequence[int] | None = None,
+    flap_jitter: Sequence[int] | None = None,
 ) -> CompiledSweep:
     """Lower a spec to R stacked replica timelines (host-side, no keys
     drawn — like ``compile_spec``, a failed compile must not advance
@@ -179,10 +199,11 @@ def compile_sweep(
         raise ValueError(f"replicas must be >= 1 (got {replicas})")
     scales = _norm_axis("loss_scales", loss_scales, replicas, 1.0)
     jitters = _norm_axis("kill_jitter", kill_jitter, replicas, 0)
+    fjitters = _norm_axis("flap_jitter", flap_jitter, replicas, 0)
     for s in scales:
         if s < 0.0:
             raise ValueError(f"loss scales must be >= 0 (got {s})")
-    if all(s == 1.0 for s in scales) and not any(jitters):
+    if all(s == 1.0 for s in scales) and not any(jitters) and not any(fjitters):
         # the common path (seed-only sweep): every replica's tensors are
         # byte-identical — compile once, broadcast the replica axis
         base = compile_spec(spec, n, base_loss=base_loss)
@@ -200,12 +221,14 @@ def compile_sweep(
             boundaries=(base.boundaries,) * replicas,
             loss_scales=scales,
             kill_jitter=jitters,
+            flap_jitter=fjitters,
         )
     per: list[CompiledScenario] = []
     for r in range(replicas):
         try:
             spec_r = replica_spec(
-                spec, kill_jitter=jitters[r], loss_scale=scales[r]
+                spec, kill_jitter=jitters[r], loss_scale=scales[r],
+                flap_jitter=fjitters[r],
             )
             per.append(compile_spec(spec_r, n, base_loss=base_loss * scales[r]))
         except ValueError as e:
@@ -213,13 +236,21 @@ def compile_sweep(
     base = per[0]
     for r, c in enumerate(per[1:], start=1):
         # jitter/scale may not change shapes or static lowering facts
-        if c.ticks != base.ticks or c.has_revive != base.has_revive:
+        if (
+            c.ticks != base.ticks
+            or c.has_revive != base.has_revive
+            or c.ev_tick.shape != base.ev_tick.shape
+            or c.has_delay != base.has_delay
+            or c.delay_depth != base.delay_depth
+        ):
             raise ValueError(f"replica {r} diverges in static scenario shape")
         if not (
             np.array_equal(np.asarray(c.p_tick), np.asarray(base.p_tick))
             and np.array_equal(np.asarray(c.p_gid), np.asarray(base.p_gid))
         ):  # pragma: no cover - jitter/scale cannot touch partitions
             raise ValueError(f"replica {r} diverges in partition rows")
+        if (c.faults is None) != (base.faults is None):  # pragma: no cover
+            raise ValueError(f"replica {r} diverges in failure-model events")
     return CompiledSweep(
         base=base,
         replicas=replicas,
@@ -230,6 +261,7 @@ def compile_sweep(
         boundaries=tuple(c.boundaries for c in per),
         loss_scales=scales,
         kill_jitter=jitters,
+        flap_jitter=fjitters,
     )
 
 
@@ -307,6 +339,7 @@ def _sweep_scan_impl(
     up,
     responsive,
     adj,
+    period,
     ev_tick,
     ev_kind,
     ev_node,
@@ -315,6 +348,7 @@ def _sweep_scan_impl(
     loss,
     keys,
     tick0=None,
+    faults=None,
     *,
     params,
     has_revive: bool,
@@ -323,19 +357,27 @@ def _sweep_scan_impl(
     # for 0) is the segment offset of the streamed sweep
     # (scenarios/stream.py): closed over rather than batched, so the
     # vmapped body sees the same global tick numbering per segment.
+    def one(state, up, responsive, adj, period, ev_tick, ev_kind, ev_node,
+            p_tick, p_gid, loss, keys, faults):
+        return runner._scenario_scan_impl(
+            state, up, responsive, adj, period,
+            ev_tick, ev_kind, ev_node, p_tick, p_gid, loss, keys,
+            None, tick0, faults,
+            params=params, has_revive=has_revive,
+        )
+
     return jax.vmap(
-        functools.partial(
-            runner._scenario_scan_impl, tick0=tick0,
-            params=params, has_revive=has_revive
-        ),
-        # batched: state/net (leading replica axis), node events (jitter
-        # reorders rows), loss (scaled), keys.  Shared: partition rows.
-        in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, 0, 0),
+        one,
+        # batched: state/net (leading replica axis, period carry
+        # included), node events (jitter reorders rows), loss (scaled),
+        # keys.  Shared: partition rows + failure-model tensors.
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0, 0, None),
     )(
         state,
         up,
         responsive,
         adj,
+        period,
         ev_tick,
         ev_kind,
         ev_node,
@@ -343,6 +385,7 @@ def _sweep_scan_impl(
         p_gid,
         loss,
         keys,
+        faults,
     )
 
 
@@ -417,13 +460,15 @@ def run_sweep_compiled(
             f"key schedule is {keys.shape[:2]} for "
             f"({cs.replicas} replicas, {cs.base.ticks} ticks)"
         )
-    adj = runner.precheck(state, net, cs.base)
+    adj = runner.precheck(state, net, cs.base, params)
+    state, period = runner.prepare_faults(state, net, cs.base)
     r = cs.replicas
     batched = [
         _broadcast_replicas(state, r),
         _broadcast_replicas(net.up, r),
         _broadcast_replicas(net.responsive, r),
         _broadcast_replicas(adj, r),
+        _broadcast_replicas(period, r),
     ]
     if shard:
         precheck_shard(r)
@@ -439,7 +484,7 @@ def run_sweep_compiled(
     _dispatches += 1
     # routed through the dispatch ledger (obs/ledger.py): a call-through
     # when disabled, a recorded compile/execute + footprint row when on
-    states, up, resp, adj, ys = default_ledger().dispatch(
+    states, up, resp, adj, period, ys = default_ledger().dispatch(
         "run_sweep",
         _sweep_scan,
         *batched,
@@ -450,6 +495,8 @@ def run_sweep_compiled(
         cs.base.p_gid,
         cs.loss,
         keys,
+        None,
+        cs.base.faults,
         params=params,
         has_revive=cs.base.has_revive,
         _meta={
@@ -459,7 +506,7 @@ def run_sweep_compiled(
             "replicas": r,
         },
     )
-    nets = type(net)(up=up, responsive=resp, adj=adj)
+    nets = type(net)(up=up, responsive=resp, adj=adj, period=period)
     return states, nets, ys
 
 
@@ -489,6 +536,7 @@ class SweepTrace:
         replica_keys: np.ndarray,
         loss_scales: Sequence[float],
         kill_jitter: Sequence[int],
+        flap_jitter: Sequence[int] | None = None,
         start_tick: int = 0,
         spec: dict[str, Any] | None = None,
     ):
@@ -501,6 +549,9 @@ class SweepTrace:
         self.replica_keys = np.asarray(replica_keys)
         self.loss_scales = tuple(float(s) for s in loss_scales)
         self.kill_jitter = tuple(int(j) for j in kill_jitter)
+        self.flap_jitter = tuple(
+            int(j) for j in (flap_jitter if flap_jitter else (0,) * len(self.kill_jitter))
+        )
         self.start_tick = int(start_tick)
         self.spec = spec
         # in-memory only (run_sweep attaches them; not serialized)
@@ -528,7 +579,11 @@ class SweepTrace:
                 raise ValueError(f"sweep metric {name!r} is not [{r}, {t}]-shaped")
         if self.replica_keys.shape[0] != r:
             raise ValueError("replica_keys does not cover every replica")
-        if len(self.loss_scales) != r or len(self.kill_jitter) != r:
+        if (
+            len(self.loss_scales) != r
+            or len(self.kill_jitter) != r
+            or len(self.flap_jitter) != r
+        ):
             raise ValueError("sweep params do not cover every replica")
         if not np.all((self.live >= 0) & (self.live <= self.n)):
             raise ValueError("sweep live counts outside [0, n]")
@@ -539,12 +594,15 @@ class SweepTrace:
         that replica's effective spec when derivable)."""
         spec = self.spec
         if spec is not None and (
-            self.kill_jitter[r] or self.loss_scales[r] != 1.0
+            self.kill_jitter[r]
+            or self.flap_jitter[r]
+            or self.loss_scales[r] != 1.0
         ):
             spec = replica_spec(
                 ScenarioSpec.from_dict(spec),
                 kill_jitter=self.kill_jitter[r],
                 loss_scale=self.loss_scales[r],
+                flap_jitter=self.flap_jitter[r],
             ).to_dict()
         return Trace(
             metrics={k: v[r] for k, v in self.metrics.items()},
@@ -582,6 +640,7 @@ class SweepTrace:
                 or not np.array_equal(s.replica_keys, first.replica_keys)
                 or s.loss_scales != first.loss_scales
                 or s.kill_jitter != first.kill_jitter
+                or s.flap_jitter != first.flap_jitter
             ):
                 raise ValueError("slabs disagree on the replica axis")
             if s.start_tick != expect:
@@ -603,6 +662,7 @@ class SweepTrace:
             replica_keys=first.replica_keys,
             loss_scales=first.loss_scales,
             kill_jitter=first.kill_jitter,
+            flap_jitter=first.flap_jitter,
             start_tick=first.start_tick,
             spec=spec if spec is not None else first.spec,
         )
@@ -670,6 +730,7 @@ class SweepTrace:
             "start_tick": self.start_tick,
             "loss_scales": list(self.loss_scales),
             "kill_jitter": list(self.kill_jitter),
+            "flap_jitter": list(self.flap_jitter),
             "spec": self.spec,
         }
 
@@ -692,6 +753,7 @@ class SweepTrace:
             replica_keys=np.asarray(data[f"{prefix}replica_keys"]),
             loss_scales=meta["loss_scales"],
             kill_jitter=meta["kill_jitter"],
+            flap_jitter=meta.get("flap_jitter"),
             start_tick=meta.get("start_tick", 0),
             spec=meta.get("spec"),
         )

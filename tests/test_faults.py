@@ -1,0 +1,644 @@
+"""Failure-model subsystem: asymmetric links, latency/jitter, flap
+storms, gray failures, rolling deploys (scenarios/faults.py).
+
+Fast lane: the spec/compiler host logic (validation, JSON round trips,
+flap/rolling expansion, link-rule / period-row / delay-depth lowering)
+plus ONE compiled run of a spec combining every family (a single scan
+compile covers the in-scan smoke for all five ops) and the streamed+
+sharded sweep composition test (PR 8 follow-up).  The per-family
+compiled-scan vs host-loop bit-parity oracles — the acceptance
+criterion — compile many programs on CPU and ride the slow lane, like
+the PR 2 parity grids.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios import faults as sfaults
+from ringpop_tpu.scenarios import runner
+from ringpop_tpu.scenarios import sweep as ssweep
+from ringpop_tpu.scenarios.spec import (
+    Event,
+    ScenarioSpec,
+    expand_fault_primitives,
+)
+
+FAST = sim.SwimParams(suspicion_ticks=8)
+# The two FAST-lane compiled tests use a 1-witness relay: the ping-req
+# exchange unrolls 4 stages x k slots, so k=1 compiles a ~3x smaller
+# program (the tier-1 suite runs against a fixed wall-clock watchdog);
+# the slow parity oracles keep the default k=3.
+LEAN = sim.SwimParams(suspicion_ticks=8, ping_req_size=1)
+N = 10
+
+# One spec exercising every failure-model family (plus a partition, so
+# composition with the first-generation events is covered): the fast
+# smoke compiles it ONCE; the slow oracle replays it against the host
+# loop bit for bit.
+MIXED = ScenarioSpec.from_dict(
+    {
+        "ticks": 30,
+        "events": [
+            {"at": 2, "op": "link_loss", "src": [0, 1], "dst": [4, 5],
+             "p": 0.9, "until": 20},
+            {"at": 3, "op": "gray", "node": 2, "factor": 4, "until": 25},
+            {"at": 4, "op": "flap", "node": 7, "until": 16, "down": 2, "up": 3},
+            {"at": 5, "op": "rolling_restart", "nodes": [8, 9], "down": 2,
+             "every": 4},
+            {"at": 6, "op": "delay", "src": [3], "dst": [6], "delay": 2,
+             "jitter": 1, "until": 22},
+            {"at": 10, "op": "partition",
+             "groups": [[0, 1, 2, 3, 4], [5, 6, 7, 8, 9]]},
+            {"at": 18, "op": "heal"},
+        ],
+    }
+)
+
+
+def _states_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(a, b)
+        if x is not None
+    )
+
+
+def _nets_equal(a, b) -> bool:
+    """Field-wise NetState equality, adj excluded (scenario runs
+    normalize adj to the group-id form; the host loop keeps None for a
+    never-partitioned net — the pre-existing convention)."""
+    for f, x, y in zip(a._fields, a, b):
+        if f == "adj":
+            continue
+        if (x is None) != (y is None):
+            return False
+        if x is not None and not np.array_equal(np.asarray(x), np.asarray(y)):
+            return False
+    return True
+
+
+# -- fast: spec round trips + validation ------------------------------------
+
+
+def test_new_ops_json_roundtrip(tmp_path):
+    path = str(tmp_path / "spec.json")
+    MIXED.save(path)
+    assert ScenarioSpec.load(path) == MIXED
+    # and through the Event dict form each way
+    for e in MIXED.events:
+        assert Event.from_dict(e.to_dict()) == e
+
+
+def test_fault_op_validation_errors():
+    def bad(events, match, ticks=20, n=8):
+        with pytest.raises(ValueError, match=match):
+            ScenarioSpec.from_dict({"ticks": ticks, "events": events}).validate(n)
+
+    bad([{"at": 1, "op": "link_loss", "src": [0], "dst": [1], "p": 1.0}],
+        "p in \\[0, 1\\)")
+    bad([{"at": 1, "op": "link_loss", "src": [], "dst": [1], "p": 0.5}],
+        "src nodes")
+    bad([{"at": 1, "op": "link_loss", "src": [0], "dst": [9], "p": 0.5}],
+        "dst nodes")
+    bad([{"at": 5, "op": "link_loss", "src": [0], "dst": [1], "p": 0.5,
+          "until": 5}], "at < until")
+    bad([{"at": 1, "op": "delay", "src": [0], "dst": [1]}],
+        "delay \\+ jitter >= 1")
+    bad([{"at": 1, "op": "flap", "node": 2, "until": 10, "down": 0, "up": 3}],
+        "down >= 1")
+    bad([{"at": 1, "op": "flap", "node": 2, "until": 19, "down": 3, "up": 2}],
+        "last revive")
+    bad([{"at": 1, "op": "gray", "node": 2, "factor": 0}], "factor >= 1")
+    bad([{"at": 1, "op": "gray", "node": 2, "factor": 3, "until": 10},
+         {"at": 5, "op": "gray", "node": 2, "factor": 5}],
+        "gray windows overlap")
+    bad([{"at": 1, "op": "rolling_restart", "nodes": [0, 1], "down": 9,
+          "every": 10}], "outside")
+    # expansion collisions join the (tick, node) conflict check
+    bad([{"at": 1, "op": "flap", "node": 2, "until": 10, "down": 2, "up": 3},
+         {"at": 3, "op": "kill", "node": 2}], "conflicting node events")
+    bad([{"at": 1, "op": "flap", "nodes": [2, 3], "until": 10, "down": 2,
+          "up": 3},
+         {"at": 1, "op": "flap", "nodes": [3], "until": 10, "down": 2,
+          "up": 3}], "conflicting node events")
+
+
+def test_sametick_revive_and_kill_now_canonical():
+    """Same-tick revive + kill on DIFFERENT nodes is legal now: both
+    sides apply bit edits before revives (the canonical order), so the
+    outcome is defined.  Same (tick, node) stays rejected."""
+    ScenarioSpec(
+        ticks=5,
+        events=(
+            Event(at=1, op="revive", node=2),
+            Event(at=1, op="kill", node=0),
+        ),
+    ).validate(4)
+    with pytest.raises(ValueError, match="conflicting node events"):
+        ScenarioSpec(
+            ticks=5,
+            events=(
+                Event(at=1, op="kill", node=2),
+                Event(at=1, op="revive", node=2),
+            ),
+        ).validate(4)
+
+
+def test_flap_expansion():
+    e = Event.from_dict(
+        {"at": 2, "op": "flap", "nodes": [5, 6], "until": 12, "down": 2,
+         "up": 3, "stagger": 1}
+    )
+    prim = expand_fault_primitives(e, 20)
+    # node 5 cycles at 2 (kill) / 4 (revive) / 7 / 9; node 6 shifts by 1
+    assert [(p.at, p.op, p.node) for p in prim] == [
+        (2, "kill", 5), (4, "revive", 5),
+        (7, "kill", 5), (9, "revive", 5),
+        (3, "kill", 6), (5, "revive", 6),
+        (8, "kill", 6), (10, "revive", 6),
+    ]
+    # every kill has its matching revive: the storm always heals itself
+    kills = sum(1 for p in prim if p.op == "kill")
+    revives = sum(1 for p in prim if p.op == "revive")
+    assert kills == revives
+
+
+def test_rolling_restart_expansion():
+    e = Event.from_dict(
+        {"at": 3, "op": "rolling_restart", "nodes": [1, 4, 7], "down": 2,
+         "every": 3}
+    )
+    prim = expand_fault_primitives(e, 20)
+    assert [(p.at, p.op, p.node) for p in prim] == [
+        (3, "kill", 1), (5, "revive", 1),
+        (6, "kill", 4), (8, "revive", 4),
+        (9, "kill", 7), (11, "revive", 7),
+    ]
+
+
+# -- fast: the faults compiler (host-side) ----------------------------------
+
+
+def test_link_rules_and_delay_depth():
+    rules = sfaults.link_rules(MIXED)
+    assert len(rules) == 2
+    assert rules[0] == sfaults.LinkRule(
+        start=2, end=20, src=(0, 1), dst=(4, 5), p=0.9, delay=0, jitter=0
+    )
+    assert rules[1].delay == 2 and rules[1].jitter == 1 and rules[1].p == 0.0
+    assert sfaults.delay_depth(MIXED) == 4  # max(d) + max(j) + 1
+    assert sfaults.delay_depth(ScenarioSpec(ticks=5)) == 0
+    # overlapping rules combine as max(d) + max(j) (the step takes the
+    # maxima separately), so the depth must cover their SUM even when
+    # no single rule reaches it — a per-rule max(d + j) would wrap the
+    # ring buffer and deliver early
+    split = ScenarioSpec.from_dict(
+        {
+            "ticks": 20,
+            "events": [
+                {"at": 1, "op": "delay", "src": [0], "dst": [1], "delay": 3},
+                {"at": 2, "op": "delay", "src": [0], "dst": [1], "delay": 0,
+                 "jitter": 2},
+            ],
+        }
+    )
+    assert sfaults.delay_depth(split) == 3 + 2 + 1
+
+
+def test_period_switches_fold():
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": 30,
+            "events": [
+                {"at": 2, "op": "gray", "node": 1, "factor": 4, "until": 10},
+                {"at": 5, "op": "gray", "nodes": [3, 4], "factor": 2,
+                 "until": 12},
+            ],
+        }
+    )
+    switches = dict(
+        (t, row.tolist()) for t, row in sfaults.period_switches(spec, 6)
+    )
+    assert set(switches) == {2, 5, 10, 12}
+    assert switches[2] == [1, 4, 1, 1, 1, 1]
+    assert switches[5] == [1, 4, 1, 2, 2, 1]
+    assert switches[10] == [1, 1, 1, 2, 2, 1]
+    assert switches[12] == [1, 1, 1, 1, 1, 1]
+    # adjacent windows sharing a tick (one ends where the next starts):
+    # the new factor wins at the shared tick, regardless of the order
+    # the spec LISTS the events (same-tick restores apply before sets)
+    adjacent = ScenarioSpec.from_dict(
+        {
+            "ticks": 40,
+            "events": [
+                {"at": 20, "op": "gray", "node": 0, "factor": 6, "until": 30},
+                {"at": 10, "op": "gray", "node": 0, "factor": 4, "until": 20},
+            ],
+        }
+    )
+    sw = dict((t, row.tolist()) for t, row in sfaults.period_switches(adjacent, 2))
+    assert sw[10] == [4, 1]
+    assert sw[20] == [6, 1]  # the restore of [10, 20) must not clobber
+    assert sw[30] == [1, 1]
+
+
+def test_compile_faults_tensors_and_boundaries():
+    compiled = scompile.compile_spec(MIXED, N)
+    ft = compiled.faults
+    assert ft is not None
+    assert ft.lr_src.shape == (2, N) and ft.lr_p.shape == (2,)
+    assert compiled.has_delay and compiled.delay_depth == 4
+    assert compiled.has_gray and ft.pe_tick.shape == (2,)
+    # link-window edges and gray switches are key-schedule boundaries
+    for t in (2, 20, 3, 25, 6, 22):
+        assert t in compiled.boundaries, t
+    # flap/rolling expansion landed in the node-event tensors
+    kinds = np.asarray(compiled.ev_kind)
+    assert (kinds == scompile.EV_KILL).sum() >= 5
+    assert (kinds == scompile.EV_REVIVE).sum() >= 5
+    assert compiled.has_revive
+    # a failure-model-free spec compiles with no fault tensors at all
+    legacy = scompile.compile_spec(
+        ScenarioSpec.from_dict(
+            {"ticks": 5, "events": [{"at": 1, "op": "kill", "node": 0}]}
+        ),
+        N,
+    )
+    assert legacy.faults is None and not legacy.has_delay
+
+
+def test_rules_arrays_activity_masking():
+    rules = sfaults.link_rules(MIXED)
+    src, dst, p, d, j = sfaults.rules_arrays(rules, N, at=21)
+    # at tick 21 the loss rule's window [2, 20) has closed, the delay
+    # rule's [6, 22) is still open
+    assert p[0] == 0.0 and d[1] == 2 and j[1] == 1
+    src2, dst2, p2, _, _ = sfaults.rules_arrays(rules, N, at=10)
+    assert p2[0] == np.float32(0.9)
+    np.testing.assert_array_equal(src, src2)  # masks never change
+
+
+def test_replica_spec_flap_jitter():
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": 30,
+            "events": [
+                {"at": 4, "op": "flap", "node": 2, "until": 16, "down": 2,
+                 "up": 3},
+            ],
+        }
+    )
+    shifted = ssweep.replica_spec(spec, flap_jitter=3)
+    (e,) = shifted.events
+    assert e.at == 7 and e.until == 19
+    # the window length is preserved, so the expansion count matches
+    assert len(expand_fault_primitives(e, 30)) == len(
+        expand_fault_primitives(spec.events[0], 30)
+    )
+    with pytest.raises(ValueError, match="flap jitter"):
+        ssweep.replica_spec(spec, flap_jitter=20)
+
+
+def test_cluster_fault_surface_guards():
+    c = SimCluster(4, FAST, seed=0)
+    with pytest.raises(ValueError, match="enable_delay"):
+        c.set_link_rules(
+            np.ones((1, 4), bool), np.ones((1, 4), bool), [0.0], d=[2], j=[0]
+        )
+    with pytest.raises(ValueError, match="depth must be >= 2"):
+        c.enable_delay(1)
+    d = SimCluster(4, FAST, seed=0, backend="delta", capacity=4)
+    with pytest.raises(NotImplementedError, match="dense-backend-only"):
+        d.enable_delay(4)
+    # delay scenarios are rejected on delta BEFORE any key draw
+    spec = ScenarioSpec.from_dict(
+        {"ticks": 6, "events": [{"at": 1, "op": "delay", "src": [0],
+                                 "dst": [1], "delay": 2}]}
+    )
+    key_before = np.asarray(d.key).copy()
+    with pytest.raises(NotImplementedError, match="dense-backend-only"):
+        d.run_scenario(spec)
+    np.testing.assert_array_equal(np.asarray(d.key), key_before)
+
+
+def test_standing_config_rejected_on_compiled_runs():
+    """A compiled scenario applies only spec-declared fault config: an
+    operator-installed ACTIVE link rule (or a non-lockstep set_period
+    row colliding with gray events) would be silently ignored in-scan
+    while the host-loop oracle kept applying it — rejected before any
+    key draw instead.  Zeroed standing rules (a finished scenario's
+    mirror) stay legal."""
+    c = SimCluster(6, FAST, seed=0)
+    src = np.zeros((1, 6), bool)
+    src[0, 0] = True
+    c.set_link_rules(src, src, [0.5])
+    key_before = np.asarray(c.key).copy()
+    plain = {"ticks": 4, "events": [{"at": 1, "op": "kill", "node": 5}]}
+    with pytest.raises(ValueError, match="standing link rules"):
+        c.run_scenario(plain)
+    np.testing.assert_array_equal(np.asarray(c.key), key_before)
+    c.set_link_rules(src, src, [0.0])  # a zeroed mirror is inert: legal
+    runner.precheck(
+        c.state, c.net, scompile.compile_spec(ScenarioSpec.from_dict(plain), 6)
+    )
+    c.clear_link_rules()
+    c.set_period(np.array([1, 1, 4, 1, 1, 1], np.int32))
+    gray = {
+        "ticks": 6,
+        "events": [{"at": 1, "op": "gray", "node": 0, "factor": 3}],
+    }
+    with pytest.raises(ValueError, match="clobber the standing"):
+        c.run_scenario(gray)
+    # a standing row composes fine with gray-free scenarios (threaded
+    # through the carry) and an all-ones row with gray ones
+    runner.precheck(
+        c.state, c.net, scompile.compile_spec(ScenarioSpec.from_dict(plain), 6)
+    )
+    c.set_period(np.ones(6, np.int32))
+    runner.precheck(
+        c.state, c.net, scompile.compile_spec(ScenarioSpec.from_dict(gray), 6)
+    )
+
+
+# -- fast: ONE compiled smoke covering every family -------------------------
+
+
+def test_mixed_families_single_dispatch_smoke():
+    """All five families in one compiled program: one dispatch, events
+    visibly land (flap/rolling dips, delayed claims counted), and the
+    post-run net mirrors the end-of-scenario configuration."""
+    before = runner.dispatch_count()
+    c = SimCluster(N, LEAN, seed=3)
+    trace = c.run_scenario(MIXED)
+    assert runner.dispatch_count() - before == 1
+    live = trace.live.tolist()
+    assert live[4] == N - 1  # the flap's first kill
+    assert min(live[5:12]) <= N - 2  # flap + rolling overlap
+    assert live[-1] == N  # every storm healed itself
+    assert int(trace.metrics["delayed_claims"].sum()) > 0
+    assert "matured_applied" in trace.metrics
+    assert trace.converged[-1]
+    # end-of-run config mirrored into the cluster net: every window
+    # closed before the final tick, so the rules are present but zeroed
+    assert c.net.link_src is not None
+    assert float(np.asarray(c.net.link_p).max()) == 0.0
+    assert np.asarray(c.net.period).tolist() == [1] * N
+    # the in-flight buffer stays installed (network-resident residue)
+    assert c.state.pending is not None
+    assert c.state.pending.shape == (4, N, N)
+
+
+@pytest.mark.slow
+def test_sweep_streamed_sharded_matches_unstreamed():
+    """PR 8 follow-up: run_sweep(segment_ticks=S, shard=True) — the
+    sharded replica axis persists across segment dispatches and the
+    telemetry is bit-identical to the unstreamed sharded sweep.
+
+    Slow lane by wall-clock budget, not by nature: the 2-core CI host
+    swings the tier-1 suite by ~25% against its 870 s watchdog, and
+    this test compiles two vmapped 8-replica programs (~19 s).  The
+    compiled failure-model representative in tier-1 is the
+    mixed-family smoke above; the sharded-stream machinery itself is
+    exercised fast by test_stream/test_sweep on their single axes."""
+    if jax.local_device_count() < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    r = jax.local_device_count()
+    spec = {"ticks": 6, "events": [{"at": 1, "op": "kill", "node": 5}]}
+    a = SimCluster(6, LEAN, seed=11)
+    plain = a.run_sweep(spec, r, shard=True)
+    b = SimCluster(6, LEAN, seed=11)
+    # S=3 over T=6: both segments share the [R, 3] shape, so the
+    # streamed arm costs ONE extra compile next to the whole-run arm
+    streamed = b.run_sweep(spec, r, shard=True, segment_ticks=3)
+    np.testing.assert_array_equal(plain.converged, streamed.converged)
+    np.testing.assert_array_equal(plain.live, streamed.live)
+    for k in plain.metrics:
+        np.testing.assert_array_equal(plain.metrics[k], streamed.metrics[k])
+    assert _states_equal(
+        jax.tree_util.tree_map(np.asarray, plain.final_states),
+        jax.tree_util.tree_map(np.asarray, streamed.final_states),
+    )
+
+
+# -- slow: compiled-scan vs host-loop bit-parity oracles --------------------
+# (the acceptance criterion: one oracle per family + the composition)
+
+
+def _parity(spec_dict, n=N, backend="dense", seed=7, **kw):
+    spec = ScenarioSpec.from_dict(spec_dict)
+    a = SimCluster(n, FAST, seed=seed, backend=backend, **kw)
+    trace = a.run_scenario(spec)
+    b = SimCluster(n, FAST, seed=seed, backend=backend, **kw)
+    runner.run_host_loop(b, spec)
+    assert _states_equal(a.state, b.state)
+    assert _nets_equal(a.net, b.net)
+    assert a.checksums() == b.checksums()
+    return trace
+
+
+@pytest.mark.slow
+def test_link_loss_parity_and_asymmetry():
+    """Directed loss: compiled == host loop bit for bit, and the
+    asymmetry is real — a one-way blackhole from most of the cluster
+    toward one node still converges (the victim's own pings get out)."""
+    trace = _parity(
+        {
+            "ticks": 25,
+            "events": [
+                {"at": 2, "op": "link_loss", "src": [0, 1, 2],
+                 "dst": [5, 6, 7], "p": 0.8, "until": 18},
+                {"at": 4, "op": "link_loss", "src": [5], "dst": [0], "p": 0.5},
+            ],
+        }
+    )
+    assert trace.converged[-1]
+
+
+@pytest.mark.slow
+def test_gray_failure_parity_and_slow_probing():
+    """Per-node periods: parity, plus the behavioral signature — a
+    gray cluster (every node slowed) sends fewer pings per tick."""
+    trace = _parity(
+        {
+            "ticks": 25,
+            "events": [
+                {"at": 2, "op": "gray", "node": 3, "factor": 5, "until": 20},
+                {"at": 5, "op": "gray", "nodes": [6, 7], "factor": 3},
+            ],
+        }
+    )
+    # while 3 nodes are gray, fewer probes are initiated than nodes
+    window = trace.metrics["pings_sent"][6:19]
+    assert window.min() < N
+
+
+@pytest.mark.slow
+def test_flap_storm_parity():
+    _parity(
+        {
+            "ticks": 24,
+            "events": [
+                {"at": 2, "op": "flap", "nodes": [8, 9], "until": 15,
+                 "down": 2, "up": 3, "stagger": 1},
+            ],
+        }
+    )
+
+
+@pytest.mark.slow
+def test_rolling_restart_parity():
+    trace = _parity(
+        {
+            "ticks": 24,
+            "events": [
+                {"at": 2, "op": "rolling_restart", "nodes": [5, 6, 7],
+                 "down": 2, "every": 3},
+            ],
+        }
+    )
+    assert trace.live[-1] == N  # the wave revived everyone
+
+
+@pytest.mark.slow
+def test_delay_jitter_parity():
+    trace = _parity(
+        {
+            "ticks": 25,
+            "events": [
+                {"at": 2, "op": "delay", "src": [0, 1, 2, 3],
+                 "dst": [4, 5, 6, 7], "delay": 2, "jitter": 2, "until": 20},
+                {"at": 3, "op": "loss", "p": 0.05},
+            ],
+        }
+    )
+    assert int(trace.metrics["delayed_claims"].sum()) > 0
+
+
+@pytest.mark.slow
+def test_mixed_families_parity():
+    _parity(MIXED.to_dict())
+
+
+@pytest.mark.slow
+def test_delta_link_and_gray_parity():
+    """The delta backend supports the loss-only link rules and gray
+    periods in-scan: scan == host loop, and dense == delta on the
+    shared telemetry (ample caps => bit parity)."""
+    spec_dict = {
+        "ticks": 25,
+        "events": [
+            {"at": 2, "op": "link_loss", "src": [0, 1, 2], "dst": [5, 6, 7],
+             "p": 0.8, "until": 18},
+            {"at": 3, "op": "gray", "node": 3, "factor": 5, "until": 20},
+            {"at": 5, "op": "kill", "node": 9},
+        ],
+    }
+    kw = dict(capacity=N, wire_cap=N, claim_grid=3 * N * N)
+    td = _parity(spec_dict, backend="delta", **kw)
+    a = SimCluster(N, FAST, seed=7, backend="delta", **kw)
+    a.run_scenario(ScenarioSpec.from_dict(spec_dict))
+    c = SimCluster(N, FAST, seed=7)
+    tc = c.run_scenario(ScenarioSpec.from_dict(spec_dict))
+    np.testing.assert_array_equal(td.converged, tc.converged)
+    np.testing.assert_array_equal(td.live, tc.live)
+    assert a.checksums() == c.checksums()
+
+
+@pytest.mark.slow
+def test_period_row_subsumes_phase_mod_both_backends():
+    """The gray model's per-node period tensor reproduces the static
+    phase_mod stagger value for value: a row of P == phase_mod=P, on
+    the dense AND the (newly ported, VERDICT item 4) delta backend."""
+    P = 4
+    p4 = sim.SwimParams(suspicion_ticks=32, phase_mod=P)
+    base = sim.SwimParams(suspicion_ticks=32)
+    for backend, kw in (
+        ("dense", {}),
+        ("delta", dict(capacity=N, wire_cap=N, claim_grid=3 * N * N)),
+    ):
+        a = SimCluster(N, p4, seed=5, backend=backend, **kw)
+        a.tick(20)
+        b = SimCluster(N, base, seed=5, backend=backend, **kw)
+        b.set_period(np.full(N, P, np.int32))
+        b.tick(20)
+        assert _states_equal(a.state, b.state), backend
+        assert a.checksums() == b.checksums(), backend
+
+
+@pytest.mark.slow
+def test_sweep_flap_jitter_per_replica_parity():
+    """flap_jitter batches storm phases: replica r of the sweep is
+    bit-identical to a standalone run_scenario of its shifted spec."""
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": 20,
+            "events": [
+                {"at": 3, "op": "flap", "node": 5, "until": 12, "down": 2,
+                 "up": 2},
+            ],
+        }
+    )
+    c = SimCluster(8, FAST, seed=9)
+    strace = c.run_sweep(spec, 2, flap_jitter=[0, 3])
+    for r in range(2):
+        solo = SimCluster(8, FAST, seed=9)
+        solo.key = jax.numpy.asarray(strace.replica_keys[r])
+        t = solo.run_scenario(
+            ssweep.replica_spec(spec, flap_jitter=strace.flap_jitter[r])
+        )
+        np.testing.assert_array_equal(t.live, strace.live[r], err_msg=f"r={r}")
+        np.testing.assert_array_equal(
+            t.converged, strace.converged[r], err_msg=f"r={r}"
+        )
+
+
+@pytest.mark.slow
+def test_streamed_mixed_scenario_bit_identical():
+    """The failure-model tensors stream: a segmented mixed-family run
+    (tick0-offset windows, carried period row, persistent in-flight
+    buffer) equals the one-dispatch run bit for bit."""
+    a = SimCluster(N, FAST, seed=3)
+    whole = a.run_scenario(MIXED)
+    b = SimCluster(N, FAST, seed=3)
+    streamed = b.run_scenario(MIXED, segment_ticks=7)
+    np.testing.assert_array_equal(whole.converged, streamed.converged)
+    np.testing.assert_array_equal(whole.live, streamed.live)
+    for k in whole.metrics:
+        np.testing.assert_array_equal(whole.metrics[k], streamed.metrics[k])
+    assert _states_equal(a.state, b.state)
+    assert _nets_equal(a.net, b.net)
+
+
+@pytest.mark.slow
+def test_relay_full_sync_fires_and_heals():
+    """VERDICT item 5 (the relay full-sync omission), closed behind
+    SwimParams.relay_full_sync: with the flag on, a divergence-heavy
+    run answers relay acks with full rows (metric > 0) and still
+    converges; with it off the metric stays 0 (the historical
+    convention, pinned)."""
+    spec = {
+        "ticks": 60,
+        "events": [
+            {"at": 2, "op": "kill", "node": 11},
+            {"at": 4, "op": "loss", "p": 0.3},
+            {"at": 8, "op": "link_loss", "src": [0, 1, 2, 3],
+             "dst": [8, 9, 10], "p": 0.95, "until": 40},
+            {"at": 40, "op": "loss", "p": 0.0},
+        ],
+    }
+    on = SimCluster(
+        12, sim.SwimParams(suspicion_ticks=8, relay_full_sync=True), seed=2
+    )
+    t_on = on.run_scenario(spec)
+    assert int(t_on.metrics["relay_full_syncs"].sum()) > 0
+    assert t_on.converged[-1]
+    off = SimCluster(12, FAST, seed=2)
+    t_off = off.run_scenario(spec)
+    assert int(t_off.metrics["relay_full_syncs"].sum()) == 0

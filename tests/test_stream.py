@@ -240,7 +240,14 @@ def test_streamed_store_memory_contract(tmp_path):
     assert c.metrics_log[-1]["ticks"] == TICKS
 
 
+@pytest.mark.slow
 def test_kill_resume_bit_identical_delta(tmp_path):
+    """Delta-backend kill-mid-flight resume (the ~30 s heavyweight of
+    the fast lane; moved to the nightly slow lane in the PR 10 tier-1
+    rebalance — the wall-clock budget absorbed the failure-model fast
+    smokes).  The resume family keeps its tier-1 representative:
+    ``test_kill_resume_bit_identical_dense`` runs the identical
+    interrupt/resume machinery on the dense backend every push."""
     a = _delta()
     ckpt_a = str(tmp_path / "a.npz")
     whole = a.run_scenario(DSPEC, segment_ticks=DSEG, checkpoint_path=ckpt_a)

@@ -44,3 +44,62 @@ assert trace.loss[-1] == 0.0
 assert "pings_sent" in trace.metrics
 print("scenario smoke OK: converged, trace schema valid")
 EOF
+
+# --- failure-model smoke: asymmetric link + flap storm ----------------
+# One-way link loss toward a victim plus a flap storm must (a) run as
+# one compiled dispatch, (b) produce detection events (the victim and
+# the flappers get declared faulty at least once), and (c) stream the
+# reference-parity bridge keys to --stats-out.
+
+faults_spec="$workdir/faults.json"
+faults_trace="$workdir/faults_trace.npz"
+stats_out="$workdir/faults_stats.jsonl"
+
+cat > "$faults_spec" <<'EOF'
+{
+  "ticks": 80,
+  "events": [
+    {"at": 5,  "op": "link_loss", "src": [0,1,2,3,4,5,6,7],
+     "dst": [14], "p": 0.97, "until": 55},
+    {"at": 6,  "op": "kill", "node": 15},
+    {"at": 8,  "op": "flap", "nodes": [12, 13], "until": 40,
+     "down": 4, "up": 5, "stagger": 2},
+    {"at": 10, "op": "gray", "node": 11, "factor": 5, "until": 60}
+  ]
+}
+EOF
+
+JAX_PLATFORMS=cpu timeout -k 10 600 python -m ringpop_tpu tick-cluster \
+  --backend tpu-sim -n 16 --scenario "$faults_spec" \
+  --trace-out "$faults_trace" --stats-out "$stats_out" \
+  | tee "$workdir/faults_out.log"
+
+grep -q "one dispatch" "$workdir/faults_out.log"
+
+JAX_PLATFORMS=cpu python - "$faults_trace" "$stats_out" <<'EOF'
+import json
+import sys
+from ringpop_tpu.obs import bridge
+from ringpop_tpu.scenarios.trace import Trace
+
+trace = Trace.load(sys.argv[1]).validate()
+assert trace.ticks == 80, trace.ticks
+# the asymmetric incidents produce real detections: the flappers get
+# suspected (and refute on revive), the permanent kill behind the
+# blackhole escalates to faulty
+assert int(trace.metrics["suspects_declared"].sum()) > 0, "no suspects"
+assert int(trace.metrics["faulty_declared"].sum()) > 0, "no detections"
+# every flap kill revived and the blackhole lifted: the cluster heals
+# around the one genuinely dead node
+assert trace.converged[-1], "failure-model scenario did not re-converge"
+assert int(trace.live[-1]) == 15, int(trace.live[-1])
+
+keys = {json.loads(line)["key"] for line in open(sys.argv[2])}
+assert keys, "stats stream is empty"
+missing = [
+    k for k in bridge.REFERENCE_KEYS
+    if f"{bridge.DEFAULT_PREFIX}.{k}" not in keys
+]
+assert not missing, f"bridge keys missing from --stats-out: {missing}"
+print("failure-model smoke OK: detections present, bridge keys complete")
+EOF
