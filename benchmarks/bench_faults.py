@@ -140,6 +140,103 @@ def _dist(ticks: np.ndarray) -> dict:
     }
 
 
+def run_traffic_scorecard(
+    n: int,
+    ticks: int,
+    seed: int,
+    segment_ticks: int | None = None,
+    keys_per_tick: int = 256,
+    buckets: int = 16,
+):
+    """Per-failure-family SERVING scorecard: goodput, request-latency
+    p50/p95/p99, and retry amplification, per backend, streamed.
+
+    Couples the PR-10 failure families to the SLO questions an operator
+    asks of the serving plane (ROADMAP item 3): each family's scenario
+    co-runs a zipf workload with the latency plane on
+    (``traffic/latency.py`` — link RTTs + RETRY_SCHEDULE backoff + gray
+    duty timeouts), streamed as S-tick segments (O(segment) host
+    memory, PR 8), on the dense AND the delta backend (per-link delay
+    rides the delta in-flight claim lanes).  Families whose scenario
+    needs in-scan revive (flap storms, rolling deploys) stay
+    dense-only — the delta revive is a host-side row op."""
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.models.swim_sim import SwimParams
+    from ringpop_tpu.traffic.latency import plane_stats
+
+    if segment_ticks is None:
+        segment_ticks = max(ticks // 4, 1)
+    wl = {
+        "kind": "zipf",
+        "keys_per_tick": keys_per_tick,
+        "pool": 8 * keys_per_tick,
+        "latency_buckets": buckets,
+    }
+    rows = []
+    for fam, spec in _fam_specs(n, ticks).items():
+        for backend in ("dense", "delta"):
+            kw = {} if backend == "dense" else {"capacity": min(2 * n, 1024)}
+            c = SimCluster(
+                n, SwimParams(suspicion_ticks=12), seed=seed,
+                backend=backend, **kw,
+            )
+            t0 = time.perf_counter()
+            try:
+                trace = c.run_scenario(
+                    spec, traffic=dict(wl), segment_ticks=segment_ticks
+                )
+            except NotImplementedError as e:
+                row = {"family": fam, "backend": backend, "n": n,
+                       "skipped": str(e).splitlines()[0]}
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+                continue
+            wall = time.perf_counter() - t0
+            m = trace.metrics
+            lookups = int(m["lookups"].sum())
+            delivered = int(m["delivered"].sum())
+            sends = (
+                int(m["proxy_sends"].sum())
+                + int(m["proxy_retries"].sum())
+                + int(m["handled_local"].sum())
+            )
+            agg = plane_stats(trace)
+            row = {
+                "family": fam,
+                "backend": backend,
+                "n": n,
+                "ticks": ticks,
+                "segment_ticks": segment_ticks,
+                "keys_per_tick": keys_per_tick,
+                "wall_s": round(wall, 2),
+                "goodput": round(delivered / max(lookups, 1), 4),
+                "lat_ms": {k: agg[k] for k in ("median", "p95", "p99")},
+                "lat_ticks_p99": round(agg["p99"] / 200.0, 2),
+                "amplification": round(sends / max(delivered, 1), 3),
+                "gray_timeouts": int(m["gray_timeouts"].sum()),
+                "send_errors": int(m["send_errors"].sum()),
+                "failed": int(m["proxy_failed"].sum()),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    print("\n| family | backend | goodput | lat p50/p95/p99 ms "
+          "| amplification | gray timeouts | failed |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        if "skipped" in r:
+            print(f"| {r['family']} | {r['backend']} | — (skipped: "
+                  f"{r['skipped'][:40]}...) | | | | |")
+            continue
+        lm = r["lat_ms"]
+        print(
+            f"| {r['family']} | {r['backend']} | {r['goodput']:.3f} "
+            f"| {lm['median']:.0f}/{lm['p95']:.0f}/{lm['p99']:.0f} "
+            f"| {r['amplification']:.2f} | {r['gray_timeouts']} "
+            f"| {r['failed']} |"
+        )
+    return rows
+
+
 def run_relay_ab(n: int, ticks: int, seeds: int):
     """Heal-tick A/B of SwimParams.relay_full_sync on a scenario that
     drives probes through the relay while views diverge."""
@@ -196,9 +293,23 @@ def main(argv=None):
                     help="run the relay full-sync A/B instead of the "
                          "family sweeps")
     ap.add_argument("--relay-seeds", type=int, default=3)
+    ap.add_argument("--traffic", action="store_true",
+                    help="run the per-family SERVING scorecard instead: "
+                         "goodput / latency p50-p95-p99 / retry "
+                         "amplification per backend, streamed "
+                         "(SLO latency plane, traffic/latency.py)")
+    ap.add_argument("--segment-ticks", type=int, default=None,
+                    help="--traffic: stream segment size (default ticks/4)")
+    ap.add_argument("--keys-per-tick", type=int, default=256)
     args = ap.parse_args(argv)
     if args.relay_ab:
         run_relay_ab(args.n, args.ticks, args.relay_seeds)
+    elif args.traffic:
+        run_traffic_scorecard(
+            args.n, args.ticks, args.seed,
+            segment_ticks=args.segment_ticks,
+            keys_per_tick=args.keys_per_tick,
+        )
     else:
         run_family_sweeps(args.n, args.ticks, args.replicas, args.seed)
 

@@ -119,9 +119,21 @@ def _compiled_scenario(n: int, ticks: int, base_loss: float):
     return spec, compile_spec(spec, n, base_loss=base_loss)
 
 
+def _traffic_fixture(n: int, buckets: int, m: int = 128):
+    from ringpop_tpu.models import checksum as cksum
+    from ringpop_tpu.traffic.workloads import compile_traffic
+
+    return compile_traffic(
+        {"keys_per_tick": m, "pool": 4 * m, "latency_buckets": buckets},
+        n,
+        cksum.default_addresses(n),
+    )
+
+
 def census_scenario(
     backend: str, n: int, ticks: int, capacity: int,
     segment_ticks: int | None = None,
+    latency_buckets: int = 0,
 ) -> dict:
     """run_scenario: the event-applying scan (runner._scenario_scan).
 
@@ -130,7 +142,14 @@ def census_scenario(
     with a traced tick0 offset — the one executable a whole soak
     re-dispatches.  Its footprint depends only on (backend, n, S),
     never on the total tick count: the CPU-side deliverable of the
-    streaming rework, pinned by tests/test_mem_census.py."""
+    streaming rework, pinned by tests/test_mem_census.py.
+
+    ``latency_buckets=B`` co-compiles a traffic workload with the SLO
+    latency plane on: the program stacks a [ticks, B] histogram plane
+    next to the scalar telemetry, so the whole-horizon row's OUTPUT
+    bytes grow linearly in T (B int32 counters per tick) while the
+    S-shaped segment program's bytes stay flat — the pair the latency
+    footprint pin asserts (tests/test_latency.py)."""
     from ringpop_tpu.scenarios import runner
 
     if backend == "delta":
@@ -139,6 +158,12 @@ def census_scenario(
         state, net, params = _dense_fixture(n)
     swim = params.swim if backend == "delta" else params
     _, compiled = _compiled_scenario(n, ticks, swim.loss)
+    ct = _traffic_fixture(n, latency_buckets) if latency_buckets else None
+    program = "run_scenario+latency" if latency_buckets else "run_scenario"
+    traffic_kw = dict(
+        traffic=ct.static if ct is not None else None,
+    )
+    tr_tensors = ct.tensors if ct is not None else None
     if segment_ticks is None:
         keys = jax.random.split(jax.random.PRNGKey(0), ticks)
         row = _census(
@@ -155,10 +180,12 @@ def census_scenario(
             compiled.p_gid,
             compiled.loss,
             keys,
+            tr_tensors,
             params=params,
             has_revive=compiled.has_revive,
+            **traffic_kw,
         )
-        return {"program": "run_scenario", "backend": backend, "n": n,
+        return {"program": program, "backend": backend, "n": n,
                 "replicas": 1, "ticks": ticks, **row}
     s = min(segment_ticks, ticks)
     keys = jax.random.split(jax.random.PRNGKey(0), s)
@@ -176,12 +203,13 @@ def census_scenario(
         compiled.p_gid,
         compiled.loss[:s],
         keys,
-        None,  # tr_tensors
+        tr_tensors,
         jnp.int32(0),  # tick0 (traced: any segment offset, same program)
         params=params,
         has_revive=compiled.has_revive,
+        **traffic_kw,
     )
-    return {"program": "run_scenario", "backend": backend, "n": n,
+    return {"program": program, "backend": backend, "n": n,
             "replicas": 1, "ticks": ticks, "segment_ticks": s, **row}
 
 
@@ -233,13 +261,17 @@ def run(
     replicas: int = 8,
     programs=("run", "scenario", "sweep"),
     segment_ticks: int | None = None,
+    latency_buckets: int = 0,
 ) -> list[dict]:
     """Every requested census row (the test entry point).
 
     ``segment_ticks`` adds the streamed segment program's row next to
     every whole-horizon ``run_scenario`` row — the pair that shows the
     segment footprint flat in total T while the whole-trace output
-    grows with it."""
+    grows with it.  ``latency_buckets=B`` additionally censuses the
+    traffic+latency-plane variant of each scenario row (the
+    ``run_scenario+latency`` program) — the compiled-bytes cost of the
+    [ticks, B] histogram planes."""
     rows = []
     for backend in backends:
         for n in ns:
@@ -254,6 +286,21 @@ def run(
                             segment_ticks=segment_ticks,
                         )
                     )
+                if latency_buckets:
+                    rows.append(
+                        census_scenario(
+                            backend, n, ticks, capacity,
+                            latency_buckets=latency_buckets,
+                        )
+                    )
+                    if segment_ticks is not None:
+                        rows.append(
+                            census_scenario(
+                                backend, n, ticks, capacity,
+                                segment_ticks=segment_ticks,
+                                latency_buckets=latency_buckets,
+                            )
+                        )
             if "sweep" in programs:
                 rows.append(
                     census_sweep(backend, n, ticks, capacity, replicas)
@@ -280,6 +327,12 @@ def main() -> None:
                     help="also census the streamed S-tick segment program "
                          "next to each run_scenario row (its footprint is "
                          "flat in --ticks; scenarios/stream.py)")
+    ap.add_argument("--latency", type=int, default=0, metavar="B",
+                    help="also census the traffic + SLO-latency-plane "
+                         "scenario program with B log2 buckets "
+                         "(run_scenario+latency rows: the [ticks, B] "
+                         "histogram planes' compiled-bytes cost; "
+                         "traffic/latency.py)")
     args = ap.parse_args()
 
     backends = ("dense", "delta") if args.backend == "both" else (args.backend,)
@@ -287,7 +340,8 @@ def main() -> None:
     programs = tuple(args.programs.split(","))
     for row in run(backends=backends, ns=ns, ticks=args.ticks,
                    capacity=args.capacity, replicas=args.replicas,
-                   programs=programs, segment_ticks=args.segment_ticks):
+                   programs=programs, segment_ticks=args.segment_ticks,
+                   latency_buckets=args.latency):
         print(json.dumps(row), flush=True)
 
 

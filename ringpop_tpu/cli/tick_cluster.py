@@ -471,6 +471,20 @@ class TpuSimCluster(ClusterDriver):
         log = self.cluster.metrics_log[-5:]
         for i, metrics in enumerate(log):
             print(f"  t-{len(log) - i}: {metrics}")
+        # request-latency percentiles next to the protocol counters:
+        # the latest SLO-latency-enabled traffic trace's histogram
+        # plane (traffic/latency.py), whole-run aggregate
+        from ringpop_tpu.traffic.latency import plane_stats
+
+        for trace in reversed(self.cluster.traces):
+            agg = plane_stats(trace)
+            if agg is not None:
+                print(
+                    f"  requestProxy.send: p50={agg['median']:.0f}ms "
+                    f"p95={agg['p95']:.0f}ms p99={agg['p99']:.0f}ms "
+                    f"count={agg['count']}"
+                )
+                break
 
     def debug_set(self, flag: str) -> None:
         print("debug flags are a host-library feature; use metrics_log")
@@ -527,6 +541,7 @@ class TpuSimCluster(ClusterDriver):
         sweep_kill_jitter: list[int] | None = None,
         sweep_flap_jitter: list[int] | None = None,
         traffic: str | None = None,
+        latency_buckets: int = 0,
         segment_ticks: int | None = None,
         checkpoint: str | None = None,
         checkpoint_every: int = 1,
@@ -544,6 +559,14 @@ class TpuSimCluster(ClusterDriver):
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
         spec = ScenarioSpec.load(path)
+        if traffic and latency_buckets:
+            # enable the SLO latency plane on the parsed workload
+            # (compile_traffic pins the tick->ms period to the cluster)
+            from ringpop_tpu.traffic.workloads import WorkloadSpec
+
+            traffic = WorkloadSpec.from_spec(traffic)._replace(
+                latency_buckets=int(latency_buckets)
+            )
         if sweep:
             if traffic:
                 raise ValueError(
@@ -618,6 +641,22 @@ class TpuSimCluster(ClusterDriver):
                 f"{int(m['proxy_failed'].sum())} failed; "
                 f"forward hops {hops}"
             )
+            from ringpop_tpu.traffic.latency import plane_stats
+
+            agg = plane_stats(trace)
+            if agg is not None:
+                delivered = max(int(m["delivered"].sum()), 1)
+                sends = int(m["proxy_sends"].sum()) + int(
+                    m["proxy_retries"].sum()
+                ) + int(m["handled_local"].sum())
+                print(
+                    f"latency: p50={agg['median']:.0f}ms "
+                    f"p95={agg['p95']:.0f}ms p99={agg['p99']:.0f}ms "
+                    f"over {agg['count']} delivered; "
+                    f"retry amplification {sends / delivered:.2f} "
+                    f"sends/delivered, "
+                    f"{int(m['gray_timeouts'].sum())} gray timeouts"
+                )
         if trace_out:
             trace.save(trace_out)
             print(f"trace ({trace.ticks} ticks x "
@@ -772,6 +811,15 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                              "serving counters (lookup, requestProxy.*, "
                              "misroutes, forward hops) join the trace "
                              "and the --stats-out stream")
+    parser.add_argument("--latency-buckets", type=int, default=0, metavar="B",
+                        help="with --traffic: enable the SLO latency plane "
+                             "(traffic/latency.py) — per-request latency "
+                             "(link RTTs + RETRY_SCHEDULE backoff, gray "
+                             "holders time out off their duty phase) lands "
+                             "in B log2 buckets per tick; request-latency "
+                             "p50/p95/p99 join the serving summary, the "
+                             "'p' command, and the requestProxy.send "
+                             "timing stream of --stats-out (0 = off)")
     parser.add_argument("--segment-ticks", type=int, default=None, metavar="S",
                         help="with --scenario: stream the run as pipelined "
                              "S-tick segment dispatches of ONE compiled "
@@ -897,6 +945,9 @@ def main(argv: list[str] | None = None) -> None:
     if args.traffic and args.sweep:
         parser.error("--traffic does not compose with --sweep yet "
                      "(serve traffic on a single-replica scenario)")
+    if args.latency_buckets and not args.traffic:
+        parser.error("--latency-buckets needs --traffic (it extends the "
+                     "serving workload with the SLO latency plane)")
     if args.segment_ticks is not None and not args.scenario:
         parser.error("--segment-ticks needs --scenario (it segments a "
                      "compiled scenario run)")
@@ -953,6 +1004,7 @@ def main(argv: list[str] | None = None) -> None:
                     sweep_kill_jitter=sweep_jitter,
                     sweep_flap_jitter=sweep_fjitter,
                     traffic=args.traffic,
+                    latency_buckets=args.latency_buckets,
                     segment_ticks=args.segment_ticks,
                     checkpoint=args.checkpoint,
                     checkpoint_every=args.checkpoint_every,

@@ -304,8 +304,11 @@ class SimCluster:
             metrics={
                 k: v
                 for k, v in stacks.items()
-                if k not in ("converged", "live", "loss")
+                if k not in ("converged", "live", "loss") and v.ndim == 1
             },
+            # vector outputs (the [ticks, B] latency histogram rows the
+            # SLO plane stacks) ride as planes, not scalar metrics
+            planes={k: v for k, v in stacks.items() if v.ndim == 2},
             converged=stacks["converged"],
             live=stacks["live"],
             loss=stacks["loss"],
@@ -432,8 +435,9 @@ class SimCluster:
             metrics={
                 k: v
                 for k, v in stacks.items()
-                if k not in ("converged", "live", "loss")
+                if k not in ("converged", "live", "loss") and v.ndim == 2
             },
+            planes={k: v for k, v in stacks.items() if v.ndim == 3},
             converged=stacks["converged"],
             live=stacks["live"],
             loss=stacks["loss"],
@@ -599,6 +603,14 @@ class SimCluster:
                     f"this cluster has n={self.n}; re-compile the spec"
                 )
             return spec
+        spec = tworkloads.WorkloadSpec.from_spec(spec)
+        if spec.latency_buckets:
+            # the SLO plane's tick->ms conversion (link delays, the
+            # RETRY_SCHEDULE backoff tick offsets) is THIS cluster's
+            # protocol period — a workload lowered against a cluster
+            # must not keep the spec default (a pre-lowered
+            # CompiledTraffic above keeps whatever it was built with)
+            spec = spec._replace(period_ms=self.params.period_ms)
         return tworkloads.compile_traffic(
             spec, self.n, self.book.addresses, ring=self.traffic_ring()
         )
@@ -783,12 +795,13 @@ class SimCluster:
             d = np.zeros(src.shape[0], np.int32) if d is None else np.asarray(d)
             j = np.zeros(src.shape[0], np.int32) if j is None else np.asarray(j)
             if self.backend == "delta":
-                raise NotImplementedError(
-                    "per-link delay is dense-backend-only"
+                depth = self.state.delay_depth
+            else:
+                depth = (
+                    0
+                    if self.state.pending is None
+                    else self.state.pending.shape[0]
                 )
-            depth = (
-                0 if self.state.pending is None else self.state.pending.shape[0]
-            )
             if int(d.max(initial=0) + j.max(initial=0)) >= max(depth, 1):
                 raise ValueError(
                     f"delay rules need enable_delay(depth > max(d + j)) "
@@ -829,14 +842,19 @@ class SimCluster:
         self.net = self.net._replace(period=period)
 
     def enable_delay(self, depth: int) -> None:
-        """Install the in-flight claim ring buffer (dense backend) so
-        per-link delay rules can defer claims up to ``depth - 1``
-        ticks.  Must run before the first delayed tick: the buffer's
-        presence widens the per-tick PRNG split, so the compiled-scan
-        and host-loop sides both install it at run start
+        """Install the in-flight claim buffer so per-link delay rules
+        can defer claims up to ``depth - 1`` ticks: the dense backend's
+        ``[D, N, N]`` claim matrix, or the delta backend's O(N)-in-
+        cluster-size claim lanes (``swim_delta.install_pending``).
+        Must run before the first delayed tick: the buffer's presence
+        widens the per-tick PRNG split, so the compiled-scan and
+        host-loop sides both install it at run start
         (scenarios/faults.py HostPlan / runner.prepare_faults)."""
         if self.backend == "delta":
-            raise NotImplementedError("per-link delay is dense-backend-only")
+            self.state = sdelta.install_pending(
+                self.state, depth, self.dparams.wire_cap
+            )
+            return
         if depth < 2:
             raise ValueError(f"delay depth must be >= 2 (got {depth})")
         if self.state.pending is not None:

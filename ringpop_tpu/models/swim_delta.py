@@ -117,6 +117,7 @@ from ringpop_tpu.models.swim_sim import (
     _check_inc,
     _distinct_ranks,
     _drop_net,
+    _message_delay,
     _stagger_send_gate,
     _sweep_divisor,
     _validate_params,
@@ -197,10 +198,40 @@ class DeltaState(NamedTuple):
     # compute_slot_base() is the from-scratch oracle.
     d_bpmask: jax.Array | None = None  # bool[N, C]
     d_bprank: jax.Array | None = None  # int32[N, C]
+    # Latency extension (None = disabled, zero cost): the delta
+    # backend's in-flight claim representation for per-link delay
+    # (NetState.link_d/link_j — scenarios/faults.py), replacing the
+    # dense backend's [D, N, N] claim matrix with per-arrival-slot
+    # claim LANES: a message delayed by d ticks at tick t parks its
+    # [W]-wide claim list (the windowed wire payload it would have
+    # merged in-tick) in slot ``(t + d) % D``, lane ``2*(d-1) + kind``
+    # (kind 0 = phase-3 ping payload, 1 = phase-4 ack payload), with
+    # its receiver in ``pend_recv``.  Within one maturity window every
+    # writing tick has a distinct d for a given slot, so the
+    # (slot, lane, sender) cells never collide — no scatter-max over
+    # [N, N] needed.  Slot ``tick % D`` matures at tick start: its
+    # lanes route through ``_route_claims_multi`` (the phase-5
+    # machinery) and merge via ``_merge_claims``; receivers that are
+    # down/suspended lose their matured claims (dense convention).
+    # O(D^2 * W * N) memory — O(N) in the cluster size, the flagship-
+    # scale form the dense [D, N, N] buffer cannot reach.  Presence
+    # widens the per-tick key split (two jitter streams), exactly like
+    # ``ClusterState.pending``; install via ``install_pending`` /
+    # ``SimCluster.enable_delay`` from tick 0.  Network-resident:
+    # kill/revive do NOT clear it.  Documented deviation from dense:
+    # the full-sync path (a structural base flip, not a claim payload)
+    # applies in-tick even over a delayed link.
+    pend_subj: jax.Array | None = None  # int32[D, 2(D-1), N, W]
+    pend_key: jax.Array | None = None  # int32[D, 2(D-1), N, W]
+    pend_recv: jax.Array | None = None  # int32[D, 2(D-1), N] (n = none)
 
     @property
     def n(self) -> int:
         return self.base_key.shape[-1]
+
+    @property
+    def delay_depth(self) -> int:
+        return 0 if self.pend_subj is None else self.pend_subj.shape[0]
 
     @property
     def capacity(self) -> int:
@@ -307,6 +338,65 @@ def init_delta(
         overflow_drops=jnp.zeros((), dtype=jnp.int32),
     )
     return refresh_carried(st)
+
+
+def install_pending(state: DeltaState, depth: int, wire_cap: int) -> DeltaState:
+    """Install the in-flight claim lanes for per-link delay (see the
+    ``DeltaState.pend_*`` docstring).  ``depth`` is the ring depth
+    (``faults.delay_depth``); lane width is the step's effective wire
+    window ``min(wire_cap, capacity)``.  Must happen before the first
+    delayed tick on BOTH the compiled-scan and host-loop sides — the
+    buffer's presence widens the per-tick key split."""
+    if depth < 2:
+        raise ValueError(f"delay depth must be >= 2 (got {depth})")
+    if state.pend_subj is not None:
+        if state.pend_subj.shape[0] != depth:
+            raise ValueError(
+                f"in-flight lanes of depth {state.pend_subj.shape[0]} are "
+                f"already installed (wanted {depth})"
+            )
+        return state
+    n = state.n
+    w_eff = min(int(wire_cap), state.capacity)
+    lanes = 2 * (depth - 1)
+    return state._replace(
+        pend_subj=jnp.full((depth, lanes, n, w_eff), SENTINEL, jnp.int32),
+        pend_key=jnp.zeros((depth, lanes, n, w_eff), jnp.int32),
+        pend_recv=jnp.full((depth, lanes, n), n, jnp.int32),
+    )
+
+
+def _pend_write(
+    st: DeltaState,
+    kind: int,
+    d: jax.Array,  # int32[N] per-sender delay (0 = in-tick, not parked)
+    dly: jax.Array,  # bool[N] sender's message is delayed
+    subj_rows: jax.Array,  # int32[N, W] claim subjects (SENTINEL pad)
+    key_rows: jax.Array,  # int32[N, W]
+    valid_rows: jax.Array,  # bool[N, W]
+    recv: jax.Array,  # int32[N] receiver per sender row
+) -> DeltaState:
+    """Park one phase's delayed claim rows in their (slot, lane) cells.
+
+    Slot ``(tick + d) % D`` with lane ``2*(d-1) + kind`` is collision-
+    free by construction (each writing tick owns a distinct d per slot
+    within a maturity window), so plain scatters suffice; non-delayed
+    rows aim at the out-of-bounds slot D and drop."""
+    n = st.n
+    dd = st.pend_subj.shape[0]
+    lanes = st.pend_subj.shape[1]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    slot = jnp.where(dly, (st.tick + d) % jnp.int32(dd), jnp.int32(dd))
+    lane = jnp.clip(2 * (d - 1) + kind, 0, lanes - 1)
+    keep = valid_rows & dly[:, None]
+    subj = jnp.where(keep, subj_rows, SENTINEL)
+    keyv = jnp.where(keep, key_rows, 0)
+    recv_v = jnp.where(dly & jnp.any(keep, axis=1), recv, jnp.int32(n))
+    return st._replace(
+        pend_subj=st.pend_subj.at[slot, lane, ids].set(subj, mode="drop"),
+        pend_key=st.pend_key.at[slot, lane, ids].set(keyv, mode="drop"),
+        pend_recv=st.pend_recv.at[slot, lane, ids].set(recv_v, mode="drop"),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -1282,11 +1372,11 @@ def delta_step_impl(
             "relay_full_sync is the dense-step fidelity experiment "
             "(SwimParams docstring); the delta relay carries changes only"
         )
-    if net.link_d is not None:
-        raise NotImplementedError(
-            "per-link delay needs the dense in-flight claim buffer "
-            "(ClusterState.pending); the delta backend supports the "
-            "loss-only link rules and per-node periods"
+    if net.link_d is not None and state.pend_subj is None:
+        raise ValueError(
+            "per-link delay needs the in-flight claim lanes "
+            "(DeltaState.pend_*): install them from tick 0 via "
+            "SimCluster.enable_delay / swim_delta.install_pending"
         )
     if net.period is not None and sw.phase_mod != 1:
         raise ValueError(
@@ -1297,7 +1387,63 @@ def delta_step_impl(
     w = params.wire_cap
     ids = jnp.arange(n, dtype=jnp.int32)
     sl_start = _validate_params(n, sw)
-    k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
+    has_delay = state.pend_subj is not None
+    if has_delay:
+        # the two extra streams draw per-message jitter; split width is
+        # keyed on the LANES' presence (not rule activity), mirroring
+        # the dense step, so host-loop and compiled-scan ticks consume
+        # keys identically (scenarios/faults.py HostPlan)
+        k_sel, k_loss1, k_loss2, k_loss3, k_j1, k_j2 = jax.random.split(
+            key, 6
+        )
+    else:
+        k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
+
+    # -- in-flight claims mature (latency model) ----------------------------
+    # Slot ``tick % D`` lands at the START of the tick (the dense
+    # convention): matured claims shape this tick's selection, digests,
+    # and refutations exactly like claims merged last tick.  Down or
+    # suspended receivers lose their matured claims, and the slot is
+    # consumed either way.
+    mat_applied = jnp.int32(0)
+    mat_late = jnp.int32(0)
+    if has_delay:
+        dd = state.pend_subj.shape[0]
+        slot0 = state.tick % jnp.int32(dd)
+        m_subj = state.pend_subj[slot0]  # [L, N, W]
+        m_key = state.pend_key[slot0]
+        m_recv = state.pend_recv[slot0]  # [L, N]
+        can_recv = net.up & net.responsive
+
+        def _mature(st: DeltaState):
+            segs = []
+            for lane in range(m_subj.shape[0]):
+                recv_l = m_recv[lane]
+                recv_c = jnp.clip(recv_l, 0, n - 1)
+                ok = (recv_l < n) & can_recv[recv_c]
+                segs.append(
+                    (
+                        m_subj[lane],
+                        m_key[lane],
+                        (m_subj[lane] < SENTINEL) & ok[:, None],
+                        recv_c,
+                    )
+                )
+            g = _route_claims_multi(n, segs, params.claim_grid)
+            out = _merge_claims(st, g[0], g[1], g[2], sl_start)
+            return out.state, out.applied_points, g[3]
+
+        def _no_mature(st: DeltaState):
+            return st, jnp.int32(0), jnp.int32(0)
+
+        state, mat_applied, mat_late = jax.lax.cond(
+            jnp.any(m_subj < SENTINEL), _mature, _no_mature, state
+        )
+        state = state._replace(
+            pend_subj=state.pend_subj.at[slot0].set(SENTINEL),
+            pend_key=state.pend_key.at[slot0].set(0),
+            pend_recv=state.pend_recv.at[slot0].set(n),
+        )
 
     # -- phases 0-1 ---------------------------------------------------------
     stats = _phase0_stats(state)
@@ -1356,13 +1502,38 @@ def delta_step_impl(
         & ~_drop_net(k_loss1, (n,), sw.loss, net, ids, t_safe)
         & resp[t_safe]
     )
+    # the delivered set (anti-echo reference): a DELAYED claim still
+    # counts as delivered — it is in the network (dense convention)
     sent_valid = (send_subj < SENTINEL) & fwd_ok[:, None]
+    delayed_claims = jnp.int32(0)
+    if has_delay:
+        # latency slows INFORMATION, not liveness: the ping/ack RTT
+        # stays in-tick (fwd_ok/ack/inbound all count every delivered
+        # message) while the claim payload of a delayed link parks in
+        # the lanes and merges d ticks later
+        d3 = _message_delay(net, k_j1, ids, t_safe, (n,))
+        dly3 = fwd_ok & (d3 > 0)
+        sent_merge = (send_subj < SENTINEL) & (fwd_ok & ~dly3)[:, None]
+        delayed_claims = delayed_claims + jnp.sum(
+            sent_valid & dly3[:, None], dtype=jnp.int32
+        )
 
-    any_claims = jnp.any(sent_valid)
+        def park3(st: DeltaState) -> DeltaState:
+            return _pend_write(
+                st, 0, d3, dly3, send_subj, send_key, sent_valid, t_safe
+            )
+
+        state = jax.lax.cond(
+            jnp.any(sent_valid & dly3[:, None]), park3, lambda st: st, state
+        )
+    else:
+        sent_merge = sent_valid
+
+    any_claims = jnp.any(sent_merge)
 
     def ping_merge(st: DeltaState) -> tuple[DeltaState, jax.Array, jax.Array]:
         g_subj, g_key, g_valid, late = _route_claims(
-            n, send_subj, send_key, sent_valid, t_safe, params.claim_grid
+            n, send_subj, send_key, sent_merge, t_safe, params.claim_grid
         )
         out = _merge_claims(st, g_subj, g_key, g_valid, sl_start)
         return out.state, out.applied_points, late
@@ -1373,6 +1544,7 @@ def delta_step_impl(
     state, ping_applied, claims_dropped = jax.lax.cond(
         any_claims, ping_merge, ping_skip, state
     )
+    claims_dropped = claims_dropped + mat_late
     if upto <= 3:
         return cut(state, _t=ping_applied)
 
@@ -1461,7 +1633,27 @@ def delta_step_impl(
     rep_any = jnp.any(a_raw, axis=1)
     full_sync = fwd_ok & ~rep_any & (h_post[t_safe] != h_pre)
     fs_apply = full_sync & ack
-    a_valid = a_raw & ack[:, None]
+    if has_delay:
+        # the reply claims ride the receiver->sender link: delayed ack
+        # payloads park keyed by their own (sender) row and merge d
+        # ticks later; the ack bit itself still lands in-tick.  The
+        # full-sync flip (fs_apply) stays in-tick even over a delayed
+        # link — the documented delta deviation (it is a structural
+        # base flip, not a claim payload the lanes can carry).
+        d4 = _message_delay(net, k_j2, t_safe, ids, (n,))
+        dly4 = ack & (d4 > 0)
+        a_valid = a_raw & (ack & ~dly4)[:, None]
+        a_park = a_raw & dly4[:, None]
+        delayed_claims = delayed_claims + jnp.sum(a_park, dtype=jnp.int32)
+
+        def park4(st: DeltaState) -> DeltaState:
+            return _pend_write(st, 1, d4, dly4, a_subj, a_key, a_raw, ids)
+
+        state = jax.lax.cond(
+            jnp.any(a_park), park4, lambda st: st, state
+        )
+    else:
+        a_valid = a_raw & ack[:, None]
     any_fs = jnp.any(fs_apply)
     any_ack_claims = jnp.any(a_valid) | any_fs
 
@@ -1978,6 +2170,9 @@ def delta_step_impl(
             jnp.sum((state.d_subj < SENTINEL).astype(jnp.int32), axis=1)
         ),
     }
+    if has_delay:
+        metrics["delayed_claims"] = delayed_claims
+        metrics["matured_applied"] = mat_applied
     return state, metrics
 
 

@@ -34,6 +34,15 @@ additionally carry the serving plane's counters:
 | proxy_retries                | increment | requestProxy.retry.attempted |
 | proxy_failed                 | increment | requestProxy.retry.failed    |
 
+SLO-latency-enabled workloads (``WorkloadSpec.latency_buckets > 0``)
+add the request-latency namespace — the failed-send / succeeded-retry
+counters of proxy.py:59 / send.py:90, and the per-tick latency
+histogram rows replayed as timing samples:
+
+| send_errors                  | increment | requestProxy.send.error      |
+| retry_succeeded              | increment | requestProxy.retry.succeeded |
+| lat_hist_ms (trace plane)    | timing    | requestProxy.send            |
+
 with the rest of the traffic series (misroutes, delivered_misroutes,
 ring_divergence, hops0..hopsK, unresolved, dropped ...) flowing as
 ``sim.``-prefixed gauges like every other sim-only series.
@@ -67,16 +76,35 @@ PROTOCOL_COUNTER_KEYS: dict[str, str] = {
 
 # traffic-plane counters (traffic/engine.counter_names) -> the serving
 # layer's reference keys: lookup/lookupn are the index.js lookup stats,
-# the requestProxy.* trio is request_proxy/send.py's retry accounting.
-# Kept out of REFERENCE_KEYS: a scenario without traffic emits none of
-# these (the host stack only emits them when lookups/proxies happen).
+# the requestProxy.* entries are request_proxy send.py/proxy.py retry
+# and send accounting.  Kept out of REFERENCE_KEYS: a scenario without
+# traffic emits none of these (the host stack only emits them when
+# lookups/proxies happen).  The last two flow only from SLO-latency-
+# enabled workloads (WorkloadSpec.latency_buckets > 0) — the bridge is
+# presence-gated per series, so a latency-off trace emits exactly the
+# base set.
 TRAFFIC_COUNTER_KEYS: dict[str, str] = {
     "lookups": "lookup",
     "lookupns": "lookupn",
     "proxy_sends": "requestProxy.send.success",
     "proxy_retries": "requestProxy.retry.attempted",
     "proxy_failed": "requestProxy.retry.failed",
+    # SLO latency plane (traffic/latency.py): failed send attempts
+    # (dead holders + gray timeouts -> proxy.py:59) and
+    # delivered-after-retry (send.py:90)
+    "send_errors": "requestProxy.send.error",
+    "retry_succeeded": "requestProxy.retry.succeeded",
 }
+
+# the serving timing stat: each tick's latency-histogram row replays as
+# ``requestProxy.send`` timing values (bucket-floor ms, at most
+# TIMING_REPLAY_CAP emissions per bucket per tick — statsd timing
+# streams are sampled anyway; exact percentiles come from the trace
+# plane itself, scenarios/trace.py summary / traffic/latency.hist_stats)
+TRAFFIC_TIMING_KEYS: dict[str, str] = {
+    "lat_hist_ms": "requestProxy.send",
+}
+TIMING_REPLAY_CAP = 8
 
 COUNTER_KEYS: dict[str, str] = {
     **PROTOCOL_COUNTER_KEYS,
@@ -101,8 +129,20 @@ REFERENCE_KEYS: tuple[str, ...] = (
     "checksum",
 )
 
-# the serving-plane keys a traffic-coupled scenario additionally emits
-TRAFFIC_KEYS: tuple[str, ...] = tuple(TRAFFIC_COUNTER_KEYS.values())
+# the additional keys an SLO-latency-enabled workload emits
+TRAFFIC_LATENCY_KEYS: tuple[str, ...] = (
+    TRAFFIC_COUNTER_KEYS["send_errors"],
+    TRAFFIC_COUNTER_KEYS["retry_succeeded"],
+    *TRAFFIC_TIMING_KEYS.values(),
+)
+
+# the serving-plane keys EVERY traffic-coupled scenario emits — derived
+# so a future base counter lands here automatically; the latency-gated
+# keys stay out (the smoke/namespace assertions over this tuple must
+# hold for latency-off runs)
+TRAFFIC_KEYS: tuple[str, ...] = tuple(
+    v for v in TRAFFIC_COUNTER_KEYS.values() if v not in TRAFFIC_LATENCY_KEYS
+)
 
 DEFAULT_PREFIX = "ringpop.sim"
 
@@ -232,10 +272,29 @@ def replay_trace(
     live = np.asarray(trace.live, dtype=np.int64)
     converged = np.asarray(trace.converged, dtype=bool)
     loss = np.asarray(trace.loss, dtype=np.float64)
+    # latency-histogram planes replay as timing stats: each nonzero
+    # bucket emits its bucket-floor ms value up to TIMING_REPLAY_CAP
+    # times per tick (bounded call volume; the trace plane keeps the
+    # exact counts)
+    timing_planes = []
+    planes = getattr(trace, "planes", None) or {}
+    for name, key in TRAFFIC_TIMING_KEYS.items():
+        if name in planes:
+            from ringpop_tpu.traffic.latency import bucket_edges_ms
+
+            arr = np.asarray(planes[name], dtype=np.int64)
+            reps = np.concatenate([[0], bucket_edges_ms(arr.shape[1])])
+            timing_planes.append((key, arr, reps))
     calls = calls0
     for t in range(trace.ticks):
         tick_metrics = {k: v[t] for k, v in trace.metrics.items()}
         calls += emit_counters(tick_metrics, sink, live=int(live[t]))
+        for key, arr, reps in timing_planes:
+            row = arr[t]
+            for b in np.flatnonzero(row):
+                for _ in range(min(int(row[b]), TIMING_REPLAY_CAP)):
+                    sink.timing(key, int(reps[b]))
+                    calls += 1
         if t == 0:
             alive = (
                 int(live[0]) if prev_live is None

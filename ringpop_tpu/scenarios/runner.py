@@ -95,19 +95,33 @@ def precheck(
             "backend='dense'"
         )
     if compiled.has_delay:
-        if isinstance(state, DeltaState):
-            raise NotImplementedError(
-                "per-link delay is dense-backend-only (the in-flight "
-                "claim buffer is an [D, N, N] dense tensor); use "
-                "run_host_loop on the dense backend or drop the delay "
-                "events"
-            )
         sw = getattr(params, "swim", params)
         if sw is not None and getattr(sw, "sparse_cap", 0):
             raise NotImplementedError(
                 "per-link delay does not compose with sparse_cap"
             )
-        if (
+        if isinstance(state, DeltaState):
+            # the delta in-flight representation: per-arrival-slot claim
+            # lanes (swim_delta.install_pending) instead of the dense
+            # [D, N, N] claim matrix
+            if state.pend_subj is not None:
+                if state.pend_subj.shape[0] != compiled.delay_depth:
+                    raise ValueError(
+                        f"the cluster carries delta in-flight lanes of "
+                        f"depth {state.pend_subj.shape[0]} but this "
+                        f"scenario needs {compiled.delay_depth}; drain "
+                        "them or start from a fresh cluster"
+                    )
+                w_eff = min(
+                    getattr(params, "wire_cap", 16), state.capacity
+                )
+                if state.pend_subj.shape[-1] != w_eff:
+                    raise ValueError(
+                        f"delta in-flight lanes are {state.pend_subj.shape[-1]} "
+                        f"claims wide but wire_cap lowers {w_eff}-wide "
+                        "messages; re-install the buffer"
+                    )
+        elif (
             state.pending is not None
             and state.pending.shape[0] != compiled.delay_depth
         ):
@@ -299,6 +313,9 @@ def _scenario_scan_impl(
                     else st.view_key,
                     u, r, tr_tensors, t, static=traffic,
                     damped=getattr(st, "damped", None),
+                    # the SLO latency plane reads the tick's ACTIVE link
+                    # rules and period row (ignored when it is off)
+                    net=net, period=per,
                 )
             )
         return (st, u, r, gid, per), y
@@ -353,7 +370,7 @@ def run_compiled(
         )
     if adj is None:
         adj = precheck(state, net, compiled, params)
-    state, period = prepare_faults(state, net, compiled)
+    state, period = prepare_faults(state, net, compiled, params)
     _dispatches += 1
     meta = {
         "backend": "delta" if isinstance(state, DeltaState) else "dense",
@@ -393,7 +410,8 @@ def run_compiled(
 
 
 def prepare_faults(
-    state: Any, net: NetState, compiled: CompiledScenario
+    state: Any, net: NetState, compiled: CompiledScenario,
+    params: Any | None = None,
 ) -> tuple[Any, jax.Array | None]:
     """Pre-scan failure-model setup shared by the one-dispatch runner,
     the sweep, and the streamed runner: install the in-flight claim
@@ -401,13 +419,22 @@ def prepare_faults(
     widens the step's key split, mirroring ``HostPlan.prepare``), and
     produce the initial per-node period carry row (the cluster's
     standing row, or all-ones when the scenario introduces gray
-    periods to a lockstep cluster)."""
-    if compiled.has_delay and state.pending is None:
-        state = state._replace(
-            pending=jnp.zeros(
-                (compiled.delay_depth, compiled.n, compiled.n), jnp.int32
+    periods to a lockstep cluster).  ``params`` sizes the delta
+    backend's in-flight lanes (wire_cap)."""
+    if compiled.has_delay:
+        if isinstance(state, DeltaState):
+            if state.pend_subj is None:
+                state = sdelta.install_pending(
+                    state,
+                    compiled.delay_depth,
+                    getattr(params, "wire_cap", 16),
+                )
+        elif state.pending is None:
+            state = state._replace(
+                pending=jnp.zeros(
+                    (compiled.delay_depth, compiled.n, compiled.n), jnp.int32
+                )
             )
-        )
     period = net.period
     if compiled.has_gray and period is None:
         period = jnp.ones((compiled.n,), jnp.int32)

@@ -50,7 +50,9 @@ compiles them, docs/simulation.md documents the host conventions):
   links land ``delay + U{0..jitter}`` ticks later (0 = immediate)
   during ``[at, until)``; the ping/ack RTT itself still completes
   in-tick (the simulation's time-compression convention — latency
-  slows information, not liveness).  Dense backend only.
+  slows information, not liveness).  Both backends: the dense
+  ``[D, N, N]`` in-flight claim matrix, or the delta backend's
+  per-arrival-slot claim lanes (``swim_delta.install_pending``).
 * ``flap`` — kill/revive duty cycles: each node in ``nodes`` (offset
   ``stagger`` ticks apart) is killed for ``down`` ticks then up for
   ``up`` ticks, cycling while the kill tick is < ``until``; every kill

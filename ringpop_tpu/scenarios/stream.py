@@ -504,7 +504,9 @@ def _drive(
     tr_tensors = traffic.tensors if traffic is not None else None
     static_traffic = traffic.static if traffic is not None else None
     sink = cluster.stats_sink
-    f_state, period0 = srunner.prepare_faults(cluster.state, cluster.net, compiled)
+    f_state, period0 = srunner.prepare_faults(
+        cluster.state, cluster.net, compiled, params
+    )
     carry = (f_state, cluster.net.up, cluster.net.responsive, adj, period0)
     pending: tuple | None = None
     slabs: list[Trace] = []  # only populated when there is no store
@@ -564,8 +566,9 @@ def _drive(
             metrics={
                 k: v
                 for k, v in stacks.items()
-                if k not in ("converged", "live", "loss")
+                if k not in ("converged", "live", "loss") and v.ndim == 1
             },
+            planes={k: v for k, v in stacks.items() if v.ndim == 2},
             converged=stacks["converged"],
             live=stacks["live"],
             loss=stacks["loss"],
@@ -764,7 +767,9 @@ def run_sweep_streamed(
     start_tick = int(cluster.state.tick)
     led = default_ledger()
     r = cs.replicas
-    f_state, period0 = srunner.prepare_faults(cluster.state, cluster.net, cs.base)
+    f_state, period0 = srunner.prepare_faults(
+        cluster.state, cluster.net, cs.base, params
+    )
     carry = (
         ssweep._broadcast_replicas(f_state, r),
         ssweep._broadcast_replicas(cluster.net.up, r),
@@ -848,8 +853,9 @@ def run_sweep_streamed(
             metrics={
                 k: v
                 for k, v in stacks.items()
-                if k not in ("converged", "live", "loss")
+                if k not in ("converged", "live", "loss") and v.ndim == 2
             },
+            planes={k: v for k, v in stacks.items() if v.ndim == 3},
             converged=stacks["converged"],
             live=stacks["live"],
             loss=stacks["loss"],

@@ -461,7 +461,7 @@ def run_sweep_compiled(
             f"({cs.replicas} replicas, {cs.base.ticks} ticks)"
         )
     adj = runner.precheck(state, net, cs.base, params)
-    state, period = runner.prepare_faults(state, net, cs.base)
+    state, period = runner.prepare_faults(state, net, cs.base, params)
     r = cs.replicas
     batched = [
         _broadcast_replicas(state, r),
@@ -539,8 +539,11 @@ class SweepTrace:
         flap_jitter: Sequence[int] | None = None,
         start_tick: int = 0,
         spec: dict[str, Any] | None = None,
+        planes: dict[str, np.ndarray] | None = None,
     ):
         self.metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        # histogram planes: [R, ticks, B] rows (scenarios/trace.py)
+        self.planes = {k: np.asarray(v) for k, v in (planes or {}).items()}
         self.converged = np.asarray(converged, dtype=bool)
         self.live = np.asarray(live, dtype=np.int32)
         self.loss = np.asarray(loss, dtype=np.float32)
@@ -577,6 +580,11 @@ class SweepTrace:
         for name, arr in self.metrics.items():
             if arr.shape != (r, t):
                 raise ValueError(f"sweep metric {name!r} is not [{r}, {t}]-shaped")
+        for name, arr in self.planes.items():
+            if arr.ndim != 3 or arr.shape[:2] != (r, t):
+                raise ValueError(
+                    f"sweep plane {name!r} is not [{r}, {t}, B]-shaped"
+                )
         if self.replica_keys.shape[0] != r:
             raise ValueError("replica_keys does not cover every replica")
         if (
@@ -606,6 +614,7 @@ class SweepTrace:
             ).to_dict()
         return Trace(
             metrics={k: v[r] for k, v in self.metrics.items()},
+            planes={k: v[r] for k, v in self.planes.items()},
             converged=self.converged[r],
             live=self.live[r],
             loss=self.loss[r],
@@ -635,6 +644,8 @@ class SweepTrace:
                 raise ValueError("slabs disagree on n/backend")
             if set(s.metrics) != set(first.metrics):
                 raise ValueError("slabs disagree on metric series")
+            if set(s.planes) != set(first.planes):
+                raise ValueError("slabs disagree on histogram planes")
             if (
                 s.replicas != first.replicas
                 or not np.array_equal(s.replica_keys, first.replica_keys)
@@ -653,6 +664,10 @@ class SweepTrace:
             metrics={
                 k: np.concatenate([s.metrics[k] for s in slabs], axis=1)
                 for k in first.metrics
+            },
+            planes={
+                k: np.concatenate([s.planes[k] for s in slabs], axis=1)
+                for k in first.planes
             },
             converged=np.concatenate([s.converged for s in slabs], axis=1),
             live=np.concatenate([s.live for s in slabs], axis=1),
@@ -719,6 +734,8 @@ class SweepTrace:
         }
         for name, arr in self.metrics.items():
             arrays[f"{prefix}m.{name}"] = arr
+        for name, arr in self.planes.items():
+            arrays[f"{prefix}p.{name}"] = arr
         return arrays
 
     def meta(self) -> dict[str, Any]:
@@ -738,13 +755,20 @@ class SweepTrace:
     def from_arrays(
         cls, data: Any, meta: dict[str, Any], prefix: str = ""
     ) -> "SweepTrace":
+        keys = list(getattr(data, "files", data.keys()))
         metrics = {
             key[len(prefix) + 2:]: np.asarray(data[key])
-            for key in getattr(data, "files", data.keys())
+            for key in keys
             if key.startswith(f"{prefix}m.")
+        }
+        planes = {
+            key[len(prefix) + 2:]: np.asarray(data[key])
+            for key in keys
+            if key.startswith(f"{prefix}p.")
         }
         return cls(
             metrics=metrics,
+            planes=planes,
             converged=np.asarray(data[f"{prefix}converged"]),
             live=np.asarray(data[f"{prefix}live"]),
             loss=np.asarray(data[f"{prefix}loss"]),

@@ -39,8 +39,15 @@ class Trace:
         backend: str,
         start_tick: int = 0,
         spec: dict[str, Any] | None = None,
+        planes: dict[str, np.ndarray] | None = None,
     ):
         self.metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        # histogram planes: [ticks, B] per-tick counter ROWS (the SLO
+        # latency plane's log2 buckets, traffic/latency.py) — vector
+        # series next to the scalar metrics, same tick axis
+        self.planes = {
+            k: np.asarray(v) for k, v in (planes or {}).items()
+        }
         self.converged = np.asarray(converged, dtype=bool)
         self.live = np.asarray(live, dtype=np.int32)
         self.loss = np.asarray(loss, dtype=np.float32)
@@ -70,6 +77,11 @@ class Trace:
         for name, arr in self.metrics.items():
             if arr.ndim != 1 or arr.shape[0] != t:
                 raise ValueError(f"trace metric {name!r} is not [{t}]-shaped")
+        for name, arr in self.planes.items():
+            if arr.ndim != 2 or arr.shape[0] != t:
+                raise ValueError(
+                    f"trace plane {name!r} is not [{t}, B]-shaped"
+                )
         if not np.all((self.live >= 0) & (self.live <= self.n)):
             raise ValueError("trace live counts outside [0, n]")
         return self
@@ -95,6 +107,13 @@ class Trace:
             "final": bool(self.converged[-1]),
             "first_tick": self.first_converged_tick(),
         }
+        if self.planes:
+            # histogram planes summarize as percentile estimates of
+            # their whole-run bucket aggregate (bucket-floor values)
+            from ringpop_tpu.traffic.latency import hist_stats
+
+            for name, arr in self.planes.items():
+                out[name] = hist_stats(arr.sum(axis=0))
         return out
 
     @classmethod
@@ -114,6 +133,8 @@ class Trace:
                 raise ValueError("slabs disagree on n/backend")
             if set(s.metrics) != set(first.metrics):
                 raise ValueError("slabs disagree on metric series")
+            if set(s.planes) != set(first.planes):
+                raise ValueError("slabs disagree on histogram planes")
             if s.start_tick != expect:
                 raise ValueError(
                     f"slab at start_tick {s.start_tick} is not contiguous "
@@ -124,6 +145,10 @@ class Trace:
             metrics={
                 k: np.concatenate([s.metrics[k] for s in slabs])
                 for k in first.metrics
+            },
+            planes={
+                k: np.concatenate([s.planes[k] for s in slabs])
+                for k in first.planes
             },
             converged=np.concatenate([s.converged for s in slabs]),
             live=np.concatenate([s.live for s in slabs]),
@@ -144,6 +169,8 @@ class Trace:
         }
         for name, arr in self.metrics.items():
             arrays[f"{prefix}m.{name}"] = arr
+        for name, arr in self.planes.items():
+            arrays[f"{prefix}p.{name}"] = arr
         return arrays
 
     def meta(self) -> dict[str, Any]:
@@ -159,13 +186,20 @@ class Trace:
     def from_arrays(
         cls, data: Any, meta: dict[str, Any], prefix: str = ""
     ) -> "Trace":
+        keys = list(getattr(data, "files", data.keys()))
         metrics = {
             key[len(prefix) + 2:]: np.asarray(data[key])
-            for key in getattr(data, "files", data.keys())
+            for key in keys
             if key.startswith(f"{prefix}m.")
+        }
+        planes = {
+            key[len(prefix) + 2:]: np.asarray(data[key])
+            for key in keys
+            if key.startswith(f"{prefix}p.")
         }
         return cls(
             metrics=metrics,
+            planes=planes,
             converged=np.asarray(data[f"{prefix}converged"]),
             live=np.asarray(data[f"{prefix}live"]),
             loss=np.asarray(data[f"{prefix}loss"]),

@@ -22,7 +22,9 @@ from ringpop_tpu.traffic.engine import (  # noqa: F401
     in_ring_from_rows,
     lookup_masked_idx,
     lookup_n_masked_idx,
+    plane_names,
     sample_tick,
     serve_once,
     serve_tick,
 )
+from ringpop_tpu.traffic import latency  # noqa: F401

@@ -27,13 +27,14 @@ gauge — the serving-plane observables during kills/partitions/heals.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-from ringpop_tpu.models.swim_sim import ALIVE, SUSPECT
+from ringpop_tpu.models.swim_sim import ALIVE, SUSPECT, _link_delay_bounds
 from ringpop_tpu.ops.ring_ops import DeviceRing, lookup_n_idx
+from ringpop_tpu.traffic import latency as tlat
 
 
 class TrafficStatic(NamedTuple):
@@ -44,6 +45,15 @@ class TrafficStatic(NamedTuple):
     window: int  # masked-walk width over the global ring
     every: int  # serve on ticks where tick % every == 0
     lookup_n: int  # >0: also resolve n-wide preference lists
+    # SLO latency plane (traffic/latency.py).  0 = off: the compiled
+    # program (and every counter) is bit-identical to the pre-latency
+    # engine.  B > 0 accumulates per-request end-to-end latency into a
+    # [B] log2-bucket histogram per tick, charges RETRY_SCHEDULE
+    # backoff per consumed retry, and makes GRAY holders time out when
+    # a send lands off their duty phase (period row) — the retry-storm
+    # mechanism.
+    latency_buckets: int = 0
+    period_ms: int = 200  # tick -> ms conversion for link delays/backoff
 
 
 class TrafficTensors(NamedTuple):
@@ -155,10 +165,25 @@ def counter_names(static: TrafficStatic) -> tuple[str, ...]:
     names += [f"hops{h}" for h in range(static.max_retries + 2)]
     if static.lookup_n:
         names += ["lookupns", "lookupn_incomplete"]
+    if static.latency_buckets:
+        # the SLO scalars ride only latency-enabled programs so a
+        # latency-off trace keeps the exact legacy schema
+        names += ["send_errors", "retry_succeeded", "gray_timeouts",
+                  "lat_count", "lat_sum_ms", "lat_max_ms"]
     return tuple(names)
 
 
-def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None):
+def plane_names(static: TrafficStatic) -> tuple[tuple[str, int], ...]:
+    """The per-tick VECTOR series (``(name, width)``) a workload adds to
+    the telemetry stacks — the trace-plane schema ([ticks, width] after
+    the scan stacks them; scenarios/trace.py carries them as planes)."""
+    if static.latency_buckets:
+        return (("lat_hist_ms", static.latency_buckets),)
+    return ()
+
+
+def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
+                net=None, period=None):
     n = view_rows.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
     rh, ro = tensors.ring_hashes, tensors.ring_owners
@@ -197,37 +222,150 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None):
     # Trip count max_retries+1: the holder reached by the last allowed
     # retry still gets its settle check.
     active = resolved & ~handled_local
-    carry = (
-        jnp.where(active, owner0, viewer),  # current holder
-        handled_local,  # settled
-        active,
-        jnp.where(handled_local, viewer, -1),  # final handler
-        jnp.zeros(static.m, dtype=jnp.int32),  # retries consumed
-        active.astype(jnp.int32),  # forwards sent (first send counted)
-        unresolved,
-    )
+    lat_extras: dict[str, jax.Array] = {}
+    if not static.latency_buckets:
+        carry = (
+            jnp.where(active, owner0, viewer),  # current holder
+            handled_local,  # settled
+            active,
+            jnp.where(handled_local, viewer, -1),  # final handler
+            jnp.zeros(static.m, dtype=jnp.int32),  # retries consumed
+            active.astype(jnp.int32),  # forwards sent (first send counted)
+            unresolved,
+        )
 
-    def hop(_, c):
-        h, settled, act, final, retries, forwards, unres = c
-        hc = jnp.clip(h, 0, n - 1)
-        has_retry = retries < static.max_retries
-        alive_h = gossip[hc]
-        retry_dead = act & ~alive_h & has_retry  # failed send, re-sent
-        nxt, f = lookup_masked_idx(rh, ro, khash, mask_all[hc], window=w)
-        done = act & alive_h & f & (nxt == h)
-        settled = settled | done
-        final = jnp.where(done, h, final)
-        unres = unres | (act & alive_h & ~f)
-        go = act & alive_h & f & (nxt != h) & has_retry  # reroute
-        stepped = (go | retry_dead).astype(jnp.int32)
-        retries = retries + stepped
-        forwards = forwards + stepped
-        h = jnp.where(go, nxt, h)
-        return (h, settled, go | retry_dead, final, retries, forwards, unres)
+        def hop(_, c):
+            h, settled, act, final, retries, forwards, unres = c
+            hc = jnp.clip(h, 0, n - 1)
+            has_retry = retries < static.max_retries
+            alive_h = gossip[hc]
+            retry_dead = act & ~alive_h & has_retry  # failed send, re-sent
+            nxt, f = lookup_masked_idx(rh, ro, khash, mask_all[hc], window=w)
+            done = act & alive_h & f & (nxt == h)
+            settled = settled | done
+            final = jnp.where(done, h, final)
+            unres = unres | (act & alive_h & ~f)
+            go = act & alive_h & f & (nxt != h) & has_retry  # reroute
+            stepped = (go | retry_dead).astype(jnp.int32)
+            retries = retries + stepped
+            forwards = forwards + stepped
+            h = jnp.where(go, nxt, h)
+            return (h, settled, go | retry_dead, final, retries, forwards, unres)
 
-    h, settled, act, final, retries, forwards, unresolved = jax.lax.fori_loop(
-        0, static.max_retries + 1, hop, carry
-    )
+        h, settled, act, final, retries, forwards, unresolved = (
+            jax.lax.fori_loop(0, static.max_retries + 1, hop, carry)
+        )
+    else:
+        # -- the SLO latency chain (traffic/latency.py) -------------------
+        # Same forward-chain topology as the plain loop (without gray
+        # holders or delay rules the retry/settle decisions are
+        # identical), plus: per-attempt one-way link latency, the
+        # reference RETRY_SCHEDULE backoff per consumed retry, and gray
+        # timeouts — a send landing on a gray holder OFF its duty phase
+        # (evaluated at the request's backoff-advanced effective tick)
+        # fails like a dead send, holds the holder, and drains budget.
+        b = static.latency_buckets
+        a_max = static.max_retries + 1  # send attempts per request
+        kf, kr = jax.random.split(tlat.latency_key(tensors.key, t))
+        u_fwd = jax.random.uniform(kf, (a_max, static.m))
+        u_ret = jax.random.uniform(kr, (static.m,))
+        bo_ms = jnp.asarray(tlat.backoff_ms_schedule(static.max_retries))
+        bo_ticks = jnp.asarray(
+            tlat.backoff_tick_offsets(static.max_retries, static.period_ms)
+        )
+
+        def oneway(src, dst, u):
+            """One-way link latency in ms: the active delay rules'
+            (base, jitter) maxima at the (src, dst) pair, one uniform
+            jitter draw — zero when the run has no delay rules."""
+            if net is None or net.link_d is None:
+                return jnp.zeros(jnp.shape(u), jnp.int32)
+            base, bound = _link_delay_bounds(net, src, dst)
+            return tlat.jitter_ms(u, base, bound, static.period_ms)
+
+        lat0 = jnp.where(
+            active, oneway(viewer, jnp.clip(owner0, 0, n - 1), u_fwd[0]), 0
+        )
+        carry = (
+            jnp.where(active, owner0, viewer),  # current holder
+            handled_local,  # settled (local handling has zero latency)
+            active,
+            jnp.where(handled_local, viewer, -1),  # final handler
+            jnp.zeros(static.m, dtype=jnp.int32),  # retries consumed
+            active.astype(jnp.int32),  # forwards sent (first send counted)
+            unresolved,
+            jnp.where(active, viewer, -1),  # sender of the in-flight attempt
+            lat0,  # accumulated latency, ms
+            jnp.int32(0),  # gray timeouts (events)
+            jnp.int32(0),  # failed send attempts (dead + gray)
+        )
+
+        def hop_lat(i, c):
+            (h, settled, act, final, retries, forwards, unres, sender, lat,
+             gray_to, send_err) = c
+            hc = jnp.clip(h, 0, n - 1)
+            has_retry = retries < static.max_retries
+            alive_h = gossip[hc]
+            # effective tick: the serve tick advanced by the backoff the
+            # request has already slept through — a gray holder's duty
+            # phase is re-evaluated there, so a backed-off retry can
+            # land on-duty (the drain path of a gray retry storm)
+            te = t + bo_ticks[jnp.clip(retries, 0, static.max_retries)]
+            on_duty = tlat.duty_on(hc, te, period)
+            serves = act & alive_h & on_duty
+            timeout = act & alive_h & ~on_duty
+            dead = act & ~alive_h
+            gray_to = gray_to + jnp.sum(timeout, dtype=jnp.int32)
+            send_err = send_err + jnp.sum(dead | timeout, dtype=jnp.int32)
+            nxt, f = lookup_masked_idx(rh, ro, khash, mask_all[hc], window=w)
+            done = serves & f & (nxt == h)
+            settled = settled | done
+            final = jnp.where(done, h, final)
+            unres = unres | (serves & ~f)
+            go = serves & f & (nxt != h) & has_retry  # reroute
+            retry_same = (dead | timeout) & has_retry  # frozen view resend
+            stepping = go | retry_same
+            # the consumed retry: schedule-slot backoff + the new
+            # attempt's forward leg (reroutes forward from the holder,
+            # same-dest retries resend over the same link, fresh draw)
+            bo = bo_ms[jnp.clip(retries, 0, bo_ms.shape[0] - 1)]
+            new_sender = jnp.where(go, h, sender)
+            new_holder = jnp.where(go, nxt, h)
+            fwd = oneway(
+                jnp.clip(new_sender, 0, n - 1),
+                jnp.clip(new_holder, 0, n - 1),
+                u_fwd[jnp.minimum(i + 1, a_max - 1)],
+            )
+            lat = lat + jnp.where(stepping, bo + fwd, 0)
+            stepped = stepping.astype(jnp.int32)
+            retries = retries + stepped
+            forwards = forwards + stepped
+            h = jnp.where(stepping, new_holder, h)
+            sender = jnp.where(stepping, new_sender, sender)
+            return (h, settled, stepping, final, retries, forwards, unres,
+                    sender, lat, gray_to, send_err)
+
+        (h, settled, act, final, retries, forwards, unresolved, sender, lat,
+         gray_to, send_err) = jax.lax.fori_loop(
+            0, static.max_retries + 1, hop_lat, carry
+        )
+        # delivered proxied requests pay the return leg from their final
+        # handler back to the arrival viewer (one draw per request)
+        proxied_done = settled & ~handled_local
+        ret = oneway(jnp.clip(final, 0, n - 1), viewer, u_ret)
+        lat = jnp.where(proxied_done, lat + ret, lat)
+        lat = jnp.where(settled, lat, 0)
+        lat_extras = {
+            "send_errors": send_err,
+            "retry_succeeded": jnp.sum(
+                settled & (retries > 0), dtype=jnp.int32
+            ),
+            "gray_timeouts": gray_to,
+            "lat_count": jnp.sum(settled, dtype=jnp.int32),
+            "lat_sum_ms": jnp.sum(jnp.where(settled, lat, 0), dtype=jnp.int32),
+            "lat_max_ms": jnp.max(jnp.where(settled, lat, 0), initial=0),
+            "lat_hist_ms": tlat.bucket_counts(lat, settled, b),
+        }
 
     def count(mask):
         return jnp.sum(mask, dtype=jnp.int32)
@@ -262,7 +400,19 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None):
         )
         out["lookupns"] = count(served)
         out["lookupn_incomplete"] = count(served & ~complete)
+    out.update(lat_extras)
     return out
+
+
+def _zero_counters(static: TrafficStatic) -> dict[str, jax.Array]:
+    """The off-cadence tick's outputs: scalar zeros per counter plus a
+    zero row per histogram plane (shapes must match the served branch)."""
+    zeros: dict[str, jax.Array] = {
+        k: jnp.int32(0) for k in counter_names(static)
+    }
+    for name, width in plane_names(static):
+        zeros[name] = jnp.zeros((width,), jnp.int32)
+    return zeros
 
 
 def serve_tick(
@@ -274,11 +424,14 @@ def serve_tick(
     *,
     static: TrafficStatic,
     damped: jax.Array | None = None,
+    net: Any | None = None,
+    period: jax.Array | None = None,
 ) -> dict[str, jax.Array]:
     """One traffic tick's counters (int32 scalars, ``counter_names``
-    schema) against the given membership views.  Traceable: composes
-    into the scenario scan (scenarios/runner.py) or jits standalone
-    (``serve_once``).
+    schema, plus the ``plane_names`` histogram rows when the latency
+    plane is on) against the given membership views.  Traceable:
+    composes into the scenario scan (scenarios/runner.py) or jits
+    standalone (``serve_once``).
 
     ``view_rows`` is the int32[N, N] packed view table, or a zero-arg
     callable producing it — pass a callable when the rows are derived
@@ -286,17 +439,24 @@ def serve_tick(
     INSIDE the on-cadence branch, so off-cadence ticks
     (``t % every != 0``) report zeros without materializing anything.
     ``damped`` (bool[N, N] or None) quarantines flap-damped members
-    from per-viewer rings, matching the host ``ring_for``."""
+    from per-viewer rings, matching the host ``ring_for``.
+
+    ``net`` (the tick's ``NetState`` with its ACTIVE link rules) and
+    ``period`` (the int32[N] per-node period row, or None) feed the SLO
+    latency plane only — with ``static.latency_buckets == 0`` they are
+    ignored and the program is exactly the legacy one."""
     get_rows = view_rows if callable(view_rows) else (lambda: view_rows)
     if static.every == 1:
         return _serve_impl(
-            get_rows(), up, responsive, tensors, t, static, damped
+            get_rows(), up, responsive, tensors, t, static, damped,
+            net=net, period=period,
         )
-    zeros = {k: jnp.int32(0) for k in counter_names(static)}
+    zeros = _zero_counters(static)
     return jax.lax.cond(
         t % static.every == 0,
         lambda _: _serve_impl(
-            get_rows(), up, responsive, tensors, t, static, damped
+            get_rows(), up, responsive, tensors, t, static, damped,
+            net=net, period=period,
         ),
         lambda _: zeros,
         None,
@@ -313,10 +473,13 @@ def serve_once(
     *,
     static: TrafficStatic,
     damped: jax.Array | None = None,
+    net: Any | None = None,
+    period: jax.Array | None = None,
 ) -> dict[str, jax.Array]:
     """The standalone jitted entry: ONE dispatch serves one traffic
     tick against a snapshot of membership state (benchmarks, ad-hoc
     serving against a live ``SimCluster``)."""
     return serve_tick(
-        view_rows, up, responsive, tensors, t, static=static, damped=damped
+        view_rows, up, responsive, tensors, t, static=static, damped=damped,
+        net=net, period=period,
     )

@@ -1,0 +1,187 @@
+"""The SLO latency model: request latency, retry backoff, log2 buckets.
+
+The serving engine (``traffic/engine.py``) answers *where* requests
+went; this module defines *how long they took*.  A request's
+end-to-end latency is accumulated INSIDE the jitted serve chain from
+the only latency sources the simulation models:
+
+* **per-link one-way delays** drawn from the failure model's
+  delay/jitter rules (``NetState.link_d``/``link_j``,
+  scenarios/faults.py): every send attempt from ``a`` to ``b`` adds
+  ``period_ms * (base(a, b) + U{0..jitter(a, b)})`` milliseconds, and
+  a delivered request adds one return leg from its final handler back
+  to the arrival viewer;
+* **retry backoff** per the reference request proxy
+  (``request_proxy/send.py`` ``RETRY_SCHEDULE`` = 0 / 1 / 3.5 s,
+  retries past the schedule reuse its last slot): every consumed retry
+  — a reroute, a failed send to a dead holder, or a gray holder's
+  timeout — adds its schedule slot in milliseconds, and advances the
+  request's *effective tick* by the cumulative backoff (so a retry
+  against a gray holder lands on a later duty phase, the mechanism
+  that lets retry storms against gray nodes eventually drain).
+
+Latencies are exact int32 milliseconds and land in fixed ``[B]``
+log2-bucket counter tensors (bucket 0 holds exactly-zero latency,
+bucket b >= 1 holds ``2^(b-1) <= ms < 2^b``, the last bucket is
+open-ended) — no per-request host lists, so a million-key tick costs
+one [B] row of trace output.  Bucketization is integer compares
+against power-of-two edges, which is what makes the compiled
+histogram bit-identical to the host-oracle walk (tests/test_latency.py).
+
+The per-tick jitter draws come from their own PRNG stream derived from
+the WORKLOAD key (``latency_key``) — like the workload sampler itself,
+adding the latency plane can never perturb the protocol trajectory.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.request_proxy.send import RETRY_SCHEDULE
+
+# domain-separation tag of the latency PRNG stream (folded into the
+# workload key before the tick fold — never collides with sample_tick's
+# per-tick stream, which folds the tick directly)
+_LATENCY_STREAM_TAG = 0x5A10
+
+# the open-ended top bucket must fit int32 millisecond values
+MAX_BUCKETS = 32
+
+
+def backoff_ms_schedule(max_retries: int) -> np.ndarray:
+    """int32[max(max_retries, 1)]: the backoff (ms) charged by retry i
+    (0-indexed) — ``RETRY_SCHEDULE`` seconds, last slot repeated for
+    retries past the schedule (send.py ``max_retry_timeout``)."""
+    slots = max(int(max_retries), 1)
+    sched = [
+        int(RETRY_SCHEDULE[min(i, len(RETRY_SCHEDULE) - 1)] * 1000)
+        for i in range(slots)
+    ]
+    return np.asarray(sched, dtype=np.int32)
+
+
+def backoff_tick_offsets(max_retries: int, period_ms: int) -> np.ndarray:
+    """int32[max_retries + 1]: a request's effective-tick offset after
+    consuming r retries — cumulative backoff milliseconds floored to
+    protocol ticks.  Entry 0 (no retry yet) is 0."""
+    ms = backoff_ms_schedule(max_retries)
+    cum = np.concatenate([[0], np.cumsum(ms)]).astype(np.int64)
+    return (cum[: max(int(max_retries), 0) + 1] // max(int(period_ms), 1)).astype(
+        np.int32
+    )
+
+
+def bucket_edges_ms(buckets: int) -> np.ndarray:
+    """int64[buckets - 1] lower edges of buckets 1.. (bucket 0 is the
+    exactly-zero bucket): 1, 2, 4, ... 2^(B-2)."""
+    return 2 ** np.arange(int(buckets) - 1, dtype=np.int64)
+
+
+def bucket_index(ms: Any, buckets: int) -> Any:
+    """Bucket per value: 0 for ms <= 0, else ``floor(log2(ms)) + 1``
+    clamped to ``buckets - 1`` — computed as integer compares against
+    the power-of-two edges (exact on device and host alike)."""
+    edges = bucket_edges_ms(buckets).astype(np.int32)
+    if isinstance(ms, jax.Array):
+        return jnp.sum(
+            ms[..., None] >= jnp.asarray(edges), axis=-1, dtype=jnp.int32
+        )
+    ms = np.asarray(ms, dtype=np.int64)
+    return np.sum(ms[..., None] >= edges, axis=-1).astype(np.int32)
+
+
+def bucket_counts(ms: jax.Array, valid: jax.Array, buckets: int) -> jax.Array:
+    """int32[buckets]: histogram of the valid entries' millisecond
+    values (one-hot sum — a fixed counter tensor, no host lists)."""
+    idx = bucket_index(ms, buckets)
+    onehot = (
+        idx[:, None] == jnp.arange(int(buckets), dtype=jnp.int32)[None, :]
+    ) & valid[:, None]
+    return jnp.sum(onehot, axis=0, dtype=jnp.int32)
+
+
+def latency_key(workload_key: jax.Array, t: jax.Array) -> jax.Array:
+    """The tick's latency PRNG key: a stream separated from the
+    sampler's ``fold_in(key, t)`` by a domain tag, so enabling the
+    plane never changes which keys/viewers a tick samples."""
+    return jax.random.fold_in(
+        jax.random.fold_in(workload_key, jnp.int32(_LATENCY_STREAM_TAG)), t
+    )
+
+
+def jitter_ms(u: jax.Array, base: jax.Array, bound: jax.Array,
+              period_ms: int) -> jax.Array:
+    """int32 one-way link latency in ms from a uniform draw ``u`` and
+    the (base, jitter-bound) tick maxima of the active delay rules —
+    ``swim_sim._message_delay``'s draw arithmetic (float32 multiply,
+    floor, clamp), scaled to milliseconds."""
+    extra = jnp.minimum(
+        (u * (bound + 1).astype(jnp.float32)).astype(jnp.int32), bound
+    )
+    return (base + extra) * jnp.int32(period_ms)
+
+
+def duty_on(holder: jax.Array, tick: jax.Array,
+            period: jax.Array | None) -> jax.Array:
+    """Is the holder on protocol duty at (effective) ``tick``?  Gray
+    nodes (period > 1) serve requests only on their duty phase — the
+    same affine phase assignment as ``swim_sim._stagger_send_gate`` —
+    so a request landing off-phase times out and retries.  ``None``
+    period = everyone serves every tick."""
+    if period is None:
+        return jnp.ones(jnp.shape(holder), dtype=bool)
+    per = jnp.maximum(period[holder], 1)
+    phase = (holder * jnp.int32(0x9E37 | 1)) % per
+    return tick % per == phase
+
+
+# ---------------------------------------------------------------------------
+# host-side histogram readouts (percentiles from log2 buckets)
+# ---------------------------------------------------------------------------
+
+
+def hist_stats(counts: np.ndarray) -> dict[str, float]:
+    """Percentile/summary estimates of an aggregated [B] log2-bucket
+    histogram, in ``stats.Histogram.print_obj`` key shape.  A bucket's
+    representative value is its LOWER edge (0 for bucket 0, else
+    2^(b-1)) — a deterministic floor estimate, so p50/p95/p99 answer
+    in the same units the buckets were counted in (ms)."""
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    reps = np.concatenate([[0], bucket_edges_ms(len(counts))])
+    if total == 0:
+        return {"count": 0, "min": 0.0, "max": 0.0, "sum": 0.0, "mean": 0.0,
+                "median": 0.0, "p75": 0.0, "p95": 0.0, "p99": 0.0}
+    cum = np.cumsum(counts)
+
+    def pct(p: float) -> float:
+        rank = int(np.ceil(p * total))
+        return float(reps[int(np.searchsorted(cum, max(rank, 1)))])
+
+    nz = np.flatnonzero(counts)
+    est_sum = float((counts * reps).sum())
+    return {
+        "count": total,
+        "min": float(reps[nz[0]]),
+        "max": float(reps[nz[-1]]),
+        "sum": est_sum,
+        "mean": est_sum / total,
+        "median": pct(0.5),
+        "p75": pct(0.75),
+        "p95": pct(0.95),
+        "p99": pct(0.99),
+    }
+
+
+def plane_stats(trace: Any, name: str = "lat_hist_ms") -> dict[str, float] | None:
+    """``hist_stats`` of a trace plane aggregated over every tick (and
+    every replica for a SweepTrace plane), or None when absent."""
+    planes = getattr(trace, "planes", None) or {}
+    if name not in planes:
+        return None
+    arr = np.asarray(planes[name], dtype=np.int64)
+    return hist_stats(arr.reshape(-1, arr.shape[-1]).sum(axis=0))

@@ -65,6 +65,11 @@ class WorkloadSpec(NamedTuple):
     max_retries: int = DEFAULT_MAX_RETRIES  # forward-chain retry cap
     window: int | None = None  # masked-walk width; None = heuristic
     every: int = 1  # serve on ticks where tick % every == 0
+    # SLO latency plane (traffic/latency.py): log2 histogram bucket
+    # count.  0 (default) = off — the compiled serving program and all
+    # its counters are bit-identical to the pre-latency engine.
+    latency_buckets: int = 0
+    period_ms: int = 200  # protocol period ms (tick->ms for the plane)
 
     # -- parsing ------------------------------------------------------------
 
@@ -118,6 +123,17 @@ class WorkloadSpec(NamedTuple):
                 raise ValueError(f"viewers out of range for n={n}")
         if self.window is not None and self.window < 1:
             raise ValueError("window must be >= 1 when given")
+        from ringpop_tpu.traffic.latency import MAX_BUCKETS
+
+        if not 0 <= self.latency_buckets <= MAX_BUCKETS:
+            raise ValueError(
+                f"latency_buckets must be in [0, {MAX_BUCKETS}] "
+                f"(got {self.latency_buckets})"
+            )
+        if self.latency_buckets and self.latency_buckets < 2:
+            raise ValueError("latency_buckets needs >= 2 buckets when on")
+        if self.period_ms < 1:
+            raise ValueError(f"period_ms must be >= 1 (got {self.period_ms})")
         return self
 
     # -- the pool (shared with host-side oracles) ---------------------------
@@ -191,6 +207,8 @@ def compile_traffic(
         window=min(window, ring.size),
         every=spec.every,
         lookup_n=spec.lookup_n,
+        latency_buckets=spec.latency_buckets,
+        period_ms=spec.period_ms,
     )
     tensors = TrafficTensors(
         pool=pool_hashes,

@@ -314,16 +314,22 @@ def test_cluster_fault_surface_guards():
         )
     with pytest.raises(ValueError, match="depth must be >= 2"):
         c.enable_delay(1)
+    # the delta backend now carries per-link delay via the in-flight
+    # claim lanes (swim_delta.install_pending); enable_delay installs
+    # them, and a mismatched standing depth is rejected BEFORE any key
+    # draw (precheck contract)
     d = SimCluster(4, FAST, seed=0, backend="delta", capacity=4)
-    with pytest.raises(NotImplementedError, match="dense-backend-only"):
-        d.enable_delay(4)
-    # delay scenarios are rejected on delta BEFORE any key draw
+    d.enable_delay(4)
+    assert d.state.pend_subj.shape[0] == 4
+    assert d.state.pend_subj.shape[1] == 2 * 3  # 2 * (depth - 1) lanes
+    with pytest.raises(ValueError, match="already installed"):
+        d.enable_delay(5)
     spec = ScenarioSpec.from_dict(
         {"ticks": 6, "events": [{"at": 1, "op": "delay", "src": [0],
                                  "dst": [1], "delay": 2}]}
-    )
+    )  # delay_depth 3 != the standing 4-deep lanes
     key_before = np.asarray(d.key).copy()
-    with pytest.raises(NotImplementedError, match="dense-backend-only"):
+    with pytest.raises(ValueError, match="depth 4"):
         d.run_scenario(spec)
     np.testing.assert_array_equal(np.asarray(d.key), key_before)
 

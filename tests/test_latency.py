@@ -1,0 +1,656 @@
+"""SLO telemetry plane: request-latency histograms, retry backoff,
+gray-timeout retry storms (traffic/latency.py + engine latency chain).
+
+The load-bearing oracle is the host latency walk: every log2-histogram
+bucket (and every SLO scalar) the compiled serve chain reports must be
+bit-identical to a host loop that walks the same forward chains through
+``ring_for`` host rings with the SAME RTT draws, backoff schedule, and
+gray duty phases.
+
+Fast lane: pure-host helpers (backoff/bucket arithmetic, trace-plane
+round trips, checkpoint v5, bridge keys) plus ONE standalone
+``serve_once`` oracle — the serve program is a small compile, so the
+tier-1 representative lives here.  The full scenario-scan oracles
+(delay/jitter x gray x flap compositions, both backends, streamed
+bit-parity, the mem-census footprint pin) compile many programs on CPU
+and ride the slow lane, like the PR 2/PR 10 parity grids.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import NetState, SwimParams
+from ringpop_tpu.ops import ring_ops
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios import faults as sfaults
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+from ringpop_tpu.scenarios.trace import Trace
+from ringpop_tpu.traffic import engine as tengine
+from ringpop_tpu.traffic import latency as tlat
+from ringpop_tpu.traffic.workloads import WorkloadSpec
+
+N = 10
+LEAN = SwimParams(suspicion_ticks=8, ping_req_size=1)
+B = 12
+# the oracle workload: exact full-ring walk, latency plane on
+SLO_WL = {"kind": "uniform", "keys_per_tick": 24, "pool": 256,
+          "window": N * ring_ops.DEFAULT_REPLICA_POINTS,
+          "latency_buckets": B}
+
+SLO_COUNTERS = ("lookups", "dropped", "handled_local", "delivered",
+                "proxy_retries", "proxy_failed", "send_errors",
+                "retry_succeeded", "gray_timeouts", "lat_count",
+                "lat_sum_ms", "lat_max_ms")
+
+
+# ---------------------------------------------------------------------------
+# fast: host-side helpers
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_schedule_matches_reference():
+    """RETRY_SCHEDULE = [0, 1, 3.5] s (send.js:49), last slot repeated
+    past the schedule; tick offsets floor the cumulative ms."""
+    np.testing.assert_array_equal(
+        tlat.backoff_ms_schedule(3), [0, 1000, 3500]
+    )
+    np.testing.assert_array_equal(
+        tlat.backoff_ms_schedule(5), [0, 1000, 3500, 3500, 3500]
+    )
+    # cumulative ticks at 200 ms: 0, 0, 5, 22 (0 / 1000 / 4500 ms)
+    np.testing.assert_array_equal(
+        tlat.backoff_tick_offsets(3, 200), [0, 0, 5, 22]
+    )
+
+
+def test_bucket_index_is_exact_log2():
+    vals = np.array([0, 1, 2, 3, 4, 5, 7, 8, 1023, 1024, 10 ** 9])
+    got = tlat.bucket_index(vals, 12)
+    want = [0 if v == 0 else min(int(v).bit_length(), 11) for v in vals]
+    np.testing.assert_array_equal(got, want)
+    # jnp path agrees bit for bit
+    np.testing.assert_array_equal(
+        np.asarray(tlat.bucket_index(jnp.asarray(vals, jnp.int32), 12)), want
+    )
+
+
+def test_hist_stats_percentiles():
+    counts = np.zeros(8, np.int64)
+    counts[0] = 50  # 50 requests at 0 ms
+    counts[4] = 49  # 49 in [8, 16)
+    counts[7] = 1  # one in the top bucket
+    s = tlat.hist_stats(counts)
+    assert s["count"] == 100
+    assert s["median"] == 0.0
+    assert s["p95"] == 8.0
+    assert s["p99"] == 8.0
+    assert s["max"] == 64.0
+    assert tlat.hist_stats(np.zeros(4, np.int64))["count"] == 0
+
+
+def test_workload_spec_latency_validation():
+    with pytest.raises(ValueError, match="latency_buckets"):
+        WorkloadSpec.from_spec({"latency_buckets": 99}).validate(N)
+    with pytest.raises(ValueError, match="period_ms"):
+        WorkloadSpec.from_spec({"period_ms": 0}).validate(N)
+    ws = WorkloadSpec.from_spec({"latency_buckets": B}).validate(N)
+    assert ws.latency_buckets == B
+
+
+# ---------------------------------------------------------------------------
+# the host latency walk (the oracle; shared by fast + slow tests)
+# ---------------------------------------------------------------------------
+
+
+def _host_slo_tick(cluster, ct, t):
+    """The reference-semantics host model of one SLO traffic tick:
+    sample the identical batch, walk the identical forward chains
+    through ``ring_for`` host rings, with the SAME latency-stream
+    jitter draws, RETRY_SCHEDULE backoff, and gray duty phases the
+    compiled chain uses.  Returns (counters dict, hist int64[B])."""
+    st = ct.static
+    m = st.m
+    idx, viewers = tengine.sample_tick(ct.tensors, jnp.int32(t), m)
+    idx, viewers = np.asarray(idx), np.asarray(viewers)
+    kf, kr = jax.random.split(tlat.latency_key(ct.tensors.key, jnp.int32(t)))
+    a_max = st.max_retries + 1
+    u_fwd = np.asarray(jax.random.uniform(kf, (a_max, m)))
+    u_ret = np.asarray(jax.random.uniform(kr, (m,)))
+    bo_ms = tlat.backoff_ms_schedule(st.max_retries)
+    bo_ticks = tlat.backoff_tick_offsets(st.max_retries, st.period_ms)
+
+    net = cluster.net
+    if net.link_d is not None:
+        l_src = np.asarray(net.link_src)
+        l_dst = np.asarray(net.link_dst)
+        l_d = np.asarray(net.link_d)
+        l_j = np.asarray(net.link_j)
+    else:
+        l_src = None
+    period = (
+        np.asarray(net.period) if net.period is not None
+        else np.ones(cluster.n, np.int32)
+    )
+
+    def oneway(a, b, u):
+        if l_src is None:
+            return 0
+        hit = l_src[:, a] & l_dst[:, b]
+        base = int(l_d[hit].max(initial=0))
+        bound = int(l_j[hit].max(initial=0))
+        extra = min(int(np.float32(u) * np.float32(bound + 1)), bound)
+        return (base + extra) * st.period_ms
+
+    def duty(h, te):
+        per = max(int(period[h]), 1)
+        return te % per == (h * (0x9E37 | 1)) % per
+
+    live = set(int(i) for i in cluster.live_indices())
+    keys = ct.spec.pool_keys()
+    addr_index = cluster.book.index
+    rings: dict[int, object] = {}
+
+    def ring_of(node):
+        if node not in rings:
+            rings[node] = cluster.ring_for(node)
+        return rings[node]
+
+    counts = {k: 0 for k in SLO_COUNTERS}
+    hist = np.zeros(st.latency_buckets, np.int64)
+
+    def deliver(lat, retries):
+        counts["delivered"] += 1
+        counts["lat_count"] += 1
+        counts["lat_sum_ms"] += lat
+        counts["lat_max_ms"] = max(counts["lat_max_ms"], lat)
+        if retries > 0:
+            counts["retry_succeeded"] += 1
+        hist[int(tlat.bucket_index(np.int64(lat), st.latency_buckets))] += 1
+
+    for k in range(m):
+        v = int(viewers[k])
+        if v not in live:
+            counts["dropped"] += 1
+            continue
+        counts["lookups"] += 1
+        key = keys[int(idx[k])]
+        owner0 = addr_index[ring_of(v).lookup(key)]
+        if owner0 == v:
+            counts["handled_local"] += 1
+            deliver(0, 0)
+            continue
+        h, sender, retries = owner0, v, 0
+        lat = oneway(v, owner0, u_fwd[0, k])
+        settled, final = False, -1
+        for i in range(st.max_retries + 1):
+            te = t + int(bo_ticks[min(retries, st.max_retries)])
+            alive_h = h in live
+            if not alive_h or not duty(h, te):
+                counts["send_errors"] += 1
+                if alive_h:
+                    counts["gray_timeouts"] += 1
+                if retries < st.max_retries:
+                    lat += int(bo_ms[retries]) + oneway(
+                        sender, h, u_fwd[i + 1, k]
+                    )
+                    retries += 1
+                    continue
+                break
+            nxt = addr_index[ring_of(h).lookup(key)]
+            if nxt == h:
+                settled, final = True, h
+                break
+            if retries < st.max_retries:
+                lat += int(bo_ms[retries]) + oneway(h, nxt, u_fwd[i + 1, k])
+                sender, h = h, nxt
+                retries += 1
+                continue
+            break
+        counts["proxy_retries"] += retries
+        if settled:
+            deliver(lat + oneway(final, v, u_ret[k]), retries)
+        else:
+            counts["proxy_failed"] += 1
+    return counts, hist
+
+
+def _assert_slo_tick_equal(got: dict, t: int, counts: dict, hist) -> None:
+    for name, value in counts.items():
+        assert int(got[name]) == value, (t, name, int(got[name]), value)
+    np.testing.assert_array_equal(
+        np.asarray(got["lat_hist_ms"]), hist, err_msg=f"tick {t}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# fast: the tier-1 oracle representative (standalone serve program)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_once_latency_oracle_fast():
+    """Tier-1 representative of the histogram bit-parity contract: the
+    standalone jitted serve chain — against a hand-built net carrying
+    delay rules, a gray period row, and a kill — reports every latency
+    bucket and SLO counter bit-identical to the host walk.  (The full
+    scenario-scan oracles ride the slow grid below; the serve program
+    is where all the latency arithmetic lives, so this compiles one
+    small program instead of a whole scan.)"""
+    c = SimCluster(N, LEAN, seed=6)
+    c.tick(3)  # let views diverge a little
+    c.kill(4)
+    c.tick(2)  # some viewers now disagree about node 4
+    ct = c.compile_traffic(SLO_WL)
+    # hand-built failure state: one delay rule + two gray nodes
+    src = np.zeros((1, N), bool)
+    dst = np.zeros((1, N), bool)
+    src[0, [0, 1, 2, 3, 4]] = True
+    dst[0, [5, 6, 7, 8, 9]] = True
+    period = np.ones(N, np.int32)
+    period[[1, 2]] = 4
+    net = c.net._replace(
+        link_src=jnp.asarray(src),
+        link_dst=jnp.asarray(dst),
+        link_p=jnp.zeros(1, jnp.float32),
+        link_d=jnp.asarray([2], jnp.int32),
+        link_j=jnp.asarray([3], jnp.int32),
+        period=jnp.asarray(period),
+    )
+    c.net = net  # the host walk reads rules/period from cluster.net
+    for t in (0, 1, 2, 5):
+        got = tengine.serve_once(
+            c.state.view_key, net.up, net.responsive, ct.tensors,
+            jnp.int32(t), static=ct.static, net=net,
+            period=net.period,
+        )
+        counts, hist = _host_slo_tick(c, ct, t)
+        _assert_slo_tick_equal(got, t, counts, hist)
+    # the failure mix actually exercised the storm paths
+    assert int(got["lat_hist_ms"].sum()) == int(got["delivered"])
+
+
+def test_latency_plane_off_keeps_legacy_schema():
+    """latency_buckets=0 keeps the exact legacy counter schema (no SLO
+    scalars, no planes) — the static gate the bit-compatibility of
+    every existing traffic program rests on."""
+    off = WorkloadSpec.from_spec(dict(SLO_WL, latency_buckets=0))
+    c = SimCluster(N, LEAN, seed=2)
+    ct = c.compile_traffic(off)
+    assert tengine.plane_names(ct.static) == ()
+    names = tengine.counter_names(ct.static)
+    assert "lat_count" not in names and "send_errors" not in names
+    ct_on = c.compile_traffic(SLO_WL)
+    on_names = tengine.counter_names(ct_on.static)
+    assert set(names) < set(on_names)
+    assert tengine.plane_names(ct_on.static) == (("lat_hist_ms", B),)
+
+
+# ---------------------------------------------------------------------------
+# fast: trace planes, checkpoint v5, bridge keys (pure host)
+# ---------------------------------------------------------------------------
+
+
+def _plane_trace(ticks=6, b=B, n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    metrics = {
+        "pings_sent": rng.integers(0, n, ticks).astype(np.int32),
+        "delivered": rng.integers(0, 20, ticks).astype(np.int32),
+        "lookups": rng.integers(0, 24, ticks).astype(np.int32),
+        "proxy_sends": rng.integers(0, 9, ticks).astype(np.int32),
+        "proxy_retries": rng.integers(0, 9, ticks).astype(np.int32),
+        "proxy_failed": rng.integers(0, 3, ticks).astype(np.int32),
+        "send_errors": rng.integers(0, 5, ticks).astype(np.int32),
+        "retry_succeeded": rng.integers(0, 5, ticks).astype(np.int32),
+    }
+    return Trace(
+        metrics=metrics,
+        planes={"lat_hist_ms": rng.integers(0, 7, (ticks, b)).astype(np.int32)},
+        converged=np.ones(ticks, bool),
+        live=np.full(ticks, n, np.int32),
+        loss=np.zeros(ticks, np.float32),
+        n=n,
+        backend="dense",
+        spec={"ticks": ticks, "events": []},
+    ).validate()
+
+
+def test_trace_plane_npz_roundtrip_concat_and_summary(tmp_path):
+    trace = _plane_trace()
+    path = str(tmp_path / "t.npz")
+    trace.save(path)
+    back = Trace.load(path)
+    np.testing.assert_array_equal(
+        back.planes["lat_hist_ms"], trace.planes["lat_hist_ms"]
+    )
+    # slab split + concat is bit-identical (the streamed-drain contract)
+    slabs = [
+        Trace(
+            metrics={k: v[a:b] for k, v in trace.metrics.items()},
+            planes={k: v[a:b] for k, v in trace.planes.items()},
+            converged=trace.converged[a:b],
+            live=trace.live[a:b],
+            loss=trace.loss[a:b],
+            n=trace.n,
+            backend=trace.backend,
+            start_tick=a,
+        )
+        for a, b in ((0, 2), (2, 4), (4, 6))
+    ]
+    cat = Trace.concat(slabs, spec=trace.spec)
+    np.testing.assert_array_equal(
+        cat.planes["lat_hist_ms"], trace.planes["lat_hist_ms"]
+    )
+    # summary reports the aggregated histogram's percentile estimates
+    s = trace.summary()["lat_hist_ms"]
+    assert s["count"] == int(trace.planes["lat_hist_ms"].sum())
+    # validate rejects a misshapen plane
+    bad = _plane_trace()
+    bad.planes["lat_hist_ms"] = bad.planes["lat_hist_ms"][:3]
+    with pytest.raises(ValueError, match="plane"):
+        bad.validate()
+
+
+def test_checkpoint_v5_roundtrips_histogram_planes(tmp_path):
+    """Trace planes ride the checkpoint via the existing optional-field
+    protocol ('p.'-prefixed arrays next to the 'm.' metric series)."""
+    from ringpop_tpu import checkpoint
+
+    c = SimCluster(N, LEAN, seed=1)
+    c.traces.append(_plane_trace())
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save(c, path)
+    back = checkpoint.load(path)
+    assert len(back.traces) == 1
+    np.testing.assert_array_equal(
+        back.traces[0].planes["lat_hist_ms"],
+        c.traces[0].planes["lat_hist_ms"],
+    )
+    # delta in-flight lanes round-trip as optional state tensors
+    d = SimCluster(4, LEAN, seed=0, backend="delta", capacity=4)
+    d.enable_delay(3)
+    dpath = str(tmp_path / "ckd.npz")
+    checkpoint.save(d, dpath)
+    dback = checkpoint.load(dpath)
+    np.testing.assert_array_equal(
+        np.asarray(dback.state.pend_subj), np.asarray(d.state.pend_subj)
+    )
+    assert dback.state.pend_recv.shape == d.state.pend_recv.shape
+
+
+def test_bridge_emits_latency_keys_and_timings():
+    from ringpop_tpu.obs import bridge
+    from ringpop_tpu.obs.emitters import CaptureEmitter
+
+    trace = _plane_trace()
+    cap = CaptureEmitter()
+    bridge.replay_trace(trace, cap)
+    suffixes = cap.suffixes(bridge.DEFAULT_PREFIX)
+    for key in bridge.TRAFFIC_LATENCY_KEYS:
+        assert key in suffixes, key
+    # timing values are bucket-floor ms, capped per (tick, bucket)
+    timings = cap.timings[f"{bridge.DEFAULT_PREFIX}.requestProxy.send"]
+    hist = trace.planes["lat_hist_ms"]
+    expect = sum(
+        min(int(c), bridge.TIMING_REPLAY_CAP)
+        for row in hist
+        for c in row
+        if c
+    )
+    assert len(timings) == expect
+    edges = set(np.concatenate([[0], tlat.bucket_edges_ms(B)]).tolist())
+    assert set(timings) <= edges
+    # a latency-off traffic trace emits none of the latency namespace
+    off = _plane_trace()
+    off.planes = {}
+    for name in ("send_errors", "retry_succeeded"):
+        del off.metrics[name]
+    cap2 = CaptureEmitter()
+    bridge.replay_trace(off, cap2)
+    suffixes2 = cap2.suffixes(bridge.DEFAULT_PREFIX)
+    assert not (set(bridge.TRAFFIC_LATENCY_KEYS) & suffixes2)
+
+
+# ---------------------------------------------------------------------------
+# slow: scenario-scan oracles (both backends), streamed parity, census
+# ---------------------------------------------------------------------------
+
+
+def _host_scenario_slo(backend, spec_obj, ct, seed, **kw):
+    """Step the protocol per tick exactly as the compiled scan does
+    (events + faultcfg at tick start, then the step with the schedule
+    key) and run the host latency walk against each tick's views.
+    Returns per-tick (counts, hist) lists."""
+    c = SimCluster(N, LEAN, seed=seed, backend=backend, **kw)
+    plan = sfaults.HostPlan(spec_obj, c.n)
+    plan.prepare(c)
+    compiled = scompile.compile_spec(spec_obj, c.n, base_loss=c.params.loss)
+    keys = scompile.key_schedule(c._split, compiled)
+    by_tick = defaultdict(list)
+    for at, op, arg in scompile.expand_events(spec_obj, c.params.loss):
+        by_tick[at].append((op, arg))
+    out = []
+    for t in range(spec_obj.ticks):
+        ops = sorted(by_tick.get(t, ()), key=lambda x: scompile._OP_RANK[x[0]])
+        cfg_done = False
+        for op, arg in ops:
+            if op == "kill":
+                c.kill(arg)
+            elif op == "suspend":
+                c.suspend(arg)
+            elif op == "resume":
+                c.resume(arg)
+            elif op == "revive":
+                c.revive(arg)
+            elif op == "partition":
+                c.partition([list(g) for g in arg])
+            elif op == "heal":
+                c.heal_partition()
+            elif op == "loss":
+                c.set_loss(arg)
+            elif op == "faultcfg" and not cfg_done:
+                plan.apply(c, t)
+                cfg_done = True
+        if backend == "delta":
+            c.state, _ = sdelta.delta_step(
+                c.state, c.net, keys[t], params=c.dparams
+            )
+        else:
+            c.state, _ = sim.swim_step(c.state, c.net, keys[t], params=c.params)
+        out.append(_host_slo_tick(c, ct, t))
+    return out
+
+
+SLO_SPECS = {
+    "delay": {
+        "ticks": 10,
+        "events": [
+            {"at": 1, "op": "delay", "src": [0, 1, 2], "dst": [5, 6, 7],
+             "delay": 2, "jitter": 2, "until": 8},
+            {"at": 2, "op": "kill", "node": 9},
+        ],
+    },
+    "gray": {
+        "ticks": 10,
+        "events": [
+            {"at": 1, "op": "gray", "nodes": [2, 3, 4], "factor": 5,
+             "until": 9},
+            {"at": 2, "op": "kill", "node": 9},
+        ],
+    },
+    "delay+gray+flap": {
+        "ticks": 12,
+        "events": [
+            {"at": 1, "op": "delay", "src": [0, 1], "dst": [6, 7],
+             "delay": 1, "jitter": 1, "until": 10},
+            {"at": 2, "op": "gray", "node": 3, "factor": 4, "until": 10},
+            {"at": 3, "op": "flap", "node": 8, "until": 9, "down": 2,
+             "up": 2},
+        ],
+    },
+    "link_loss+delay": {
+        "ticks": 10,
+        "events": [
+            {"at": 1, "op": "link_loss", "src": [0, 1], "dst": [4, 5],
+             "p": 0.7, "until": 8},
+            {"at": 2, "op": "delay", "src": [4, 5], "dst": [0, 1],
+             "delay": 1, "jitter": 2, "until": 8},
+        ],
+    },
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["dense", "delta"])
+@pytest.mark.parametrize("name", sorted(SLO_SPECS))
+def test_scenario_latency_oracle_grid(backend, name):
+    """The acceptance grid: per-tick latency histograms and SLO
+    counters from the compiled scenario+traffic scan bit-match the
+    host walk, over delay/jitter/gray/flap compositions, on BOTH
+    backends (the delta arm runs per-link delay through the in-flight
+    claim lanes)."""
+    spec_obj = ScenarioSpec.from_dict(SLO_SPECS[name])
+    if backend == "delta" and any(
+        e.op in ("flap", "rolling_restart", "revive")
+        for e in spec_obj.events
+    ):
+        pytest.skip("in-scan revive is dense-only")
+    kw = (
+        {}
+        if backend == "dense"
+        else dict(capacity=N, wire_cap=N, claim_grid=3 * N * N)
+    )
+    a = SimCluster(N, LEAN, seed=11, backend=backend, **kw)
+    ct = a.compile_traffic(SLO_WL)
+    trace = a.run_scenario(spec_obj, traffic=ct)
+    want = _host_scenario_slo(backend, spec_obj, ct, seed=11, **kw)
+    assert trace.planes["lat_hist_ms"].shape == (spec_obj.ticks, B)
+    for t, (counts, hist) in enumerate(want):
+        got = {k: trace.metrics[k][t] for k in SLO_COUNTERS}
+        got["lat_hist_ms"] = trace.planes["lat_hist_ms"][t]
+        _assert_slo_tick_equal(got, t, counts, hist)
+    if "gray" in name:
+        assert int(trace.metrics["gray_timeouts"].sum()) > 0
+    if "delay" in name:
+        assert int(
+            (trace.planes["lat_hist_ms"][:, 1:]).sum()
+        ) > 0, "delay rules put no mass above the zero bucket"
+
+
+@pytest.mark.slow
+def test_latency_on_without_faults_matches_plain_chain():
+    """With no gray/delay anywhere, the latency chain's routing
+    decisions reduce to the plain chain exactly: every shared serving
+    counter is bit-identical with the plane on vs off, and all the
+    histogram mass sits in bucket 0."""
+    spec = {"ticks": 8, "events": [{"at": 2, "op": "kill", "node": 3}]}
+    a = SimCluster(N, LEAN, seed=4)
+    ta = a.run_scenario(spec, traffic=SLO_WL)
+    b = SimCluster(N, LEAN, seed=4)
+    tb = b.run_scenario(spec, traffic=dict(SLO_WL, latency_buckets=0))
+    for name in tb.metrics:
+        np.testing.assert_array_equal(ta.metrics[name], tb.metrics[name], name)
+    hist = ta.planes["lat_hist_ms"]
+    assert int(hist[:, 1:].sum()) == 0
+    np.testing.assert_array_equal(hist[:, 0], ta.metrics["delivered"])
+
+
+@pytest.mark.slow
+def test_streamed_latency_planes_bit_identical(tmp_path):
+    """Streaming a latency-enabled scenario (O(segment) drains) is an
+    execution strategy: planes, SLO counters, and the store round trip
+    are bit-identical to the unsegmented run."""
+    spec = SLO_SPECS["delay+gray+flap"]
+    a = SimCluster(N, LEAN, seed=9)
+    plain = a.run_scenario(spec, traffic=SLO_WL)
+    b = SimCluster(N, LEAN, seed=9)
+    store = str(tmp_path / "store")
+    streamed = b.run_scenario(
+        spec, traffic=SLO_WL, segment_ticks=5, store=store
+    )
+    np.testing.assert_array_equal(
+        plain.planes["lat_hist_ms"], streamed.planes["lat_hist_ms"]
+    )
+    for name in plain.metrics:
+        np.testing.assert_array_equal(
+            plain.metrics[name], streamed.metrics[name], name
+        )
+    # the per-segment slabs carry the plane rows too
+    from ringpop_tpu.scenarios.stream import SegmentStore
+
+    slabs = list(SegmentStore.open(store).iter_traces())
+    assert all("lat_hist_ms" in s.planes for s in slabs)
+    np.testing.assert_array_equal(
+        np.concatenate([s.planes["lat_hist_ms"] for s in slabs]),
+        plain.planes["lat_hist_ms"],
+    )
+
+
+@pytest.mark.slow
+def test_delta_delay_protocol_parity_and_maturity():
+    """The delta in-flight lanes: compiled scan == host loop bit for
+    bit on a delay+jitter spec (protocol level — the PR 10 parity
+    contract extended to the delta backend), with claims actually
+    delayed AND matured into applications."""
+    from ringpop_tpu.scenarios import runner
+
+    spec_obj = ScenarioSpec.from_dict(
+        {
+            "ticks": 20,
+            "events": [
+                {"at": 1, "op": "delay", "src": list(range(5)),
+                 "dst": list(range(5, 10)), "delay": 2, "jitter": 1,
+                 "until": 16},
+                {"at": 3, "op": "kill", "node": 9},
+            ],
+        }
+    )
+    kw = dict(capacity=N, wire_cap=N, claim_grid=3 * N * N)
+    a = SimCluster(N, LEAN, seed=7, backend="delta", **kw)
+    trace = a.run_scenario(spec_obj)
+    b = SimCluster(N, LEAN, seed=7, backend="delta", **kw)
+    runner.run_host_loop(b, spec_obj)
+    for f, x, y in zip(a.state._fields, a.state, b.state):
+        if x is None or f == "tick":
+            assert (x is None) == (y is None), f
+            continue
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), f)
+    assert a.checksums() == b.checksums()
+    assert int(trace.metrics["delayed_claims"].sum()) > 0
+    assert int(trace.metrics["matured_applied"].sum()) > 0
+
+
+@pytest.mark.slow
+def test_mem_census_latency_axis_linear_output_flat_segment():
+    """The latency plane's footprint shape: the whole-horizon program's
+    OUTPUT bytes grow with T (the [T, B] histogram rows), while the
+    S-tick segment program's bytes are flat in total T — the
+    O(segment) streaming contract extended to the planes."""
+    from benchmarks import mem_census
+
+    b = 16
+    short = mem_census.census_scenario(
+        "dense", 64, 8, 64, latency_buckets=b
+    )
+    long = mem_census.census_scenario(
+        "dense", 64, 16, 64, latency_buckets=b
+    )
+    grown = long["output_bytes"] - short["output_bytes"]
+    # 8 extra ticks of [B] int32 rows, plus the scalar series growth
+    assert grown >= 8 * b * 4, (short["output_bytes"], long["output_bytes"])
+    seg_short = mem_census.census_scenario(
+        "dense", 64, 8, 64, segment_ticks=4, latency_buckets=b
+    )
+    seg_long = mem_census.census_scenario(
+        "dense", 64, 16, 64, segment_ticks=4, latency_buckets=b
+    )
+    for field in ("temp_bytes", "argument_bytes"):
+        assert seg_short[field] == seg_long[field], field

@@ -74,3 +74,76 @@ print(f"traffic smoke OK: one dispatch (compile {row['compile_s']:.2f}s, "
       f"execute {row['execute_s']:.3f}s), {int(lookups)} lookups, "
       f"{int(misroutes)} misroutes traced, {len(keys)} stat keys")
 EOF
+
+# --- SLO latency plane: delay + gray under traffic -------------------------
+# A second scenario exercises the latency plane end to end: a delay rule
+# plus a gray window must put real mass in the request-latency histogram
+# (requestProxy.send timing stream), amplify retries above 1 under gray
+# (sends per delivered request), and surface the new requestProxy keys
+# in --stats-out.
+spec2="$workdir/spec_slo.json"
+stats2="$workdir/stats_slo.jsonl"
+
+cat > "$spec2" <<'EOF'
+{
+  "ticks": 24,
+  "events": [
+    {"at": 3, "op": "gray", "nodes": [1, 2, 3, 4], "factor": 6, "until": 20},
+    {"at": 4, "op": "delay", "src": [5, 6, 7], "dst": [8, 9, 10],
+     "delay": 1, "jitter": 2, "until": 20},
+    {"at": 5, "op": "kill", "node": 11}
+  ]
+}
+EOF
+
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+  python -m ringpop_tpu tick-cluster --backend tpu-sim -n 16 \
+  --scenario "$spec2" --traffic zipf:128 --latency-buckets 16 \
+  --stats-out "$stats2" \
+  | tee "$workdir/out_slo.log"
+
+grep -q "latency: p50=" "$workdir/out_slo.log"
+
+JAX_PLATFORMS=cpu python - "$stats2" <<'EOF'
+import json
+import sys
+
+from ringpop_tpu.obs.bridge import (
+    DEFAULT_PREFIX, TRAFFIC_KEYS, TRAFFIC_LATENCY_KEYS,
+)
+
+lines = [json.loads(line) for line in open(sys.argv[1])]
+keys = {line["key"] for line in lines}
+
+# (a) the latency namespace joins the serving namespace
+wanted = [*(k for k in TRAFFIC_KEYS if k != "lookupn"), *TRAFFIC_LATENCY_KEYS]
+missing = [k for k in wanted if f"{DEFAULT_PREFIX}.{k}" not in keys]
+assert not missing, f"missing SLO stat keys: {missing}"
+
+# (b) nonzero latency-histogram mass: real timing samples streamed,
+# some of them nonzero (the delay rule's link RTTs / retry backoff)
+timings = [line["value"] for line in lines
+           if line["type"] == "timing"
+           and line["key"] == f"{DEFAULT_PREFIX}.requestProxy.send"]
+assert timings, "no requestProxy.send timing samples streamed"
+assert any(v > 0 for v in timings), "latency histogram mass is all-zero"
+
+# (c) retry amplification > 1 under gray: sends per delivered request
+def total(key, type_):
+    return sum(line.get("value") or 0 for line in lines
+               if line["key"] == f"{DEFAULT_PREFIX}.{key}"
+               and line["type"] == type_)
+
+sends = (total("requestProxy.send.success", "increment")
+         + total("requestProxy.retry.attempted", "increment")
+         + total("sim.handled-local", "gauge"))
+delivered = total("sim.delivered", "gauge")
+amp = sends / max(delivered, 1)
+assert amp > 1.0, f"retry amplification {amp:.3f} not > 1 under gray"
+gray = total("sim.gray-timeouts", "gauge")
+assert gray > 0, "no gray timeouts under the gray window"
+
+print(f"SLO smoke OK: amplification {amp:.2f} sends/delivered, "
+      f"{gray} gray timeouts, {len(timings)} timing samples "
+      f"(max {max(timings):.0f}ms)")
+EOF
