@@ -45,7 +45,6 @@ def run(
     backend: str = "delta",
     wire_cap: int = 64,
 ) -> list[dict]:
-    from ringpop_tpu.models import swim_delta as sd
     from ringpop_tpu.models import swim_sim as sim
     from ringpop_tpu.models.cluster import SimCluster
 

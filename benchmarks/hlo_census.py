@@ -18,7 +18,15 @@ rough element count.  Two backends:
   the only remaining [N]-class sorts are the flat sender orderings.
 
 Usage: python -m benchmarks.hlo_census [--backend dense|delta]
-       [--recv-merge sorted|scatter|pallas] [n] [capacity]
+       [--recv-merge sorted|scatter|pallas] [--temps [--min-elems E]]
+       [n] [capacity]
+
+``--temps`` switches to the temporary-tensor census (the trace-contract
+auditor's contract 5, ringpop_tpu/analysis/contracts.py): one JSON row
+per distinct (shape, dtype, producing primitive, jaxpr path) whose
+intermediate is ``[N, N]``-shaped or at/above the element threshold —
+the machine-readable target list for the footprint hunt (ROADMAP item
+2a: which wide temporaries to bit-pack or fuse next).
 
 ``tests/test_hlo_census.py`` pins the dense tallies as a regression
 guard (future PRs must not silently re-materialize the permuted claim
@@ -178,6 +186,51 @@ def lower_dense(n: int, recv_merge: str | None = None) -> str:
         jax.clear_caches()
 
 
+def temp_rows(
+    backend: str,
+    n: int,
+    cap: int,
+    recv_merge: str | None = None,
+    min_elems: int | None = None,
+) -> list[dict]:
+    """Temporary-tensor census rows of one protocol STEP (the same
+    program scope the op tallies cover), via the auditor's jaxpr
+    census.  ``min_elems`` defaults to the [N, C]-class floor on delta
+    and [N, N] on dense."""
+    from ringpop_tpu.analysis.contracts import temp_census
+    from ringpop_tpu.analysis.registry import _delta_fixture, _dense_fixture
+
+    key = jax.random.PRNGKey(0)
+    if backend == "delta":
+        from ringpop_tpu.models import swim_delta as sd
+
+        state, net, params = _delta_fixture(n, cap)
+        closed = jax.make_jaxpr(
+            sd.delta_step_impl, static_argnums=(3,)
+        )(state, net, key, params)
+        dims = dict(N=n, C=cap)
+        floor = min_elems if min_elems is not None else n * cap
+    else:
+        from ringpop_tpu.models import swim_sim as sim
+
+        state, net, params = _dense_fixture(n)
+
+        def _trace():
+            return jax.make_jaxpr(
+                sim.swim_step_impl, static_argnums=(3,)
+            )(state, net, key, params)
+
+        if recv_merge is None:
+            closed = _trace()
+        else:
+            with sim._force_recv_merge(recv_merge):
+                closed = _trace()
+        dims = dict(N=n)
+        floor = min_elems if min_elems is not None else n * n
+    entry = f"{backend}_step"
+    return temp_census(closed, dims=dims, min_elems=floor, entry=entry)
+
+
 def report(txt: str, header: str) -> None:
     tallies, elems = census_text(txt)
     print(f"{header}  module: {len(txt) / 1e6:.1f} MB text")
@@ -197,9 +250,35 @@ def main():
         default=None,
         help="dense only: override the RINGPOP_RECV_MERGE lowering",
     )
+    ap.add_argument(
+        "--temps",
+        action="store_true",
+        help="emit the temporary-tensor census (one JSON row per "
+             "distinct [N, N]-class intermediate: shape, dtype, "
+             "producing primitive) instead of the op tallies",
+    )
+    ap.add_argument(
+        "--min-elems",
+        type=int,
+        default=None,
+        help="--temps threshold override (default: N*C on delta, "
+             "N*N on dense)",
+    )
     ap.add_argument("n", nargs="?", type=int, default=None)
     ap.add_argument("capacity", nargs="?", type=int, default=256)
     args = ap.parse_args()
+
+    if args.temps:
+        import json
+
+        n = args.n if args.n is not None else (
+            65536 if args.backend == "delta" else 8192
+        )
+        for row in temp_rows(
+            args.backend, n, args.capacity, args.recv_merge, args.min_elems
+        ):
+            print(json.dumps(row), flush=True)
+        return
 
     if args.backend == "delta":
         n = args.n if args.n is not None else 65536

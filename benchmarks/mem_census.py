@@ -43,7 +43,6 @@ from __future__ import annotations
 
 import argparse
 import json
-from typing import Any
 
 from ringpop_tpu.utils import pin_cpu_if_requested
 

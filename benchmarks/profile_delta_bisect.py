@@ -21,7 +21,6 @@ from ringpop_tpu.utils import enable_compilation_cache, pin_cpu_if_requested
 pin_cpu_if_requested()
 enable_compilation_cache()
 
-import jax.numpy as jnp
 
 from ringpop_tpu.models import swim_delta as sd
 from ringpop_tpu.models import swim_sim as sim

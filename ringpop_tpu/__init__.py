@@ -20,9 +20,9 @@ designed TPU-first:
 * ``ringpop_tpu.parallel`` — jax.sharding mesh layouts for multi-chip scale.
 """
 
-__version__ = "0.1.0"
-
 from ringpop_tpu.ops.farmhash import farmhash32
+
+__version__ = "0.1.0"
 
 
 def __getattr__(name):

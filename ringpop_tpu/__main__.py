@@ -7,6 +7,8 @@ scripts/generate-hosts.js):
   tick-cluster    multi-node harness & fault injector
   generate-hosts  write a hosts.json
   obs-ledger      summarize a dispatch-ledger .jsonl (obs/ledger.py)
+  audit           trace-contract auditor: machine-check the compiled
+                  programs' invariants (analysis/; --fail-on gating)
 """
 
 from __future__ import annotations
@@ -34,6 +36,10 @@ def main() -> None:
         from ringpop_tpu.obs.ledger import main as ledger_main
 
         ledger_main(rest)
+    elif command == "audit":
+        from ringpop_tpu.analysis.cli import main as audit_main
+
+        audit_main(rest)
     else:
         print(__doc__)
         sys.exit(0 if command in (None, "-h", "--help") else 1)

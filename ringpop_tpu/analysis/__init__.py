@@ -1,0 +1,43 @@
+"""Trace-contract auditor: static analysis of the compiled programs.
+
+Every guarantee the repo's performance story rests on — one XLA
+compile per signature, no host syncs inside compiled paths, buffer
+donation actually applied, bounded carry dtypes, independent PRNG
+streams — was historically enforced by runtime oracles that catch
+violations AFTER an expensive run (or after a TPU worker crash, round
+5).  This package machine-checks those invariants at trace time, on
+CPU, in seconds:
+
+* ``registry``  — the audited entry points (swim_run, delta_run,
+  run_scenario, run_sweep, the traffic+latency-coupled scan,
+  recv_merge_pallas), each with a small lowerable fixture;
+* ``jaxpr_walk`` — recursive jaxpr traversal: sub-jaxpr iteration,
+  primary-scan carry extraction, PRNG key-lineage dataflow;
+* ``contracts`` — the five trace-contract checks over a lowered entry
+  point (host transfers, donation, carry dtypes, key lineage,
+  temporary-tensor census);
+* ``budgets``  — the pinned per-entry carry dtype budget table (a
+  widened carry slot fails the audit instead of eating HBM);
+* ``lint``     — the AST-level lint layer for repo hazards in library
+  source (host syncs, ``np.asarray`` on traced values, Python ``if``
+  on traced booleans, wall-clock reads in scan bodies);
+* ``cli``      — ``python -m ringpop_tpu audit`` with ``--fail-on``
+  severity gating.
+
+See docs/analysis.md for the contract definitions and report format.
+"""
+
+from ringpop_tpu.analysis.findings import (  # noqa: F401
+    SEVERITY_RANK,
+    Finding,
+    max_severity,
+)
+from ringpop_tpu.analysis.lint import lint_paths, lint_source  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "SEVERITY_RANK",
+    "max_severity",
+    "lint_paths",
+    "lint_source",
+]

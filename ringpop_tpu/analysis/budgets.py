@@ -1,0 +1,55 @@
+"""Pinned carry dtype budgets per (entry point, backend).
+
+The multiset of primary-scan carry dtypes each compiled program is
+ALLOWED to hold.  The auditor (``contracts.check_carry_dtypes``)
+compares the traced carries against this table: a widened slot (int32
+where int8 was pinned), a new carry leaf, or a dropped one fails the
+audit until the change is justified and the row here re-pinned — the
+review gate ROADMAP item 2(a)'s footprint hunt needs (carry bytes are
+the resident-HBM floor of every streamed soak).
+
+Regenerate a row after an intentional carry change with:
+
+    python -m ringpop_tpu audit --entry NAME --backend B --print-budget
+
+The counts are shape-independent (dtype multiset only), so one pin
+covers every n.  ``run_scenario+traffic`` rows include the serving
+plane's counters; the plain ``run_scenario`` row is the protocol-only
+program.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+# (entry, backend) -> {dtype name: carry-leaf count}.  Pinned from the
+# audit of the seed fixtures (n is immaterial; the multiset is
+# shape-independent).  The dense carry is view_key int32[N, N] + the
+# int8 lattice planes + the scan-threaded net bits; the delta carry is
+# the windowed claim state (int32 slots + uint32 hash row);
+# run_scenario adds the net carry (up/responsive bool, gid/period
+# int32); run_scenario+traffic is carry-identical to run_scenario (the
+# serving plane stacks ys, it carries nothing); recv_merge_pallas's
+# two int32 scans are the searchsorted lowering inside the wrapper.
+CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
+    ("swim_run", "dense"): {"int32": 2, "int8": 2},
+    ("delta_run", "delta"): {"bool": 1, "int32": 7, "int8": 2, "uint32": 1},
+    ("run_scenario", "dense"): {"bool": 2, "int32": 3, "int8": 2},
+    ("run_scenario", "delta"): {"bool": 3, "int32": 8, "int8": 2,
+                                "uint32": 1},
+    ("run_scenario+traffic", "dense"): {"bool": 2, "int32": 3, "int8": 2},
+    ("run_scenario+traffic", "delta"): {"bool": 3, "int32": 8, "int8": 2,
+                                        "uint32": 1},
+    ("run_sweep", "dense"): {"bool": 2, "int32": 3, "int8": 2},
+    ("run_sweep", "delta"): {"bool": 3, "int32": 8, "int8": 2, "uint32": 1},
+    ("recv_merge_pallas", "dense"): {"int32": 2},
+}
+
+
+def expected(entry: str, backend: str) -> dict[str, int] | None:
+    return CARRY_BUDGETS.get((entry, backend))
+
+
+def format_multiset(ms: Counter | dict[str, int]) -> str:
+    items = sorted(dict(ms).items())
+    return "{" + ", ".join(f"{k}: {v}" for k, v in items) + "}"

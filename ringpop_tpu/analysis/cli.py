@@ -1,0 +1,170 @@
+"""``python -m ringpop_tpu audit`` — the trace-contract auditor CLI.
+
+Audits every registered entry point (or a selection) on the current
+host — tracing only, CPU is fine — and exits non-zero when any finding
+reaches ``--fail-on`` severity.  The CI audit job runs
+``audit --fail-on error`` on every push; a perf PR runs it before
+benching to know the program it is about to measure still honors the
+pinned contracts.
+
+Examples:
+
+    python -m ringpop_tpu audit
+    python -m ringpop_tpu audit --entry delta_run --n 4096 --census \\
+        --no-compile --json
+    python -m ringpop_tpu audit --entry run_scenario+traffic \\
+        --backend delta --print-budget
+    python -m ringpop_tpu audit --lint-only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+from ringpop_tpu.analysis.findings import SEVERITY_RANK, at_least
+from ringpop_tpu.analysis.lint import lint_paths
+from ringpop_tpu.analysis.registry import ENTRY_POINTS
+
+
+def _parse(argv):
+    ap = argparse.ArgumentParser(
+        prog="python -m ringpop_tpu audit",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--entry", default=None,
+                    help="comma list of entry points (default: all; "
+                         "see --list)")
+    ap.add_argument("--backend", choices=("dense", "delta", "both"),
+                    default="both")
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--ticks", type=int, default=4)
+    ap.add_argument("--capacity", type=int, default=64)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--fail-on", choices=("error", "warning", "info",
+                                          "never"),
+                    default="error",
+                    help="exit 1 when any finding reaches this severity")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per entry report (machine lane)")
+    ap.add_argument("--census", action="store_true",
+                    help="print the temporary-tensor census rows")
+    ap.add_argument("--census-min-elems", type=int, default=None,
+                    help="census threshold override (default: the "
+                         "entry's [N, C]-class floor)")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="skip StableHLO lowering (faster big-n census; "
+                         "donation check degrades to a skip)")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the AST lint layer")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="run only the AST lint layer (no tracing)")
+    ap.add_argument("--print-budget", action="store_true",
+                    help="print the carry-budget rows for "
+                         "analysis/budgets.py pinning")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered entry points and exit")
+    return ap.parse_args(argv)
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = _parse(argv)
+
+    if args.list:
+        for name, spec in ENTRY_POINTS.items():
+            print(f"{name:24s} [{'/'.join(spec.backends)}] {spec.doc}")
+        return
+
+    findings = []
+    reports = []
+
+    if not args.lint_only:
+        from ringpop_tpu.analysis.contracts import audit_all
+        from ringpop_tpu.analysis.registry import iter_entries
+
+        names = args.entry.split(",") if args.entry else None
+        backends = (None if args.backend == "both" else (args.backend,))
+        # a typo'd --entry (or an entry/backend pair matching nothing)
+        # must not fail OPEN: auditing zero programs is an error, and
+        # unknown names are named
+        if names is not None:
+            unknown = [n for n in names if n not in ENTRY_POINTS]
+            if unknown:
+                sys.exit(f"audit: unknown entry point(s) {unknown}; "
+                         f"--list shows the registry")
+        if not list(iter_entries(names, backends)):
+            sys.exit("audit: the --entry/--backend selection matches no "
+                     "registered (entry, backend) pair")
+        reports, audit_findings = audit_all(
+            names,
+            backends,
+            n=args.n,
+            ticks=args.ticks,
+            capacity=args.capacity,
+            replicas=args.replicas,
+            compile_programs=not args.no_compile,
+            census_min_elems=args.census_min_elems,
+        )
+        findings += audit_findings
+
+    lint_ran = args.lint_only or not args.no_lint
+    if lint_ran:
+        findings += lint_paths(Path(__file__).resolve().parent.parent)
+
+    if args.json:
+        for r in reports:
+            print(json.dumps({"kind": "entry", **r.to_json()}))
+        for f in findings:
+            if not any(f in r.findings for r in reports):
+                print(json.dumps({"kind": "finding", **f.to_json()}))
+    else:
+        for r in reports:
+            sev = Counter(f.severity for f in r.findings)
+            status = ("clean" if not r.findings else
+                      " ".join(f"{v} {k}" for k, v in sorted(sev.items())))
+            print(
+                f"{r.entry} [{r.backend}] n={r.n}: {status}; "
+                f"{len(r.census)} census rows, aliased={r.aliased_outputs}, "
+                f"prng roots={r.prng.get('roots', {})}"
+            )
+            if args.census:
+                for row in r.census:
+                    print(
+                        f"    [{row['tag']}] {row['dtype']}"
+                        f"{row['shape']} x{row['count']} via "
+                        f"{row['primitive']} @ {row['path']} "
+                        f"({row['bytes_each'] / 1e6:.2f} MB each)"
+                    )
+            if args.print_budget:
+                ms = Counter()
+                for leaves in r.carries.values():
+                    for leaf in leaves:
+                        ms[leaf.split("[")[0]] += 1
+                print(f"    (\"{r.entry}\", \"{r.backend}\"): "
+                      f"{dict(sorted(ms.items()))},")
+        lint_findings = [f for f in findings
+                         if f.contract.startswith("lint:")]
+        shown = [f for f in findings
+                 if SEVERITY_RANK[f.severity] >= SEVERITY_RANK["warning"]
+                 or f.contract.startswith("lint:")]
+        for f in shown:
+            print(str(f))
+        total = Counter(f.severity for f in findings)
+        lint_part = (f"{len(lint_findings)} lint findings"
+                     if lint_ran else "lint skipped")
+        print(
+            f"audit: {len(reports)} programs, {lint_part}, "
+            f"{total.get('error', 0)} errors / "
+            f"{total.get('warning', 0)} warnings / "
+            f"{total.get('info', 0)} info"
+        )
+
+    if args.fail_on != "never" and at_least(findings, args.fail_on):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,408 @@
+"""The five trace contracts, machine-checked per entry point.
+
+``audit_entry`` lowers one registered entry point (a small concrete
+fixture from ``registry``) and runs every check against its jaxpr and
+StableHLO; ``audit_all`` sweeps the registry.  Checks:
+
+1. **host-transfer** — no ``callback`` / ``io_callback`` /
+   ``pure_callback`` / infeed/outfeed primitives anywhere in the
+   program (a host round-trip inside the scan serializes every
+   dispatch), and the whole trace runs under
+   ``jax.transfer_guard("disallow")`` so an implicit device↔host copy
+   at trace time raises instead of silently syncing;
+2. **donation** — programs that declare ``donate_argnums`` must
+   actually alias: the lowered module carries ``tf.aliasing_output``
+   parameter attributes and lowering emitted no donation-dropped
+   warning (a dropped donation doubles the carry's HBM);
+3. **carry-dtype** — no 8-byte dtype in any primary scan carry, and
+   the carry dtype multiset matches the pinned budget table
+   (``budgets.py``) — a silently widened slot fails the audit instead
+   of eating HBM at n=65,536;
+4. **prng-lineage** — static dataflow over the key-derivation
+   primitives proving the declared streams (protocol schedule,
+   workload key, and the workload key's tagged latency sub-stream)
+   never mix and no key value is drawn from twice
+   (``jaxpr_walk.KeyLineageAnalysis``);
+5. **temp-census** — every intermediate at or above the entry's
+   ``[N, C]``-class element threshold (or shaped ``[N, N]`` /
+   ``[..., N, N]``), with dtype and producing primitive — the
+   machine-readable target list for the footprint hunt (ROADMAP item
+   2a), also surfaced via ``benchmarks/hlo_census.py --temps``.
+
+All checks are trace/lower-level only; nothing executes or compiles.
+The StableHLO lowering (donation attributes + donation-dropped
+warnings both surface there) is skippable with
+``compile_programs=False`` for big-n census runs where only the jaxpr
+checks are wanted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+import warnings as _warnings
+from collections import Counter
+from typing import Any
+
+import jax
+
+from ringpop_tpu.analysis import budgets
+from ringpop_tpu.analysis.findings import Finding
+from ringpop_tpu.analysis.jaxpr_walk import (
+    all_avals,
+    iter_eqns,
+    key_lineage,
+    primary_scans,
+    scan_carry_avals,
+)
+from ringpop_tpu.analysis.registry import Built, build_entry, iter_entries
+
+# Primitive names that imply a host round-trip inside the compiled
+# program.  Matched exactly or as a substring ("callback" covers
+# pure_callback / io_callback / debug_callback and future variants).
+_HOST_PRIM_EXACT = frozenset({"infeed", "outfeed", "host_local_array"})
+_HOST_PRIM_SUBSTR = ("callback",)
+
+_ALIAS_RE = re.compile(r"tf\.aliasing_output")
+_DONATION_WARNING_RE = re.compile(
+    r"donated buffer|buffers were not usable", re.IGNORECASE
+)
+
+# 4-byte lanes are the repo-wide carry budget: int64/float64/complex
+# in a scan carry double the resident HBM for no modeled benefit.
+MAX_CARRY_ITEMSIZE = 4
+
+
+@dataclasses.dataclass
+class EntryReport:
+    """One audited (entry, backend): findings plus report material."""
+
+    entry: str
+    backend: str
+    n: int
+    findings: list[Finding]
+    census: list[dict[str, Any]]
+    prng: dict[str, Any]
+    carries: dict[str, list[str]]  # scan path -> carry "dtype[shape]" list
+    aliased_outputs: int
+    host_prims: int
+
+    def to_json(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["findings"] = [f.to_json() for f in self.findings]
+        return d
+
+
+# ---------------------------------------------------------------------------
+# the individual checks
+# ---------------------------------------------------------------------------
+
+
+def check_host_transfers(closed, entry: str) -> tuple[list[Finding], int]:
+    """Contract 1: the jaxpr walker half (the transfer-guard half wraps
+    the trace itself in ``_trace``)."""
+    findings = []
+    hits = 0
+    for path, eqn in iter_eqns(closed):
+        name = eqn.primitive.name
+        if name in _HOST_PRIM_EXACT or any(
+            s in name for s in _HOST_PRIM_SUBSTR
+        ):
+            hits += 1
+            in_scan = "scan" in path.split("/") if path else False
+            findings.append(
+                Finding(
+                    contract="host-transfer",
+                    severity="error",
+                    entry=entry,
+                    message=(
+                        f"host round-trip primitive '{name}' in the "
+                        f"compiled program"
+                        + (" (inside the scan body: every tick pays a "
+                           "host sync)" if in_scan else "")
+                    ),
+                    where=path or "<top>",
+                )
+            )
+    return findings, hits
+
+
+def check_donation(
+    built: Built, lowered_text: str | None, warning_msgs: list[str]
+) -> tuple[list[Finding], int]:
+    """Contract 2: donation declared must be donation applied."""
+    findings: list[Finding] = []
+    aliased = (
+        len(_ALIAS_RE.findall(lowered_text)) if lowered_text is not None else 0
+    )
+    dropped = [m for m in warning_msgs if _DONATION_WARNING_RE.search(m)]
+    if not built.donates:
+        return findings, aliased
+    for msg in dropped:
+        findings.append(
+            Finding(
+                contract="donation",
+                severity="error",
+                entry=built.name,
+                message=f"donation dropped at lowering: {msg.splitlines()[0]}",
+            )
+        )
+    if lowered_text is not None and aliased < built.min_aliased:
+        findings.append(
+            Finding(
+                contract="donation",
+                severity="error",
+                entry=built.name,
+                message=(
+                    f"program declares donate_argnums but the lowered "
+                    f"module aliases only {aliased} parameter(s) "
+                    f"(pinned floor {built.min_aliased}) — the carry "
+                    "is being copied, not reused"
+                ),
+            )
+        )
+    return findings, aliased
+
+
+def check_carry_dtypes(
+    closed, built: Built
+) -> tuple[list[Finding], dict[str, list[str]]]:
+    """Contract 3: wide dtypes and the pinned per-entry budget."""
+    findings: list[Finding] = []
+    carries: dict[str, list[str]] = {}
+    multiset: Counter = Counter()
+    for path, eqn in primary_scans(closed):
+        avals = scan_carry_avals(eqn)
+        label = path or "<top>"
+        carries[label] = [f"{a.dtype}{list(a.shape)}" for a in avals]
+        for a in avals:
+            multiset[str(a.dtype)] += 1
+            if a.dtype.itemsize > MAX_CARRY_ITEMSIZE:
+                findings.append(
+                    Finding(
+                        contract="carry-dtype",
+                        severity="error",
+                        entry=built.name,
+                        message=(
+                            f"scan carry leaf {a.dtype}{list(a.shape)} is "
+                            f"{a.dtype.itemsize} bytes/elem — over the "
+                            f"{MAX_CARRY_ITEMSIZE}-byte carry budget "
+                            "(silent promotion?)"
+                        ),
+                        where=label,
+                    )
+                )
+    pinned = budgets.expected(built.name, built.backend)
+    if pinned is None:
+        findings.append(
+            Finding(
+                contract="carry-dtype",
+                severity="warning",
+                entry=built.name,
+                message=(
+                    f"no pinned carry budget for "
+                    f"({built.name}, {built.backend}); actual "
+                    f"{budgets.format_multiset(multiset)} — pin it in "
+                    "analysis/budgets.py"
+                ),
+            )
+        )
+    elif Counter(pinned) != multiset:
+        findings.append(
+            Finding(
+                contract="carry-dtype",
+                severity="error",
+                entry=built.name,
+                message=(
+                    "carry dtype budget drift: pinned "
+                    f"{budgets.format_multiset(Counter(pinned))} but the "
+                    f"trace carries {budgets.format_multiset(multiset)} — "
+                    "a widened/added slot must be justified and re-pinned "
+                    "in analysis/budgets.py"
+                ),
+            )
+        )
+    # program-wide f64 anywhere (x64 creeping in) — weaker than the
+    # carry rule, but a float64 temporary is still 2x HBM for nothing
+    for path, prim, aval in all_avals(closed):
+        if str(aval.dtype) in ("float64", "complex128"):
+            findings.append(
+                Finding(
+                    contract="carry-dtype",
+                    severity="warning",
+                    entry=built.name,
+                    message=f"float64 intermediate {list(aval.shape)} "
+                            f"produced by '{prim}'",
+                    where=path or "<top>",
+                )
+            )
+            break  # one representative is enough; the census has the rest
+    return findings, carries
+
+
+def check_key_lineage(closed, built: Built) -> tuple[list[Finding], dict]:
+    """Contract 4: declared streams never mix; no key drawn twice."""
+    if not built.key_roots:
+        return [], {"roots": {}}
+    return key_lineage(closed, built.key_roots, built.name)
+
+
+def _dim_name(d: int, dims: dict[str, int]) -> str:
+    """Named-dim tag for a size; when several named dims share the
+    size (n == capacity at small fixture shapes) the tag keeps every
+    candidate ("N|C") instead of silently picking one — the census's
+    whole point is telling [N, C] claim tables from [N, N] planes."""
+    matches = [name for name, val in dims.items() if d == val]
+    return "|".join(matches) if matches else str(d)
+
+
+def temp_census(
+    closed, *, dims: dict[str, int], min_elems: int, entry: str = ""
+) -> list[dict[str, Any]]:
+    """Contract 5: the temporary-tensor census rows (info/report, not
+    findings): every equation output at or above ``min_elems`` elements
+    or shaped ``[..., N, N]``, with dtype and producing primitive,
+    grouped and sorted by footprint."""
+    n = dims.get("N", 0)
+    grouped: dict[tuple, dict[str, Any]] = {}
+    for path, prim, aval in all_avals(closed):
+        shape = tuple(int(d) for d in aval.shape)
+        elems = math.prod(shape) if shape else 1
+        nxn = n > 1 and sum(1 for d in shape if d == n) >= 2
+        if elems < min_elems and not nxn:
+            continue
+        key = (shape, str(aval.dtype), prim, path)
+        row = grouped.get(key)
+        if row is None:
+            grouped[key] = row = {
+                "entry": entry,
+                "shape": list(shape),
+                "tag": "x".join(_dim_name(d, dims) for d in shape),
+                "dtype": str(aval.dtype),
+                "primitive": prim,
+                "path": path or "<top>",
+                "count": 0,
+                "elems_each": elems,
+                "bytes_each": elems * aval.dtype.itemsize,
+            }
+        row["count"] += 1
+    return sorted(
+        grouped.values(),
+        key=lambda r: (-r["bytes_each"] * r["count"], r["primitive"]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-entry driver
+# ---------------------------------------------------------------------------
+
+
+def _trace(built: Built):
+    """The entry point's closed jaxpr, traced under a disallow
+    transfer guard (an implicit device↔host copy during tracing —
+    e.g. a concretized traced bool — raises here instead of silently
+    serializing dispatches on a real accelerator)."""
+
+    def fn(*args):
+        return built.jitted(*args, **built.statics)
+
+    with jax.transfer_guard("disallow"):
+        return jax.make_jaxpr(fn)(*built.args)
+
+
+def _trace_and_lower(
+    built: Built, *, lower: bool
+) -> tuple[Any, str | None, list[str]]:
+    """One trace serves both halves: the AOT ``.trace`` yields the
+    closed jaxpr AND (optionally) the StableHLO lowering, so an entry
+    point is traced exactly once per audit and the disallow transfer
+    guard covers the whole trace→lower span.  Returns ``(closed_jaxpr,
+    lowered_text | None, warning messages)`` — donation-dropped
+    warnings surface at lowering."""
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        with jax.transfer_guard("disallow"):
+            traced = built.jitted.trace(*built.args, **built.statics)
+            text = traced.lower().as_text() if lower else None
+    return traced.jaxpr, text, [str(w.message) for w in caught]
+
+
+def _lower_text(built: Built) -> tuple[str | None, list[str]]:
+    """The lowered StableHLO text plus any warnings lowering emitted
+    (donation-dropped warnings appear here) — the fixture-level helper
+    ``tests/test_analysis.py`` drives the donation check through."""
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        lowered = built.jitted.lower(*built.args, **built.statics)
+        text = lowered.as_text()
+    return text, [str(w.message) for w in caught]
+
+
+def audit_entry(
+    name: str,
+    backend: str,
+    *,
+    n: int = 64,
+    ticks: int = 4,
+    capacity: int = 64,
+    replicas: int = 2,
+    compile_programs: bool = True,
+    census_min_elems: int | None = None,
+    **extra: Any,
+) -> EntryReport:
+    """Run every trace contract against one (entry, backend) at the
+    given fixture shape; ``compile_programs=False`` skips the StableHLO
+    lowering (donation check degrades to a skip) for big-n census-only
+    runs."""
+    built = build_entry(
+        name, backend, n=n, ticks=ticks, capacity=capacity,
+        replicas=replicas, **extra,
+    )
+    findings: list[Finding] = []
+    closed, text, warns = _trace_and_lower(built, lower=compile_programs)
+
+    host_findings, host_hits = check_host_transfers(closed, built.name)
+    findings += host_findings
+
+    donation_findings, aliased = check_donation(built, text, warns)
+    findings += donation_findings
+
+    carry_findings, carries = check_carry_dtypes(closed, built)
+    findings += carry_findings
+
+    prng_findings, prng = check_key_lineage(closed, built)
+    findings += prng_findings
+
+    census = temp_census(
+        closed,
+        dims=built.dims,
+        min_elems=(census_min_elems if census_min_elems is not None
+                   else built.census_min_elems),
+        entry=built.name,
+    )
+    return EntryReport(
+        entry=built.name,
+        backend=backend,
+        n=n,
+        findings=findings,
+        census=census,
+        prng=prng,
+        carries=carries,
+        aliased_outputs=aliased,
+        host_prims=host_hits,
+    )
+
+
+def audit_all(
+    names=None, backends=None, **kw: Any
+) -> tuple[list[EntryReport], list[Finding]]:
+    """Audit every registered (entry, backend); returns the reports
+    and the concatenated findings."""
+    reports = []
+    findings: list[Finding] = []
+    for name, backend in iter_entries(names, backends):
+        report = audit_entry(name, backend, **kw)
+        reports.append(report)
+        findings += report.findings
+    return reports, findings

@@ -1,0 +1,497 @@
+"""Recursive jaxpr traversal: sub-jaxprs, scan carries, key lineage.
+
+Everything here is pure trace-level analysis — no compilation, no
+execution — so it runs in seconds on a CPU host even for programs
+whose compiled form needs a TPU (the Mosaic kernel entry point) or
+crashes one (the round-5 n=65,536 delta program).
+
+Three layers:
+
+* ``iter_eqns``        — depth-first equation iteration through every
+  sub-jaxpr a primitive carries (pjit ``jaxpr``, scan/while bodies,
+  cond ``branches``, custom_* ``call_jaxpr``), with a readable path
+  string ("scan/cond/pjit") per equation;
+* ``primary_scans``    — the scan equations NOT nested inside another
+  scan: the tick loops whose carries are the HBM-resident state the
+  dtype budget pins (inner searchsorted/fori scans are sub-kernels);
+* ``KeyLineageAnalysis`` — a forward dataflow over PRNG key material:
+  which declared key roots reach which derive/draw sites, whether two
+  roots ever mix, and whether any single key value is consumed by more
+  than one bit-drawing equation (classic key reuse).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Iterator
+
+import jax
+
+from ringpop_tpu.analysis.findings import Finding
+
+# ---------------------------------------------------------------------------
+# generic traversal
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(eqn) -> Iterator[Any]:
+    """Every inner (closed or open) jaxpr an equation's params carry."""
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for item in vals:
+            if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                yield item.jaxpr  # ClosedJaxpr
+            elif hasattr(item, "eqns"):
+                yield item  # open Jaxpr
+
+
+def iter_eqns(jaxpr, path: str = "") -> Iterator[tuple[str, Any]]:
+    """Yield ``(path, eqn)`` depth-first over ``jaxpr`` and every
+    sub-jaxpr.  ``path`` lists the enclosing primitives ("scan/cond");
+    the top level is ""."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
+    for eqn in inner.eqns:
+        yield path, eqn
+        sub_path = f"{path}/{eqn.primitive.name}" if path else eqn.primitive.name
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, sub_path)
+
+
+def primary_scans(jaxpr) -> list[tuple[str, Any]]:
+    """The ``scan`` equations not nested inside another scan — the
+    tick loops whose carries ride in HBM across the whole horizon."""
+    return [
+        (path, eqn)
+        for path, eqn in iter_eqns(jaxpr)
+        if eqn.primitive.name == "scan" and "scan" not in path.split("/")
+    ]
+
+
+def scan_carry_avals(eqn) -> list[Any]:
+    """The carry avals of one scan equation (consts excluded)."""
+    nc = eqn.params["num_consts"]
+    ncar = eqn.params["num_carry"]
+    return [v.aval for v in eqn.invars[nc : nc + ncar]]
+
+
+def all_avals(jaxpr) -> Iterator[tuple[str, str, Any]]:
+    """Every equation output aval in the program: ``(path, primitive,
+    aval)`` — the temporary-tensor census's raw stream."""
+    for path, eqn in iter_eqns(jaxpr):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                yield path, eqn.primitive.name, aval
+
+
+# ---------------------------------------------------------------------------
+# PRNG key-lineage dataflow
+# ---------------------------------------------------------------------------
+
+# Primitives that GENERATE bits from a key — the consumption sites the
+# reuse rule counts.  (With typed keys, uniform/randint draw through
+# random_bits; the raw-key legacy path bottoms out in threefry2x32.)
+DRAW_PRIMS = frozenset({"random_bits", "threefry2x32"})
+
+# Primitives that DERIVE new, statistically independent keys from a
+# key.  Fan-out through these is the sanctioned idiom (split streams,
+# fold_in domain tags) and is never flagged by itself.
+DERIVE_PRIMS = frozenset({"random_split", "random_fold_in", "random_seed"})
+
+# Value-preserving plumbing: the output IS the input key (re-typed,
+# re-laid-out, or copied) — same value id, same roots.
+PASSTHROUGH_PRIMS = frozenset(
+    {
+        "random_wrap",
+        "random_unwrap",
+        "convert_element_type",
+        "bitcast_convert_type",
+        "reshape",
+        "squeeze",
+        "broadcast_in_dim",
+        "copy",
+        "device_put",
+        "optimization_barrier",
+        "stop_gradient",
+    }
+)
+
+# Indexing: the output is a sub-key of a stacked key tensor (a row of
+# the per-tick schedule, one of split's children).  Key material with
+# the same roots, but a DISTINCT value per call site.
+INDEX_PRIMS = frozenset(
+    {"slice", "dynamic_slice", "gather", "transpose", "concatenate", "rev"}
+)
+
+
+@dataclasses.dataclass
+class _KeyVal:
+    """Key material flowing through one var: which declared roots it
+    descends from, and a value identity (creation-site token) shared
+    only by vars provably holding the same key value."""
+
+    roots: frozenset[str]
+    vid: int
+
+
+class KeyLineageAnalysis:
+    """Forward dataflow over a closed jaxpr tracking PRNG key material.
+
+    ``roots`` maps a root-stream name ("protocol", "workload") to the
+    set of top-level flat input indices holding that stream's key
+    tensor(s).  The analysis propagates (root-set, value-id) through
+    passthrough/index/derive primitives, unions root-sets through
+    arithmetic that combines key material, and records every draw /
+    derive site per value id.
+
+    Violations:
+
+    * ``prng-mixing``  (error): a derive or draw consumes key material
+      descended from two different declared roots — the streams share
+      a lineage;
+    * ``prng-reuse``   (error): the same key value feeds two distinct
+      bit-drawing equations — two "independent" streams are reading
+      the same bits;
+    * ``prng-draw-and-derive`` (warning): a key value is both drawn
+      from and used to derive children — the children correlate with
+      the drawn bits (JAX's key-reuse doctrine).
+
+    Scan carries iterate to a root-set fixpoint (a key threaded
+    through the carry picks up every root it ever held).
+    """
+
+    def __init__(self, closed_jaxpr, roots: dict[str, list[int]]):
+        self.closed = closed_jaxpr
+        self.roots = roots
+        self.findings: list[Finding] = []
+        self.draw_sites: dict[int, list[str]] = {}
+        self.derive_sites: dict[int, list[str]] = {}
+        self.root_draws: dict[str, int] = {name: 0 for name in roots}
+        self._vid = itertools.count(1)
+        self._site_vids: dict[tuple[int, int], int] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _fresh(self, roots: frozenset[str], site: tuple[int, int]) -> _KeyVal:
+        """A derived key value: new value id per (eqn site, out slot),
+        stable across fixpoint re-visits of the same equation."""
+        vid = self._site_vids.setdefault(site, next(self._vid))
+        return _KeyVal(roots=roots, vid=vid)
+
+    @staticmethod
+    def _read(env: dict, var) -> _KeyVal | None:
+        if type(var).__name__ == "Literal":
+            return None
+        return env.get(var)
+
+    def _record_use(self, kind: str, kv: _KeyVal, path: str) -> None:
+        store = self.draw_sites if kind == "draw" else self.derive_sites
+        store.setdefault(kv.vid, []).append(path)
+        if kind == "draw":
+            for r in kv.roots:
+                self.root_draws[r] = self.root_draws.get(r, 0) + 1
+
+    # -- the walk -----------------------------------------------------------
+
+    def run(self, entry: str) -> list[Finding]:
+        inner = self.closed.jaxpr
+        env: dict[Any, _KeyVal] = {}
+        for name, idxs in self.roots.items():
+            for i in idxs:
+                if i < len(inner.invars):
+                    env[inner.invars[i]] = _KeyVal(
+                        roots=frozenset({name}), vid=next(self._vid)
+                    )
+        self._walk(inner, env, path="", entry=entry)
+        self._finalize(entry)
+        return self.findings
+
+    def _walk(self, jaxpr, env: dict, path: str, entry: str) -> None:
+        for eqn_i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            in_kvs = [self._read(env, v) for v in eqn.invars]
+            key_ins = [kv for kv in in_kvs if kv is not None]
+            sub_path = f"{path}/{name}" if path else name
+
+            if key_ins:
+                roots = frozenset().union(*(kv.roots for kv in key_ins))
+                if name in DRAW_PRIMS or name in DERIVE_PRIMS:
+                    if len(roots) > 1:
+                        self.findings.append(
+                            Finding(
+                                contract="prng-lineage",
+                                severity="error",
+                                entry=entry,
+                                message=(
+                                    f"prng-mixing: {name} consumes key "
+                                    f"material from roots "
+                                    f"{sorted(roots)} — the streams "
+                                    "share a lineage"
+                                ),
+                                where=sub_path,
+                            )
+                        )
+                    for kv in key_ins:
+                        self._record_use(
+                            "draw" if name in DRAW_PRIMS else "derive",
+                            kv,
+                            sub_path,
+                        )
+
+            subs = list(_sub_jaxprs(eqn))
+            if subs:
+                self._walk_call(eqn, subs, env, in_kvs, sub_path, entry)
+                continue
+
+            # propagate key material to outputs
+            if not key_ins or name in DRAW_PRIMS:
+                continue  # drawn bits are data, not key material
+            roots = frozenset().union(*(kv.roots for kv in key_ins))
+            for out_i, ov in enumerate(eqn.outvars):
+                if name in PASSTHROUGH_PRIMS and len(key_ins) == 1:
+                    env[ov] = key_ins[0]
+                else:
+                    # derive / index / arithmetic combination: key
+                    # material with a fresh value per site
+                    env[ov] = self._fresh(roots, (id(eqn), out_i))
+
+    # -- call-like primitives (pjit / scan / cond / while / custom_*) -------
+
+    def _walk_call(self, eqn, subs, env, in_kvs, sub_path, entry) -> None:
+        name = eqn.primitive.name
+        if name == "scan":
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            body = subs[0]
+            inner = getattr(body, "jaxpr", body)
+            # consts + carry map 1:1; xs rows are indexed sub-keys
+            inner_env: dict[Any, _KeyVal] = {}
+            for i, v in enumerate(inner.invars):
+                kv = in_kvs[i] if i < len(in_kvs) else None
+                if kv is None:
+                    continue
+                if i < nc + ncar:
+                    inner_env[v] = kv
+                else:
+                    inner_env[v] = self._fresh(kv.roots, (id(eqn), -1 - i))
+            # fixpoint over the carry root-sets (2 passes suffice for a
+            # monotone union lattice of this depth)
+            for _ in range(3):
+                probe = dict(inner_env)
+                self._walk_quiet(inner, probe, sub_path, entry)
+                changed = False
+                for ci in range(ncar):
+                    ov = inner.outvars[ci]
+                    okv = self._read(probe, ov)
+                    iv = inner.invars[nc + ci]
+                    ikv = inner_env.get(iv)
+                    if okv is None:
+                        continue
+                    merged = okv.roots | (ikv.roots if ikv else frozenset())
+                    if ikv is None or merged != ikv.roots:
+                        inner_env[iv] = self._fresh(merged, (id(eqn), -100 - ci))
+                        changed = True
+                if not changed:
+                    break
+            # final accounted pass
+            final = dict(inner_env)
+            self._walk(inner, final, sub_path, entry)
+            # the classic scan reuse: a key threaded UNCHANGED through
+            # the carry (same value id in as out) and drawn inside the
+            # body draws identical bits every iteration — per-site
+            # counting alone cannot see it (one site, T draws of one
+            # value), so the carry loop is checked explicitly
+            for ci in range(ncar):
+                ikv = final.get(inner.invars[nc + ci])
+                okv = self._read(final, inner.outvars[ci])
+                if (
+                    ikv is not None
+                    and okv is not None
+                    and okv.vid == ikv.vid
+                    and ikv.vid in self.draw_sites
+                ):
+                    self.findings.append(
+                        Finding(
+                            contract="prng-lineage",
+                            severity="error",
+                            entry=entry,
+                            message=(
+                                "prng-reuse: a key threaded unchanged "
+                                "through the scan carry is drawn inside "
+                                "the body — every iteration reads the "
+                                "same bits (fold_in the tick, or split "
+                                "the carry key)"
+                            ),
+                            where=sub_path,
+                        )
+                    )
+            for ci, ov in enumerate(eqn.outvars):
+                okv = self._read(final, inner.outvars[ci])
+                if okv is not None:
+                    env[ov] = self._fresh(okv.roots, (id(eqn), 1000 + ci))
+        elif name in ("cond", "switch"):
+            # invars = predicate + operands shared by every branch.
+            # Branches are MUTUALLY EXCLUSIVE: a key drawn once in each
+            # branch is drawn once at runtime, so each branch's
+            # draw/derive sites are collected in isolation and merged
+            # per value-id with the MAX across branches (a single
+            # branch drawing twice still trips the reuse rule).
+            out_roots: list[frozenset | None] = [None] * len(eqn.outvars)
+            branch_draws: list[dict[int, list[str]]] = []
+            branch_derives: list[dict[int, list[str]]] = []
+            branch_roots: list[dict[str, int]] = []
+            for branch in subs:
+                inner = getattr(branch, "jaxpr", branch)
+                inner_env = {
+                    v: kv
+                    for v, kv in zip(inner.invars, in_kvs[1:])
+                    if kv is not None
+                }
+                saved = (self.draw_sites, self.derive_sites,
+                         self.root_draws)
+                self.draw_sites, self.derive_sites = {}, {}
+                self.root_draws = dict.fromkeys(saved[2], 0)
+                try:
+                    self._walk(inner, inner_env, sub_path, entry)
+                    branch_draws.append(self.draw_sites)
+                    branch_derives.append(self.derive_sites)
+                    branch_roots.append(self.root_draws)
+                finally:
+                    (self.draw_sites, self.derive_sites,
+                     self.root_draws) = saved
+                for oi, ov in enumerate(inner.outvars):
+                    okv = self._read(inner_env, ov)
+                    if okv is not None:
+                        out_roots[oi] = (out_roots[oi] or frozenset()) | okv.roots
+            for store, per_branch in ((self.draw_sites, branch_draws),
+                                      (self.derive_sites, branch_derives)):
+                for vid in {v for b in per_branch for v in b}:
+                    heaviest = max(
+                        (b.get(vid, []) for b in per_branch), key=len
+                    )
+                    store.setdefault(vid, []).extend(heaviest)
+            for root in self.root_draws:
+                self.root_draws[root] += max(
+                    (b.get(root, 0) for b in branch_roots), default=0
+                )
+            for oi, roots in enumerate(out_roots):
+                if roots:
+                    env[eqn.outvars[oi]] = self._fresh(roots, (id(eqn), oi))
+        elif name == "while":
+            # cond_jaxpr/body_jaxpr over cond_consts + body_consts + carry
+            body = eqn.params.get("body_jaxpr")
+            ncc = eqn.params.get("cond_nconsts", 0)
+            nbc = eqn.params.get("body_nconsts", 0)
+            if body is None:
+                return
+            inner = body.jaxpr
+            carry_kvs = in_kvs[ncc + nbc :]
+            inner_env = {}
+            for i, v in enumerate(inner.invars):
+                kv = (in_kvs[ncc + i] if i < nbc else
+                      carry_kvs[i - nbc] if i - nbc < len(carry_kvs) else None)
+                if kv is not None:
+                    inner_env[v] = kv
+            self._walk(inner, inner_env, sub_path, entry)
+            for oi, ov in enumerate(eqn.outvars):
+                okv = self._read(inner_env, inner.outvars[oi])
+                if okv is not None:
+                    env[ov] = self._fresh(okv.roots, (id(eqn), oi))
+        else:
+            # pjit / closed_call / custom_jvp / remat: operands map 1:1
+            inner = getattr(subs[0], "jaxpr", subs[0])
+            inner_env = {
+                v: kv for v, kv in zip(inner.invars, in_kvs) if kv is not None
+            }
+            self._walk(inner, inner_env, sub_path, entry)
+            for ov, iv in zip(eqn.outvars, inner.outvars):
+                okv = self._read(inner_env, iv)
+                if okv is not None:
+                    env[ov] = self._fresh(okv.roots, (id(eqn), id(ov)))
+
+    def _walk_quiet(self, jaxpr, env, path, entry) -> None:
+        """A probe pass that records nothing: used to reach the scan
+        carry fixpoint before the single accounted pass."""
+        saved = (self.findings, self.draw_sites, self.derive_sites,
+                 self.root_draws)
+        self.findings, self.draw_sites, self.derive_sites, self.root_draws = (
+            [], {}, {}, dict.fromkeys(self.root_draws, 0)
+        )
+        try:
+            self._walk(jaxpr, env, path, entry)
+        finally:
+            (self.findings, self.draw_sites, self.derive_sites,
+             self.root_draws) = saved
+
+    # -- verdicts -----------------------------------------------------------
+
+    def _finalize(self, entry: str) -> None:
+        for vid, sites in self.draw_sites.items():
+            if len(sites) > 1:
+                self.findings.append(
+                    Finding(
+                        contract="prng-lineage",
+                        severity="error",
+                        entry=entry,
+                        message=(
+                            f"prng-reuse: one key value feeds "
+                            f"{len(sites)} bit-drawing sites — the "
+                            "streams read the same bits"
+                        ),
+                        where="; ".join(sorted(set(sites))[:4]),
+                    )
+                )
+            elif vid in self.derive_sites:
+                self.findings.append(
+                    Finding(
+                        contract="prng-lineage",
+                        severity="warning",
+                        entry=entry,
+                        message=(
+                            "prng-draw-and-derive: a key value is both "
+                            "drawn from and split/folded — derived "
+                            "children correlate with the drawn bits"
+                        ),
+                        where="; ".join(
+                            sorted(set(sites + self.derive_sites[vid]))[:4]
+                        ),
+                    )
+                )
+        for name, count in self.root_draws.items():
+            if count == 0:
+                self.findings.append(
+                    Finding(
+                        contract="prng-lineage",
+                        severity="info",
+                        entry=entry,
+                        message=(
+                            f"declared key root '{name}' never reaches "
+                            "a bit-drawing site in this program"
+                        ),
+                    )
+                )
+
+    def summary(self) -> dict[str, Any]:
+        """Machine-readable lineage stats: per-root draw counts and the
+        fan-out shape (derive/draw site totals)."""
+        return {
+            "roots": dict(self.root_draws),
+            "draw_values": len(self.draw_sites),
+            "derive_values": len(self.derive_sites),
+        }
+
+
+def key_lineage(closed_jaxpr, roots: dict[str, list[int]], entry: str):
+    """Run the lineage analysis; returns ``(findings, summary)``."""
+    an = KeyLineageAnalysis(closed_jaxpr, roots)
+    findings = an.run(entry)
+    return findings, an.summary()
+
+
+def tree_flat_index_of(args: tuple, target: Any) -> list[int]:
+    """Flat leaf indices (under ``jax.tree_util.tree_flatten(args)``)
+    of every leaf that IS ``target`` — how the registry names a key
+    root without hard-coding pytree layouts."""
+    leaves, _ = jax.tree_util.tree_flatten(args)
+    return [i for i, leaf in enumerate(leaves) if leaf is target]

@@ -1,0 +1,261 @@
+"""AST-level lint for repo-specific hazards in library source.
+
+ruff covers generic Python; these checks encode hazards ruff cannot
+know about — patterns that are fine in host/bench code but break (or
+silently serialize) the compiled paths:
+
+* **RPL001 host-sync-in-library** — ``.block_until_ready()`` in a
+  compiled-path module: a hidden drain point that serializes the
+  dispatch pipeline (the streaming runner's 97% overlap depends on
+  draining exactly once, at the drain site it owns);
+* **RPL002 np-on-traced** — ``np.asarray`` / ``np.array`` /
+  ``np.<ufunc>`` inside a traced context: on a tracer it raises at
+  best and silently concretizes at worst;
+* **RPL003 traced-bool-if** — a Python ``if``/``while`` whose test
+  calls ``bool()`` / ``.item()`` / ``.any()`` / ``.all()`` or a
+  ``jnp.*`` reduction inside a traced context: a traced boolean forced
+  to a host value is a device→host sync per trace (use ``lax.cond`` /
+  ``jnp.where``);
+* **RPL004 wallclock-in-traced** — ``time.time`` / ``perf_counter`` /
+  ``datetime.now`` inside a traced context: wall-clock reads bake a
+  constant into the compiled program ("Date-free scan bodies").
+
+**Traced contexts** are functions the compiler traces: any function
+named ``*_impl``, any function decorated with ``jax.jit`` (bare or via
+``functools.partial``), and every function nested inside one (scan
+bodies, cond branches).  Everything else is host code where these
+patterns are legitimate, so the walk stays quiet there — except
+RPL001, which applies module-wide in compiled-path modules (the
+``COMPILED_PATH_DIRS`` set) because a drain is a drain wherever the
+call sits.
+
+Suppress a true-but-intended hit with a trailing ``# audit: allow``
+comment (optionally ``# audit: allow=RPL001``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Iterable
+
+from ringpop_tpu.analysis.findings import Finding
+
+# Library modules whose every line is compiled-path-adjacent: a host
+# sync here stalls the dispatch pipeline no matter which function it
+# sits in.  obs/ and cli/ are host-side by design (the ledger's drain
+# IS its job) and are not scanned by default.
+COMPILED_PATH_DIRS = ("models", "scenarios", "traffic", "ops", "parallel")
+
+_ALLOW_RE = re.compile(r"#\s*audit:\s*allow(?:=(?P<codes>[\w,]+))?")
+
+_WALLCLOCK_CALLS = {
+    ("time", "time"),
+    ("time", "perf_counter"),
+    ("time", "monotonic"),
+    ("time", "process_time"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+}
+
+_SYNC_METHODS = {"item", "any", "all", "tolist"}
+
+
+@dataclasses.dataclass
+class _Ctx:
+    traced: bool
+    func: str
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """jax.jit / partial(jax.jit, ...) / functools.partial(jax.jit,...)."""
+    target = dec
+    if isinstance(dec, ast.Call):
+        fname = _dotted(dec.func)
+        if fname and fname.split(".")[-1] == "partial" and dec.args:
+            target = dec.args[0]
+        else:
+            target = dec.func
+    name = _dotted(target)
+    return bool(name) and name.split(".")[-1] == "jit"
+
+
+def _dotted(node: ast.expr) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, compiled_path: bool):
+        self.path = path
+        self.lines = source.splitlines()
+        self.compiled_path = compiled_path
+        self.findings: list[Finding] = []
+        self.stack: list[_Ctx] = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _allowed(self, node: ast.AST, code: str) -> bool:
+        # the pragma may sit on any line the node spans (a wrapped call
+        # naturally carries it after the closing paren)
+        first = node.lineno
+        last = getattr(node, "end_lineno", None) or first
+        for ln in range(first, min(last, len(self.lines)) + 1):
+            m = _ALLOW_RE.search(self.lines[ln - 1])
+            if m:
+                codes = m.group("codes")
+                return codes is None or code in codes.split(",")
+        return False
+
+    def _emit(self, node: ast.AST, code: str, message: str) -> None:
+        if self._allowed(node, code):
+            return
+        self.findings.append(
+            Finding(
+                contract=f"lint:{code}",
+                severity="error",
+                entry=self.path,
+                message=message,
+                where=f"{self.path}:{node.lineno}",
+            )
+        )
+
+    @property
+    def _in_traced(self) -> bool:
+        return any(c.traced for c in self.stack)
+
+    # -- scope tracking -----------------------------------------------------
+
+    def _visit_func(self, node) -> None:
+        traced = node.name.endswith("_impl") or any(
+            _is_jit_decorator(d) for d in node.decorator_list
+        )
+        self.stack.append(_Ctx(traced=traced or self._in_traced, func=node.name))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.stack.append(_Ctx(traced=self._in_traced, func="<lambda>"))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    # -- checks -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "block_until_ready" and self.compiled_path:
+                self._emit(
+                    node, "RPL001",
+                    "block_until_ready() in a compiled-path module: a "
+                    "hidden drain point that serializes dispatch "
+                    "pipelining — drain in the caller that owns the "
+                    "pacing, or mark '# audit: allow'",
+                )
+        if self._in_traced and name:
+            head, _, tail = name.partition(".")
+            if head in ("np", "numpy") and tail and tail not in (
+                "ndarray", "dtype", "int32", "int64", "float32", "bool_",
+                "uint32", "int8", "uint8", "int16", "uint16", "newaxis",
+            ):
+                self._emit(
+                    node, "RPL002",
+                    f"{name}() inside traced context "
+                    f"'{self.stack[-1].func}': numpy on a traced value "
+                    "concretizes (host sync) or raises — use jnp",
+                )
+            if (head, tail) in _WALLCLOCK_CALLS or name in (
+                "perf_counter", "datetime.datetime.now"
+            ):
+                self._emit(
+                    node, "RPL004",
+                    f"wall-clock read {name}() inside traced context "
+                    f"'{self.stack[-1].func}': the value is baked into "
+                    "the compiled program at trace time",
+                )
+        self.generic_visit(node)
+
+    def _check_test(self, node: ast.stmt, test: ast.expr) -> None:
+        if not self._in_traced:
+            return
+        for sub in ast.walk(test):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _dotted(sub.func)
+            if isinstance(sub.func, ast.Attribute) and sub.func.attr in _SYNC_METHODS:
+                self._emit(
+                    node, "RPL003",
+                    f".{sub.func.attr}() in a Python branch condition "
+                    f"inside traced context '{self.stack[-1].func}': a "
+                    "traced boolean forced to host — use lax.cond / "
+                    "jnp.where",
+                )
+            elif name and name.split(".")[0] in ("jnp",) and name.split(
+                "."
+            )[-1] in ("any", "all", "sum", "max", "min"):
+                self._emit(
+                    node, "RPL003",
+                    f"{name}(...) in a Python branch condition inside "
+                    f"traced context '{self.stack[-1].func}': the "
+                    "branch concretizes a traced boolean — use "
+                    "lax.cond / jnp.where",
+                )
+            elif name == "bool":
+                self._emit(
+                    node, "RPL003",
+                    "bool(...) in a Python branch condition inside a "
+                    "traced context forces a traced value to host",
+                )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>",
+                compiled_path: bool = True) -> list[Finding]:
+    """Lint one module's source text; ``compiled_path`` enables the
+    module-wide RPL001 host-sync rule."""
+    tree = ast.parse(source, filename=path)
+    linter = _Linter(path, source, compiled_path)
+    linter.visit(tree)
+    return linter.findings
+
+
+def lint_paths(root: str | Path,
+               dirs: Iterable[str] = COMPILED_PATH_DIRS) -> list[Finding]:
+    """Lint every .py under ``root/<dir>`` for each compiled-path dir
+    (plus root-level modules, which host several ``*_impl``-free but
+    traced-adjacent helpers — they get the traced-context rules only)."""
+    root = Path(root)
+    findings: list[Finding] = []
+    seen: set[Path] = set()
+    for d in dirs:
+        for p in sorted((root / d).rglob("*.py")):
+            seen.add(p)
+            findings += lint_source(
+                p.read_text(), str(p.relative_to(root.parent)),
+                compiled_path=True,
+            )
+    for p in sorted(root.glob("*.py")):
+        if p not in seen:
+            findings += lint_source(
+                p.read_text(), str(p.relative_to(root.parent)),
+                compiled_path=False,
+            )
+    return findings

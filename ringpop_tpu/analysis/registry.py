@@ -1,0 +1,319 @@
+"""The audited entry points: every jitted program the repo ships.
+
+Each entry is a named builder producing a ``Built`` — the jitted
+callable, a small concrete fixture (argument arrays + static kwargs,
+modeled on ``benchmarks/mem_census.py``'s census fixtures), and the
+contract metadata the checks need: which flat argument leaves are PRNG
+key roots, whether the program donates its carry, and the element
+threshold above which an intermediate lands in the temporary-tensor
+census.
+
+Builders import the heavy model modules lazily (the mem_census idiom)
+so ``python -m ringpop_tpu audit --list`` costs nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+from ringpop_tpu.analysis.jaxpr_walk import tree_flat_index_of
+
+
+class Built(NamedTuple):
+    """One lowerable entry point plus its contract metadata."""
+
+    name: str
+    backend: str
+    jitted: Any  # the jax.jit-wrapped callable
+    args: tuple  # concrete positional arguments
+    statics: dict[str, Any]  # static keyword arguments
+    key_roots: dict[str, list[int]]  # stream name -> flat arg leaf idx
+    donates: bool  # program declares donate_argnums
+    min_aliased: int  # pinned floor of tf.aliasing_output params
+    census_min_elems: int  # census threshold (>= [N, C]-class)
+    dims: dict[str, int]  # named dims for shape tagging (N, C, ...)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntrySpec:
+    name: str
+    backends: tuple[str, ...]
+    build: Callable[..., Built]
+    doc: str
+
+
+def _dense_fixture(n: int):
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sim.SwimParams(loss=0.01)
+    return sim.init_state(n), sim.make_net(n), params
+
+
+def _delta_fixture(n: int, capacity: int):
+    from ringpop_tpu.models import swim_delta as sd
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.01), wire_cap=16, claim_grid=64
+    )
+    return sd.init_delta(n, capacity=capacity), sim.make_net(n), params
+
+
+def _build_run(backend: str, *, n: int, ticks: int, capacity: int) -> Built:
+    """swim_run / delta_run: the plain multi-tick scan."""
+    import jax
+
+    key = jax.random.PRNGKey(0)
+    if backend == "delta":
+        from ringpop_tpu.models import swim_delta as sd
+
+        state, net, params = _delta_fixture(n, capacity)
+        jitted, name = sd.delta_run, "delta_run"
+    else:
+        from ringpop_tpu.models import swim_sim as sim
+
+        state, net, params = _dense_fixture(n)
+        jitted, name = sim.swim_run, "swim_run"
+    args = (state, net, key)
+    return Built(
+        name=name,
+        backend=backend,
+        jitted=jitted,
+        args=args,
+        statics=dict(params=params, ticks=ticks),
+        key_roots={"protocol": tree_flat_index_of(args, key)},
+        donates=True,
+        min_aliased=1,
+        census_min_elems=n * (capacity if backend == "delta" else n),
+        dims=dict(N=n, C=capacity) if backend == "delta" else dict(N=n),
+    )
+
+
+def _scenario_parts(backend: str, n: int, ticks: int, capacity: int,
+                    latency_buckets: int = 0):
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.scenarios.compile import compile_spec
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+    if backend == "delta":
+        state, net, params = _delta_fixture(n, capacity)
+        base_loss = params.swim.loss
+    else:
+        state, net, params = _dense_fixture(n)
+        base_loss = params.loss
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": ticks,
+            "events": [
+                {"at": min(max(ticks // 4, 1), ticks - 1),
+                 "op": "kill", "node": 0},
+                {"at": min(max(ticks // 2, 1), ticks - 1),
+                 "op": "loss", "p": 0.05},
+            ],
+        }
+    )
+    compiled = compile_spec(spec, n, base_loss=base_loss)
+    keys = jax.random.split(jax.random.PRNGKey(0), ticks)
+    ct = None
+    if latency_buckets:
+        from ringpop_tpu.models import checksum as cksum
+        from ringpop_tpu.traffic.workloads import compile_traffic
+
+        m = min(4 * n, 128)
+        ct = compile_traffic(
+            {"keys_per_tick": m, "pool": 4 * m,
+             "latency_buckets": latency_buckets},
+            n,
+            cksum.default_addresses(n),
+        )
+    return state, net, params, compiled, jnp.asarray(keys), ct
+
+
+def _build_scenario(backend: str, *, n: int, ticks: int, capacity: int,
+                    latency_buckets: int = 0) -> Built:
+    """run_scenario's jitted scan (runner._scenario_scan); with
+    ``latency_buckets`` the traffic + SLO-latency-coupled variant."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.scenarios import runner
+
+    state, net, params, compiled, keys, ct = _scenario_parts(
+        backend, n, ticks, capacity, latency_buckets
+    )
+    args = (
+        state,
+        net.up,
+        net.responsive,
+        jnp.zeros((n,), jnp.int32),
+        None,  # period
+        compiled.ev_tick,
+        compiled.ev_kind,
+        compiled.ev_node,
+        compiled.p_tick,
+        compiled.p_gid,
+        compiled.loss,
+        keys,
+        ct.tensors if ct is not None else None,
+        None,  # tick0
+        compiled.faults,
+    )
+    key_roots = {"protocol": tree_flat_index_of(args, keys)}
+    if ct is not None:
+        key_roots["workload"] = tree_flat_index_of(args, ct.tensors.key)
+    name = "run_scenario+traffic" if latency_buckets else "run_scenario"
+    dims = dict(N=n)
+    if backend == "delta":
+        dims["C"] = capacity
+    if ct is not None:
+        dims["M"] = ct.static.m
+        dims["B"] = latency_buckets
+    return Built(
+        name=name,
+        backend=backend,
+        jitted=runner._scenario_scan,
+        args=args,
+        statics=dict(
+            params=params,
+            has_revive=compiled.has_revive,
+            traffic=ct.static if ct is not None else None,
+        ),
+        key_roots=key_roots,
+        donates=True,
+        min_aliased=1,
+        census_min_elems=n * (capacity if backend == "delta" else n),
+        dims=dims,
+    )
+
+
+def _build_sweep(backend: str, *, n: int, ticks: int, capacity: int,
+                 replicas: int) -> Built:
+    """run_sweep's jitted vmapped scan (sweep._sweep_scan)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.scenarios import sweep as ssweep
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+    if backend == "delta":
+        state, net, params = _delta_fixture(n, capacity)
+        base_loss = params.swim.loss
+    else:
+        state, net, params = _dense_fixture(n)
+        base_loss = params.loss
+    spec = ScenarioSpec.from_dict(
+        {"ticks": ticks,
+         "events": [{"at": min(max(ticks // 4, 1), ticks - 1),
+                     "op": "kill", "node": 0}]}
+    )
+    cs = ssweep.compile_sweep(spec, n, replicas=replicas, base_loss=base_loss)
+    rkeys = list(jax.random.split(jax.random.PRNGKey(0), replicas))
+    keys = ssweep.sweep_key_schedule(rkeys, cs)
+    args = (
+        ssweep._broadcast_replicas(state, replicas),
+        ssweep._broadcast_replicas(net.up, replicas),
+        ssweep._broadcast_replicas(net.responsive, replicas),
+        ssweep._broadcast_replicas(jnp.zeros((n,), jnp.int32), replicas),
+        None,  # period
+        cs.ev_tick,
+        cs.ev_kind,
+        cs.ev_node,
+        cs.base.p_tick,
+        cs.base.p_gid,
+        cs.loss,
+        keys,
+    )
+    return Built(
+        name="run_sweep",
+        backend=backend,
+        jitted=ssweep._sweep_scan,
+        args=args,
+        statics=dict(params=params, has_revive=cs.base.has_revive),
+        key_roots={"protocol": tree_flat_index_of(args, keys)},
+        donates=True,
+        min_aliased=1,
+        census_min_elems=replicas * n
+        * (capacity if backend == "delta" else n),
+        dims=dict(N=n, R=replicas, **(dict(C=capacity)
+                                      if backend == "delta" else {})),
+    )
+
+
+def _build_recv_merge(backend: str, *, n: int, **_ignored) -> Built:
+    """The Pallas receiver-merge kernel's host-level jit wrapper
+    (interpret mode — the Mosaic kernel itself needs a TPU to compile,
+    but the jaxpr contracts are lowering-independent)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.ops import recv_merge_pallas as rmp
+
+    key = jax.random.PRNGKey(0)
+    t_safe = jax.random.randint(key, (n,), 0, n, dtype=jnp.int32)
+    fwd_ok = jnp.ones((n,), bool)
+    claims = jnp.zeros((n, n), jnp.int32)
+    args = (t_safe, fwd_ok, claims)
+    return Built(
+        name="recv_merge_pallas",
+        backend=backend,
+        jitted=rmp._recv_merge_pallas_jit,
+        args=args,
+        statics=dict(interpret=True),
+        key_roots={},
+        donates=False,
+        min_aliased=0,
+        census_min_elems=n * n,
+        dims=dict(N=n),
+    )
+
+
+ENTRY_POINTS: dict[str, EntrySpec] = {
+    "swim_run": EntrySpec(
+        "swim_run", ("dense",), _build_run,
+        "the dense multi-tick scan (models/swim_sim.py)"),
+    "delta_run": EntrySpec(
+        "delta_run", ("delta",), _build_run,
+        "the delta multi-tick scan (models/swim_delta.py)"),
+    "run_scenario": EntrySpec(
+        "run_scenario", ("dense", "delta"), _build_scenario,
+        "the compiled fault-timeline scan (scenarios/runner.py)"),
+    "run_scenario+traffic": EntrySpec(
+        "run_scenario+traffic", ("dense", "delta"),
+        lambda backend, **kw: _build_scenario(
+            backend, latency_buckets=kw.pop("latency_buckets", 8), **kw),
+        "the scenario scan co-running a key workload with the SLO "
+        "latency plane (traffic/engine.py + traffic/latency.py)"),
+    "run_sweep": EntrySpec(
+        "run_sweep", ("dense", "delta"), _build_sweep,
+        "the vmapped R-replica sweep scan (scenarios/sweep.py)"),
+    "recv_merge_pallas": EntrySpec(
+        "recv_merge_pallas", ("dense",), _build_recv_merge,
+        "the Pallas receiver-merge kernel wrapper "
+        "(ops/recv_merge_pallas.py, interpret lowering)"),
+}
+
+def build_entry(name: str, backend: str, *, n: int = 64, ticks: int = 4,
+                capacity: int = 64, replicas: int = 2,
+                **extra: Any) -> Built:
+    """Materialize one (entry, backend) fixture at the given shape."""
+    spec = ENTRY_POINTS[name]
+    if backend not in spec.backends:
+        raise ValueError(f"{name} has no {backend} backend "
+                         f"(has {spec.backends})")
+    kw: dict[str, Any] = dict(n=n, ticks=ticks, capacity=capacity, **extra)
+    if name == "run_sweep":
+        kw["replicas"] = replicas
+    return spec.build(backend, **kw)
+
+
+def iter_entries(names=None, backends=None):
+    """Yield every requested (entry name, backend) pair."""
+    for name, spec in ENTRY_POINTS.items():
+        if names is not None and name not in names:
+            continue
+        for backend in spec.backends:
+            if backends is not None and backend not in backends:
+                continue
+            yield name, backend

@@ -26,7 +26,6 @@ from typing import Any
 import jax
 import numpy as np
 
-from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.models.cluster import SimCluster
 from ringpop_tpu.models.swim_delta import DeltaState
 from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams

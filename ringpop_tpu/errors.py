@@ -58,14 +58,20 @@ class FieldRequiredError(RingpopError):
     type = "ringpop.field-required"
 
     def __init__(self, argument: str = "", field: str = ""):
-        super().__init__(f"Expected `{field}` to be defined on `{argument}`", argument=argument, field=field)
+        super().__init__(
+            f"Expected `{field}` to be defined on `{argument}`",
+            argument=argument, field=field,
+        )
 
 
 class MethodRequiredError(RingpopError):
     type = "ringpop.method-required"
 
     def __init__(self, argument: str = "", method: str = ""):
-        super().__init__(f"Expected `{method}` to be implemented by `{argument}`", argument=argument, method=method)
+        super().__init__(
+            f"Expected `{method}` to be implemented by `{argument}`",
+            argument=argument, method=method,
+        )
 
 
 class DuplicateHookError(RingpopError):
@@ -117,7 +123,10 @@ class JoinDurationExceededError(RingpopError):
     type = "ringpop.join-duration-exceeded"
 
     def __init__(self, duration: float = 0, max: float = 0):
-        super().__init__(f"Join duration of `{duration}` exceeded max `{max}`", duration=duration, max=max)
+        super().__init__(
+            f"Join duration of `{duration}` exceeded max `{max}`",
+            duration=duration, max=max,
+        )
 
 
 class JoinAttemptsExceededError(RingpopError):
@@ -146,7 +155,8 @@ class InvalidJoinAppError(RingpopError):
 
     def __init__(self, expected: str = "", actual: str = ""):
         super().__init__(
-            f"A node tried joining a different app cluster. Expected ({expected}) actual ({actual}).",
+            f"A node tried joining a different app cluster. "
+            f"Expected ({expected}) actual ({actual}).",
             expected=expected,
             actual=actual,
         )
@@ -209,7 +219,10 @@ class PingReqPingError(RingpopError):
     type = "ringpop.ping-req.ping"
 
     def __init__(self, err_message: str = ""):
-        super().__init__(f"An error occurred on ping-req ping: {err_message}", errMessage=err_message)
+        super().__init__(
+            f"An error occurred on ping-req ping: {err_message}",
+            errMessage=err_message,
+        )
 
 
 # -- request proxy (lib/request-proxy/{index,send}.js) ----------------------
@@ -220,7 +233,8 @@ class InvalidCheckSumError(RingpopError):
 
     def __init__(self, expected: Any = None, actual: Any = None):
         super().__init__(
-            f"Expected the remote checksum to match local checksum. Expected {expected} actual {actual}.",
+            f"Expected the remote checksum to match local checksum. "
+            f"Expected {expected} actual {actual}.",
             expected=expected,
             actual=actual,
         )

@@ -16,7 +16,7 @@ Tie-break on (astronomically rare) 32-bit hash collisions is by server name
 from __future__ import annotations
 
 import bisect
-from typing import Any, Callable
+from typing import Callable
 
 from ringpop_tpu.ops.farmhash import farmhash32
 from ringpop_tpu.utils.events import EventEmitter

@@ -9,7 +9,7 @@ produced on-device by ops/checksum.py.
 from __future__ import annotations
 
 import uuid
-from typing import Any, Callable
+from typing import Any
 
 from ringpop_tpu.changeset_merge import merge_membership_changesets
 from ringpop_tpu.member import Member, Status

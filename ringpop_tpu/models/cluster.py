@@ -28,7 +28,7 @@ from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.obs import bridge as obs_bridge
 from ringpop_tpu.obs.ledger import default_ledger
 from ringpop_tpu.ops import checksum_device as ckdev
-from ringpop_tpu.models.swim_sim import ClusterState, NetState, SwimParams
+from ringpop_tpu.models.swim_sim import NetState, SwimParams
 
 DEFAULT_BASE_INC = 1_400_000_000_000  # host clock epoch (clock.SimScheduler)
 

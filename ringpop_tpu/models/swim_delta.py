@@ -629,7 +629,8 @@ def refresh_carried(state: DeltaState) -> DeltaState:
     RINGPOP_WIDE_METHOD: they trade the per-tick [N, C] base gathers
     for extra cond-carry volume on the active paths — measured a ~2%
     LOSS on single-core CPU (151,269 vs 154,637 idle node-rounds/s at
-    n=8,192, both idle-box with narrowed cond carries) but aimed at TPU, where random gathers cost far more
+    n=8,192, both idle-box with narrowed cond carries) but aimed at
+    TPU, where random gathers cost far more
     relative to elementwise; RINGPOP_CARRY_SLOTBASE=1 enables them for
     the on-chip race.  Read at state-BUILD time only — inside the step
     the carry configuration is a property of the state (see
@@ -967,7 +968,11 @@ def _merge_claims(
     # --- matched updates: invert (claim -> slot) into (slot -> claim) --
     # a slot's updating claim, if any, is located by searching the
     # claim subjects for the slot's subject (claims are sorted too).
-    s_pos = _row_searchsorted(c_subj, jnp.where(stats_live := (state.d_subj < SENTINEL), state.d_subj, SENTINEL))
+    s_pos = _row_searchsorted(
+        c_subj,
+        jnp.where(stats_live := (state.d_subj < SENTINEL),
+                  state.d_subj, SENTINEL),
+    )
     s_pos_c = jnp.minimum(s_pos, kk - 1)
     s_claim_subj = jnp.take_along_axis(c_subj, s_pos_c, axis=1)
     s_hit = stats_live & (s_claim_subj == state.d_subj)
@@ -1748,8 +1753,12 @@ def delta_step_impl(
             fs_valid0 = (fs_subj0 < SENTINEL) & fs_apply[:, None]
             # merge the W-wide ack list into the C-wide claim set (the
             # non-full-sync senders still apply their normal claims)
-            m_subj = jnp.concatenate([jnp.where(a_valid, a_subj, SENTINEL), jnp.where(fs_valid0, fs_subj0, SENTINEL)], axis=1)
-            m_key = jnp.concatenate([jnp.where(a_valid, a_key, 0), jnp.where(fs_valid0, fs_key0, 0)], axis=1)
+            m_subj = jnp.concatenate(
+                [jnp.where(a_valid, a_subj, SENTINEL),
+                 jnp.where(fs_valid0, fs_subj0, SENTINEL)], axis=1)
+            m_key = jnp.concatenate(
+                [jnp.where(a_valid, a_key, 0),
+                 jnp.where(fs_valid0, fs_key0, 0)], axis=1)
             m_valid = jnp.concatenate([a_valid, fs_valid0], axis=1)
             out = _merge_claims(
                 st2, *_sort_claim_rows(m_subj, m_key, m_valid), sl_start

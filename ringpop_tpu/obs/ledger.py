@@ -92,11 +92,16 @@ def memory_row(compiled: Any) -> dict[str, int | bool]:
 
 def _signature(args: tuple, statics: dict) -> tuple:
     """Hashable abstract signature of a dispatch: pytree structure plus
-    (shape, dtype) per array leaf and repr per static leaf.  Matches
-    jit's recompile granularity closely enough to reuse executables."""
+    (shape, dtype) per array leaf, and the STATIC kwargs as a separate
+    name-keyed component — so when a second cold compile happens the
+    ledger can name exactly which static argument forced it (the
+    trace-contract auditor's recompile-source attribution; a static
+    that should have been a traced batch axis shows up here by name).
+    Matches jit's recompile granularity closely enough to reuse
+    executables."""
     import jax
 
-    leaves, treedef = jax.tree_util.tree_flatten((args, statics))
+    leaves, treedef = jax.tree_util.tree_flatten(args)
     parts = []
     for leaf in leaves:
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
@@ -107,7 +112,61 @@ def _signature(args: tuple, statics: dict) -> tuple:
             parts.append((tuple(leaf.shape), str(leaf.dtype), placement))
         else:
             parts.append(repr(leaf))
-    return (str(treedef), tuple(parts))
+    static_items = tuple(sorted((k, repr(v)) for k, v in statics.items()))
+    return (str(treedef), tuple(parts), static_items)
+
+
+def _sig_hash(sig: tuple) -> str:
+    """Short stable digest of a signature — rows carry it so a ledger
+    reader can assert "exactly one cold compile per signature" without
+    reconstructing the signature itself (tools/obs_smoke.sh)."""
+    import hashlib
+
+    return hashlib.sha1(repr(sig).encode()).hexdigest()[:12]
+
+
+def _clip(s: str, width: int = 90) -> str:
+    return s if len(s) <= width else s[: width - 1] + "…"
+
+
+def _sig_diff(old: tuple, new: tuple) -> list[str]:
+    """Human-readable causes of a recompile: which components of the
+    abstract signature changed between two dispatches of one program."""
+    causes: list[str] = []
+    old_tree, old_parts, old_statics = old
+    new_tree, new_parts, new_statics = new
+    if old_tree != new_tree:
+        causes.append("argument pytree structure changed")
+    if len(old_parts) != len(new_parts):
+        causes.append(
+            f"argument leaf count {len(old_parts)} -> {len(new_parts)}"
+        )
+    else:
+        for i, (a, b) in enumerate(zip(old_parts, new_parts)):
+            if a == b:
+                continue
+            if isinstance(a, tuple) and isinstance(b, tuple):
+                what = (
+                    "shape" if a[0] != b[0]
+                    else "dtype" if a[1] != b[1] else "placement"
+                )
+                causes.append(
+                    f"arg leaf {i} {what} changed: "
+                    f"{a[0] if what == 'shape' else a[1] if what == 'dtype' else a[2]}"
+                    f" -> "
+                    f"{b[0] if what == 'shape' else b[1] if what == 'dtype' else b[2]}"
+                )
+            else:
+                causes.append(f"arg leaf {i} changed: {_clip(repr(a))} -> "
+                              f"{_clip(repr(b))}")
+    od, nd = dict(old_statics), dict(new_statics)
+    for k in sorted(set(od) | set(nd)):
+        if od.get(k) != nd.get(k):
+            causes.append(
+                f"static '{k}' changed: {_clip(od.get(k, '<absent>'))} -> "
+                f"{_clip(nd.get(k, '<absent>'))}"
+            )
+    return causes
 
 
 class DispatchLedger:
@@ -121,6 +180,9 @@ class DispatchLedger:
         self._explicit = path is not None
         self._enabled = path is not None
         self._compiled: dict[tuple, tuple[Any, dict[str, Any]]] = {}
+        # per-program signatures seen, in arrival order: the recompile
+        # attribution diffs a new cold signature against these
+        self._sigs: dict[str, list[tuple]] = {}
         self._lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
@@ -154,6 +216,7 @@ class DispatchLedger:
         with self._lock:
             self.rows.clear()
             self._compiled.clear()
+            self._sigs.clear()
 
     # -- recording ----------------------------------------------------------
 
@@ -236,10 +299,21 @@ class DispatchLedger:
             return jitted(*args, **static_kwargs), None
         import jax
 
-        key = (program, _signature(args, static_kwargs))
+        sig = _signature(args, static_kwargs)
+        key = (program, sig)
         cold = key not in self._compiled
         trace_s = compile_s = 0.0
+        recompile_cause: list[str] | None = None
         if cold:
+            # recompile-source attribution: a SECOND cold compile for a
+            # program means some signature component drifted — name it
+            # (the closest prior signature's diff), so "which static arg
+            # forced this" is answered by the row, not by a bisection
+            prior = self._sigs.setdefault(program, [])
+            if prior:
+                recompile_cause = min(
+                    (_sig_diff(p, sig) for p in prior), key=len
+                ) or ["signature hash collision (identical components)"]
             t0 = time.perf_counter()
             lowered = jitted.lower(*args, **static_kwargs)
             t1 = time.perf_counter()
@@ -247,16 +321,20 @@ class DispatchLedger:
             t2 = time.perf_counter()
             trace_s, compile_s = t1 - t0, t2 - t1
             self._compiled[key] = (compiled, memory_row(compiled))
+            prior.append(sig)
         compiled, mem = self._compiled[key]
         out = compiled(*args)
         row = {
             "program": program,
             "platform": jax.default_backend(),
             "cold": cold,
+            "sig": _sig_hash(sig),
             "trace_s": round(trace_s, 6),
             "compile_s": round(compile_s, 6),
             **mem,
         }
+        if recompile_cause is not None:
+            row["recompile_cause"] = recompile_cause
         if _meta:
             row.update(_meta)
         return out, row

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.models.swim_sim import NONE, STATUS_NAMES
+from ringpop_tpu.models.swim_sim import STATUS_NAMES
 from ringpop_tpu.ops.farmhash_jax import farmhash32_batch_jax
 
 _POW10 = tuple(10**i for i in range(10))

@@ -26,7 +26,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.hashring import DEFAULT_REPLICA_POINTS
-from ringpop_tpu.ops.farmhash import farmhash32
 from ringpop_tpu.ops.farmhash_jax import farmhash32_batch_jax
 
 
@@ -70,7 +69,9 @@ def build_ring(
     )
 
 
-def encode_strings(strings: Sequence[str], pad_to: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+def encode_strings(
+    strings: Sequence[str], pad_to: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
     """Pack strings into the (padded uint8 buffer, length) form the
     device hash kernels consume."""
     raw = [s.encode() for s in strings]

@@ -44,7 +44,6 @@ from ringpop_tpu.models.swim_delta import (
 from ringpop_tpu.models.swim_sim import (
     ClusterState,
     NetState,
-    SwimParams,
     swim_run_impl,
     swim_step_impl,
 )

@@ -13,7 +13,6 @@ backend (models/swim_sim.py) can be validated against it.
 from __future__ import annotations
 
 import collections
-import json
 import os
 import random
 from typing import Any, Callable

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.scenarios.spec import Event, ScenarioSpec
+from ringpop_tpu.scenarios.spec import ScenarioSpec
 
 
 class LinkRule(NamedTuple):

@@ -32,6 +32,9 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
+import pytest  # noqa: E402  (jax platform pin must precede any import)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -39,3 +42,32 @@ def pytest_configure(config):
         "profiling prefixes, real-process integration); deselected by "
         "default via pytest.ini addopts, run with -m slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "allow_transfers: opt out of the tier-1 disallow transfer "
+        "guard (host-loop oracles and host<->device round-trip tests "
+        "that transfer implicitly by design)",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _no_implicit_transfers(request):
+    """Tier-1 compiled-path tests run under
+    ``jax.transfer_guard_device_to_host("disallow")``: a silent
+    IMPLICIT device->host sync — the direction that serializes dispatch
+    pipelining — raises immediately instead of quietly stalling.  The
+    host->device direction stays open (feeding a Python scalar to a
+    jitted call is an implicit h2d and is ubiquitous + benign); the
+    explicit transfers (``jax.device_get``, ``np.asarray`` on a
+    concrete array) stay legal too — reading RESULTS is fine, it is the
+    hidden mid-pipeline drain the guard bans.  On this CPU-only suite
+    the guard is ~free; on a real accelerator it is the runtime
+    tripwire for the trace-contract auditor's host-transfer contract
+    (ringpop_tpu/analysis).  Opt out with
+    ``@pytest.mark.allow_transfers`` for host-loop oracles that
+    transfer implicitly by design."""
+    if request.node.get_closest_marker("allow_transfers"):
+        yield
+        return
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield

@@ -1,0 +1,579 @@
+"""Trace-contract auditor tests (ringpop_tpu/analysis).
+
+Two lanes:
+
+* known-bad fixture programs — one per contract — each asserting the
+  SPECIFIC violation is reported: a host-sync scan (contract 1), a
+  dropped donation (2), an f64 carry and a budget drift (3), a shared
+  key lineage and a key drawn twice (4), an [N, N] temporary landing
+  in the census (5);
+* the clean lane: a well-formed program yields ZERO findings, and the
+  real registry entry points audit clean (the fast representative here
+  is ``swim_run``; the full registry runs in the CI audit job and the
+  slow lane).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.analysis import budgets, lint_source
+from ringpop_tpu.analysis.contracts import (
+    EntryReport,
+    _lower_text,
+    audit_entry,
+    check_carry_dtypes,
+    check_donation,
+    check_host_transfers,
+    temp_census,
+)
+from ringpop_tpu.analysis.jaxpr_walk import (
+    key_lineage,
+    primary_scans,
+    scan_carry_avals,
+)
+from ringpop_tpu.analysis.registry import Built, build_entry
+from ringpop_tpu.obs.ledger import DispatchLedger
+
+
+def _fixture_built(jitted, args, statics=None, *, donates=False,
+                   min_aliased=0, key_roots=None, name="fixture"):
+    return Built(
+        name=name, backend="dense", jitted=jitted, args=args,
+        statics=statics or {}, key_roots=key_roots or {},
+        donates=donates, min_aliased=min_aliased,
+        census_min_elems=1 << 30, dims={},
+    )
+
+
+# ---------------------------------------------------------------------------
+# contract 1: host transfers
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_scan_detected():
+    from jax.experimental import io_callback
+
+    def hostfn(x):
+        return x
+
+    def body(c, x):
+        c = io_callback(hostfn, jax.ShapeDtypeStruct(c.shape, c.dtype), c)
+        return c + x, c.sum()
+
+    def bad(init, xs):
+        return jax.lax.scan(body, init, xs)
+
+    closed = jax.make_jaxpr(bad)(
+        jnp.zeros((4,), jnp.int32), jnp.zeros((8, 4), jnp.int32)
+    )
+    findings, hits = check_host_transfers(closed, "bad-host-sync")
+    assert hits == 1
+    (f,) = findings
+    assert f.contract == "host-transfer" and f.severity == "error"
+    assert "io_callback" in f.message and "scan body" in f.message
+
+
+def test_clean_scan_no_host_prims():
+    def ok(init, xs):
+        return jax.lax.scan(lambda c, x: (c + x, c), init, xs)
+
+    closed = jax.make_jaxpr(ok)(
+        jnp.zeros((4,), jnp.int32), jnp.zeros((8, 4), jnp.int32)
+    )
+    findings, hits = check_host_transfers(closed, "ok")
+    assert hits == 0 and findings == []
+
+
+# ---------------------------------------------------------------------------
+# contract 2: donation
+# ---------------------------------------------------------------------------
+
+
+def test_dropped_donation_detected():
+    # the donated input's dtype never reaches an output: lowering warns
+    # and emits no aliasing — both halves of the check must fire
+    f = jax.jit(
+        lambda a: (a.astype(jnp.int32) * 0).sum(), donate_argnums=(0,)
+    )
+    built = _fixture_built(
+        f, (jnp.zeros((64,), jnp.float32),), donates=True, min_aliased=1,
+        name="bad-donation",
+    )
+    text, warns = _lower_text(built)
+    findings, aliased = check_donation(built, text, warns)
+    assert aliased == 0
+    assert any("donation dropped" in f.message for f in findings)
+    assert any("aliases only 0" in f.message for f in findings)
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_applied_donation_clean():
+    f = jax.jit(lambda a: a + 1, donate_argnums=(0,))
+    built = _fixture_built(
+        f, (jnp.zeros((64,), jnp.float32),), donates=True, min_aliased=1
+    )
+    text, warns = _lower_text(built)
+    findings, aliased = check_donation(built, text, warns)
+    assert aliased >= 1 and findings == []
+
+
+# ---------------------------------------------------------------------------
+# contract 3: carry dtypes
+# ---------------------------------------------------------------------------
+
+
+def test_f64_carry_detected():
+    jax.config.update("jax_enable_x64", True)
+    try:
+        def run(init, xs):
+            return jax.lax.scan(lambda c, x: (c + x, c.sum()), init, xs)
+
+        closed = jax.make_jaxpr(run)(
+            jnp.zeros((4,), jnp.float64), jnp.zeros((8, 4), jnp.float64)
+        )
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    built = _fixture_built(jax.jit(lambda: 0), (), name="bad-f64-carry")
+    findings, carries = check_carry_dtypes(closed, built)
+    wide = [f for f in findings
+            if f.severity == "error" and "8 bytes/elem" in f.message]
+    assert wide, findings
+    assert "float64" in wide[0].message
+    assert any("float64[4]" in leaf for leaves in carries.values()
+               for leaf in leaves)
+
+
+def test_budget_drift_detected(monkeypatch):
+    # a pinned budget of {int8: 1} against an int32 carry = the
+    # "widened int slot" review gate
+    def run(init, xs):
+        return jax.lax.scan(lambda c, x: (c + x, c), init, xs)
+
+    closed = jax.make_jaxpr(run)(
+        jnp.zeros((4,), jnp.int32), jnp.zeros((8, 4), jnp.int32)
+    )
+    built = _fixture_built(jax.jit(lambda: 0), (), name="drift")
+    monkeypatch.setitem(
+        budgets.CARRY_BUDGETS, ("drift", "dense"), {"int8": 1}
+    )
+    findings, _ = check_carry_dtypes(closed, built)
+    drift = [f for f in findings if "budget drift" in f.message]
+    assert drift and drift[0].severity == "error"
+    assert "int8" in drift[0].message and "int32" in drift[0].message
+
+
+def test_pinned_budget_match_clean(monkeypatch):
+    def run(init, xs):
+        return jax.lax.scan(lambda c, x: (c + x, c), init, xs)
+
+    closed = jax.make_jaxpr(run)(
+        jnp.zeros((4,), jnp.int32), jnp.zeros((8, 4), jnp.int32)
+    )
+    built = _fixture_built(jax.jit(lambda: 0), (), name="pinned")
+    monkeypatch.setitem(
+        budgets.CARRY_BUDGETS, ("pinned", "dense"), {"int32": 1}
+    )
+    findings, _ = check_carry_dtypes(closed, built)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# contract 4: PRNG key lineage
+# ---------------------------------------------------------------------------
+
+
+def test_shared_key_lineage_detected():
+    # two declared streams combined into one key: lineage shared
+    def bad(k1, k2):
+        return jax.random.uniform(k1 ^ k2, (4,))
+
+    k1, k2 = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+    closed = jax.make_jaxpr(bad)(k1, k2)
+    findings, _ = key_lineage(
+        closed, {"protocol": [0], "workload": [1]}, "bad-mixed"
+    )
+    mixing = [f for f in findings if "prng-mixing" in f.message]
+    assert mixing and mixing[0].severity == "error"
+    assert "protocol" in mixing[0].message
+    assert "workload" in mixing[0].message
+
+
+def test_key_reuse_detected():
+    # the same key value drawn twice: two "independent" streams read
+    # the same bits
+    def bad(key):
+        a = jax.random.uniform(key, (4,))
+        b = jax.random.normal(key, (4,))
+        return a + b
+
+    closed = jax.make_jaxpr(bad)(jax.random.PRNGKey(0))
+    findings, _ = key_lineage(closed, {"protocol": [0]}, "bad-reuse")
+    reuse = [f for f in findings if "prng-reuse" in f.message]
+    assert reuse and reuse[0].severity == "error"
+
+
+def test_fold_in_fanout_clean():
+    # the repo's sanctioned idiom: domain-tag fold_in + per-tick fold,
+    # every draw on its own derived key — zero findings
+    def ok(key, t):
+        ka = jax.random.fold_in(key, 0x5A10)
+        kb = jax.random.fold_in(key, t)
+        k1, k2 = jax.random.split(kb)
+        return (jax.random.uniform(ka, (2,)),
+                jax.random.uniform(k1, (2,)),
+                jax.random.uniform(k2, (2,)))
+
+    closed = jax.make_jaxpr(ok)(jax.random.PRNGKey(0), jnp.int32(3))
+    findings, summary = key_lineage(closed, {"workload": [0]}, "ok")
+    assert findings == []
+    assert summary["roots"]["workload"] == 3
+
+
+def test_carry_threaded_key_reuse_detected():
+    # the classic scan reuse: key rides the carry unchanged and is
+    # drawn every iteration — one draw SITE, T draws of one value
+    def bad(key, xs):
+        def body(k, x):
+            return k, jax.random.uniform(k, ()) + x
+
+        return jax.lax.scan(body, key, xs)
+
+    closed = jax.make_jaxpr(bad)(
+        jax.random.PRNGKey(0), jnp.zeros((6,), jnp.float32)
+    )
+    findings, _ = key_lineage(closed, {"protocol": [0]}, "bad-carry")
+    assert any(
+        "threaded unchanged" in f.message and f.severity == "error"
+        for f in findings
+    ), [str(f) for f in findings]
+
+    # the sanctioned carry pattern: split per iteration — clean
+    def ok(key, xs):
+        def body(k, x):
+            k, sub = jax.random.split(k)
+            return k, jax.random.uniform(sub, ()) + x
+
+        return jax.lax.scan(body, key, xs)
+
+    closed = jax.make_jaxpr(ok)(
+        jax.random.PRNGKey(0), jnp.zeros((6,), jnp.float32)
+    )
+    findings, _ = key_lineage(closed, {"protocol": [0]}, "ok-carry")
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_cond_branch_draws_not_reuse():
+    # mutually exclusive branches each drawing the same key once is ONE
+    # draw at runtime — must not be flagged; a single branch drawing
+    # twice still must be
+    def ok(pred, key):
+        return jax.lax.cond(
+            pred,
+            lambda k: jax.random.uniform(k, (2,)),
+            lambda k: jax.random.normal(k, (2,)),
+            key,
+        )
+
+    closed = jax.make_jaxpr(ok)(jnp.bool_(True), jax.random.PRNGKey(0))
+    findings, summary = key_lineage(closed, {"protocol": [1]}, "ok-cond")
+    assert [f for f in findings if "prng-reuse" in f.message] == []
+    assert summary["roots"]["protocol"] == 1
+
+    def bad(pred, key):
+        def left(k):
+            return jax.random.uniform(k, (2,)) + jax.random.normal(k, (2,))
+
+        return jax.lax.cond(pred, left, lambda k: jax.random.uniform(k, (2,)), key)
+
+    closed = jax.make_jaxpr(bad)(jnp.bool_(True), jax.random.PRNGKey(0))
+    findings, _ = key_lineage(closed, {"protocol": [1]}, "bad-cond")
+    assert any("prng-reuse" in f.message for f in findings)
+
+
+def test_scan_threaded_key_lineage():
+    # a per-tick key row sliced from a [T, 2] schedule inside a scan —
+    # the entry points' shape — must stay clean and count its draws
+    def ok(init, keys):
+        def body(c, key):
+            return c + jax.random.uniform(key, c.shape), c.sum()
+
+        return jax.lax.scan(body, init, keys)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 6)
+    closed = jax.make_jaxpr(ok)(jnp.zeros((4,), jnp.float32), keys)
+    findings, summary = key_lineage(closed, {"protocol": [1]}, "ok-scan")
+    assert findings == []
+    assert summary["roots"]["protocol"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# contract 5: temporary-tensor census
+# ---------------------------------------------------------------------------
+
+
+def test_census_lists_nxn_intermediate():
+    n = 32
+
+    def prog(a):
+        big = a[:, None] * a[None, :]  # the [N, N] temporary
+        return big.sum()
+
+    closed = jax.make_jaxpr(prog)(jnp.arange(n, dtype=jnp.float32))
+    rows = temp_census(closed, dims={"N": n}, min_elems=n * n, entry="fx")
+    assert rows, "census missed the [N, N] intermediate"
+    tags = {r["tag"] for r in rows}
+    assert "NxN" in tags
+    for r in rows:
+        assert r["dtype"] and r["primitive"] and r["elems_each"] >= n * n
+
+
+def test_census_ambiguous_dim_tagged_with_both_names():
+    # n == capacity at small fixture shapes: the tag must keep every
+    # candidate name, not silently pick one
+    n = 16
+
+    def prog(a):
+        return (a[:, None] * a[None, :]).sum()
+
+    closed = jax.make_jaxpr(prog)(jnp.arange(n, dtype=jnp.float32))
+    rows = temp_census(
+        closed, dims={"N": n, "C": n}, min_elems=n * n, entry="fx"
+    )
+    assert rows and all("N|C" in r["tag"] for r in rows), rows
+
+
+def test_census_threshold_respected():
+    def prog(a):
+        return (a[:, None] * a[None, :]).sum()
+
+    closed = jax.make_jaxpr(prog)(jnp.arange(8, dtype=jnp.float32))
+    # min_elems above 8x8 and N declared as something else: no rows
+    rows = temp_census(closed, dims={"N": 999}, min_elems=1000, entry="fx")
+    assert rows == []
+
+
+# ---------------------------------------------------------------------------
+# the clean lane: fixture + real entry point
+# ---------------------------------------------------------------------------
+
+
+def test_clean_program_zero_findings(monkeypatch):
+    @partial(jax.jit, donate_argnums=(0,))
+    def clean(carry, keys):
+        def body(c, key):
+            return c + jax.random.uniform(key, c.shape), c.sum()
+
+        out, ys = jax.lax.scan(body, carry, keys)
+        return out, ys
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    args = (jnp.zeros((8,), jnp.float32), keys)
+    built = _fixture_built(
+        clean, args, donates=True, min_aliased=1,
+        key_roots={"protocol": [1]}, name="clean",
+    )
+    monkeypatch.setitem(
+        budgets.CARRY_BUDGETS, ("clean", "dense"), {"float32": 1}
+    )
+    from ringpop_tpu.analysis import contracts
+
+    closed = contracts._trace(built)
+    text, warns = contracts._lower_text(built)
+    findings = []
+    f1, hits = check_host_transfers(closed, built.name)
+    f2, aliased = check_donation(built, text, warns)
+    f3, _ = check_carry_dtypes(closed, built)
+    f4, _ = key_lineage(closed, built.key_roots, built.name)
+    findings = f1 + f2 + f3 + f4
+    assert findings == [], [str(f) for f in findings]
+    assert hits == 0 and aliased >= 1
+
+
+def test_registry_swim_run_audits_clean():
+    # the tier-1 representative of the CI audit job: the real dense
+    # entry point at a tiny shape must satisfy every pinned contract
+    report = audit_entry("swim_run", "dense", n=16, ticks=2)
+    assert isinstance(report, EntryReport)
+    assert [f for f in report.findings if f.severity != "info"] == [], [
+        str(f) for f in report.findings
+    ]
+    assert report.aliased_outputs >= 1
+    assert report.prng["roots"]["protocol"] > 0
+    # the dense tick scan is found, with its pinned carry multiset
+    assert any(report.carries.values())
+
+
+def test_registry_builders_cover_declared_backends():
+    # every registered (entry, backend) pair must at least BUILD — a
+    # signature change in a model/scenario module breaks here first
+    from ringpop_tpu.analysis.registry import iter_entries
+
+    pairs = list(iter_entries())
+    assert ("run_scenario", "delta") in pairs
+    assert ("run_scenario+traffic", "dense") in pairs
+    built = build_entry("run_scenario", "dense", n=8, ticks=2)
+    assert built.key_roots["protocol"]
+    assert built.donates
+
+
+@pytest.mark.slow
+def test_full_registry_audits_clean():
+    # the whole registry, both backends (the CI audit job's assertion,
+    # kept out of the tier-1 wall)
+    from ringpop_tpu.analysis.contracts import audit_all
+
+    reports, findings = audit_all(n=32, ticks=3)
+    assert len(reports) == 9
+    bad = [f for f in findings if f.severity in ("warning", "error")]
+    assert bad == [], [str(f) for f in bad]
+
+
+@pytest.mark.slow
+def test_delta_run_census_lists_nc_intermediates():
+    # the acceptance shape: delta_run at n=4096 lists every >= [N, C]
+    # intermediate with dtype + producing primitive
+    report = audit_entry(
+        "delta_run", "delta", n=4096, ticks=2, capacity=64,
+        compile_programs=False,
+    )
+    assert report.census
+    nc = [r for r in report.census if r["tag"] == "NxC"]
+    assert nc, "no [N, C]-tagged rows at n=4096"
+    for r in report.census:
+        assert r["elems_each"] >= 4096 * 64 or "N" in r["tag"]
+        assert r["dtype"] and r["primitive"]
+
+
+# ---------------------------------------------------------------------------
+# the AST lint layer
+# ---------------------------------------------------------------------------
+
+
+def test_lint_block_until_ready_flagged_and_pragma():
+    src = "def drain(x):\n    return x.block_until_ready()\n"
+    (f,) = lint_source(src, "lib.py", compiled_path=True)
+    assert f.contract == "lint:RPL001" and "lib.py:2" in f.where
+    src_ok = ("def drain(x):\n"
+              "    return x.block_until_ready()  # audit: allow=RPL001\n")
+    assert lint_source(src_ok, "lib.py", compiled_path=True) == []
+    # the pragma may land on ANY line a wrapped call spans
+    src_wrapped = ("def drain(x, y):\n"
+                   "    return x.block_until_ready(\n"
+                   "    )  # audit: allow=RPL001\n")
+    assert lint_source(src_wrapped, "lib.py", compiled_path=True) == []
+    # host-side modules are exempt
+    assert lint_source(src, "host.py", compiled_path=False) == []
+
+
+def test_lint_np_on_traced_flagged():
+    src = ("import numpy as np\n"
+           "def step_impl(state):\n"
+           "    return np.asarray(state)\n")
+    (f,) = lint_source(src, "m.py")
+    assert f.contract == "lint:RPL002" and "step_impl" in f.message
+    # host code: same call, no traced context, no finding
+    host = "import numpy as np\ndef reader(x):\n    return np.asarray(x)\n"
+    assert lint_source(host, "m.py") == []
+
+
+def test_lint_traced_bool_if_flagged():
+    src = ("import jax.numpy as jnp\n"
+           "def step_impl(mask):\n"
+           "    if jnp.any(mask):\n"
+           "        return 1\n"
+           "    return 0\n")
+    (f,) = lint_source(src, "m.py")
+    assert f.contract == "lint:RPL003"
+    # static-shape branches stay legal
+    ok = ("def step_impl(ev):\n"
+          "    if ev.shape[0]:\n"
+          "        return 1\n"
+          "    return 0\n")
+    assert lint_source(ok, "m.py") == []
+
+
+def test_lint_wallclock_in_traced_flagged():
+    src = ("import time\n"
+           "def body_impl(c):\n"
+           "    return c + time.time()\n")
+    (f,) = lint_source(src, "m.py")
+    assert f.contract == "lint:RPL004"
+    # host code wall-clock reads are fine
+    host = "import time\ndef stamp():\n    return time.time()\n"
+    assert lint_source(host, "m.py") == []
+
+
+def test_lint_nested_scan_body_inherits_traced_context():
+    src = ("import numpy as np\n"
+           "def run_impl(xs):\n"
+           "    def body(c, x):\n"
+           "        return c + np.asarray(x), c\n"
+           "    return body\n")
+    (f,) = lint_source(src, "m.py")
+    assert f.contract == "lint:RPL002"
+
+
+def test_lint_library_tree_clean():
+    # the shipped compiled-path modules must lint clean (the CI audit
+    # job's lint assertion)
+    from pathlib import Path
+
+    from ringpop_tpu.analysis.lint import lint_paths
+
+    import ringpop_tpu
+
+    findings = lint_paths(Path(ringpop_tpu.__file__).parent)
+    assert findings == [], [str(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# ledger recompile attribution (obs/ledger.py)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_recompile_attribution_names_static():
+    led = DispatchLedger().enable(None)
+    f = jax.jit(lambda x, k: x * k, static_argnames=("k",))
+    led.dispatch("prog", f, jnp.zeros((4,), jnp.float32), k=2)
+    led.dispatch("prog", f, jnp.zeros((4,), jnp.float32), k=2)
+    led.dispatch("prog", f, jnp.zeros((4,), jnp.float32), k=3)
+    led.dispatch("prog", f, jnp.zeros((8,), jnp.float32), k=3)
+    rows = led.rows
+    assert [r["cold"] for r in rows] == [True, False, True, True]
+    # warm row: same sig as its cold row, no cause
+    assert rows[1]["sig"] == rows[0]["sig"]
+    assert "recompile_cause" not in rows[0]
+    assert "recompile_cause" not in rows[1]
+    assert rows[2]["recompile_cause"] == ["static 'k' changed: 2 -> 3"]
+    assert rows[3]["recompile_cause"] == [
+        "arg leaf 0 shape changed: (4,) -> (8,)"
+    ]
+    # exactly one cold per signature
+    sigs = [r["sig"] for r in rows if r["cold"]]
+    assert len(sigs) == len(set(sigs))
+
+
+def test_audit_cli_smoke(capsys):
+    # the CLI lane end to end on a tiny entry (no SystemExit = exit 0)
+    from ringpop_tpu.analysis.cli import main
+
+    main(["--entry", "swim_run", "--n", "16", "--ticks", "2", "--no-lint"])
+    out = capsys.readouterr().out
+    assert "swim_run [dense]" in out and "clean" in out
+    assert "lint skipped" in out
+
+
+def test_audit_cli_rejects_unknown_entry():
+    # a typo'd selection must fail CLOSED, not audit 0 programs
+    from ringpop_tpu.analysis.cli import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--entry", "delta_runn", "--no-lint"])
+    assert "unknown entry point" in str(exc.value)
+    with pytest.raises(SystemExit) as exc:
+        main(["--entry", "recv_merge_pallas", "--backend", "delta",
+              "--no-lint"])
+    assert "matches no registered" in str(exc.value)

@@ -9,7 +9,6 @@ and hash-for-hash on every view composition.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

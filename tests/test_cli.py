@@ -9,7 +9,6 @@ process-per-node shape, tick-cluster.js:352-416) and is marked slow.
 from __future__ import annotations
 
 import io
-import json
 import sys
 
 import pytest

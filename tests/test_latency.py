@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from ringpop_tpu.models import swim_delta as sdelta
 from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.models.cluster import SimCluster
-from ringpop_tpu.models.swim_sim import NetState, SwimParams
+from ringpop_tpu.models.swim_sim import SwimParams
 from ringpop_tpu.ops import ring_ops
 from ringpop_tpu.scenarios import compile as scompile
 from ringpop_tpu.scenarios import faults as sfaults

@@ -5,10 +5,8 @@ cluster history.  This is BASELINE.json's north-star invariant and the
 "minimum end-to-end slice" of SURVEY §7.
 """
 
-import numpy as np
 
 from ringpop_tpu.harness import Cluster
-from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.models.cluster import SimCluster
 from ringpop_tpu.models.swim_sim import SwimParams
 

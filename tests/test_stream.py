@@ -22,7 +22,6 @@ rides the slow lane.
 from __future__ import annotations
 
 import json
-import os
 
 import numpy as np
 import pytest

@@ -51,12 +51,32 @@ missing = [k for k in REFERENCE_KEYS if f"{DEFAULT_PREFIX}.{k}" not in keys]
 assert not missing, f"missing reference keys: {missing}"
 
 # (a) the scenario's ledger row with forensics populated
-rows = [r for r in DispatchLedger.load_rows(ledger_path)
-        if r["program"] == "run_scenario"]
+all_rows = DispatchLedger.load_rows(ledger_path)
+rows = [r for r in all_rows if r["program"] == "run_scenario"]
 assert len(rows) == 1, rows
 row = rows[0]
 assert row["cold"] and row["compile_s"] > 0 and row["execute_s"] > 0
 assert row["peak_bytes"] > 0 and row["n"] == 16 and row["ticks"] == 40
+
+# (a2) recompile-regression gate: the pinned compile-once contract —
+# EXACTLY one cold compile per (program, signature), and no dispatch
+# carries a recompile_cause (a second cold for the same program means
+# some static/shape drifted mid-run; the row names the culprit)
+from collections import Counter
+sigs = Counter((r["program"], r.get("sig")) for r in all_rows if "sig" in r)
+colds = Counter((r["program"], r.get("sig"))
+                for r in all_rows if r.get("cold") and "sig" in r)
+for key, n_cold in colds.items():
+    assert n_cold == 1, f"{n_cold} cold compiles for one signature: {key}"
+# every signature dispatched must own its one cold row (a warm row
+# with no cold sibling would mean the AOT cache was pre-seeded)
+missing = [key for key in sigs if key not in colds]
+assert not missing, f"signatures with warm rows but no cold row: {missing}"
+recompiled = [r for r in all_rows if r.get("recompile_cause")]
+assert not recompiled, (
+    "unexpected recompile(s): "
+    + "; ".join(f"{r['program']}: {r['recompile_cause']}" for r in recompiled)
+)
 
 # (c) the profiler trace directory exists and is non-empty
 files = [p for p in pathlib.Path(profdir).rglob("*") if p.is_file()]
