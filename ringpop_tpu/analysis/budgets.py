@@ -40,6 +40,12 @@ CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
     ("run_scenario+traffic", "dense"): {"bool": 2, "int32": 3, "int8": 2},
     ("run_scenario+traffic", "delta"): {"bool": 3, "int32": 8, "int8": 2,
                                         "uint32": 1},
+    # the incident shape adds the overload feedback carry on top of
+    # run_scenario+traffic — ov_gray (bool[N]), ov_cnt (int32[N]) —
+    # plus the period row the overload fixture always materializes
+    ("run_scenario+incident", "dense"): {"bool": 3, "int32": 5, "int8": 2},
+    ("run_scenario+incident", "delta"): {"bool": 4, "int32": 10, "int8": 2,
+                                         "uint32": 1},
     ("run_sweep", "dense"): {"bool": 2, "int32": 3, "int8": 2},
     ("run_sweep", "delta"): {"bool": 3, "int32": 8, "int8": 2, "uint32": 1},
     ("recv_merge_pallas", "dense"): {"int32": 2},

@@ -534,7 +534,7 @@ class TpuSimCluster(ClusterDriver):
 
     def run_scenario(
         self,
-        path: str,
+        path: str | None,
         trace_out: str | None = None,
         sweep: int = 0,
         sweep_loss_scales: list[float] | None = None,
@@ -546,6 +546,7 @@ class TpuSimCluster(ClusterDriver):
         checkpoint: str | None = None,
         checkpoint_every: int = 1,
         segment_store: str | None = None,
+        incident: str | None = None,
     ) -> None:
         """Run a JSON scenario spec as ONE jitted call (scenarios/);
         with ``sweep=R`` run R replicas in one vmapped dispatch; with
@@ -555,11 +556,31 @@ class TpuSimCluster(ClusterDriver):
         ``segment_ticks=S`` stream the run as pipelined S-tick segment
         dispatches (one compile), checkpointing every
         ``checkpoint_every`` segments when ``checkpoint`` is given —
-        a killed soak continues with ``--resume``."""
+        a killed soak continues with ``--resume``.
+
+        ``incident=NAME`` replays a named outage from the incident
+        library (scenarios/library.py) at this cluster's size instead
+        of a spec file: the incident supplies both the fault timeline
+        and its latency-coupled workload, the run streams by default
+        (segments of 32), and the detect/heal/serve summary prints at
+        the end — the same summary the golden regression lane pins."""
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
-        spec = ScenarioSpec.load(path)
-        if traffic and latency_buckets:
+        incident_name = incident
+        if incident_name is not None:
+            from ringpop_tpu.scenarios import library as ilib
+
+            spec, traffic = ilib.build_incident(
+                incident_name, self.cluster.n,
+                backend=self.cluster.backend,
+            )
+            if segment_ticks is None:
+                # incidents stream by default: one compile, O(segment)
+                # host telemetry, and the same bit-identical trace
+                segment_ticks = min(32, spec.ticks)
+        else:
+            spec = ScenarioSpec.load(path)
+        if traffic and latency_buckets and incident_name is None:
             # enable the SLO latency plane on the parsed workload
             # (compile_traffic pins the tick->ms period to the cluster)
             from ringpop_tpu.traffic.workloads import WorkloadSpec
@@ -568,14 +589,9 @@ class TpuSimCluster(ClusterDriver):
                 latency_buckets=int(latency_buckets)
             )
         if sweep:
-            if traffic:
-                raise ValueError(
-                    "traffic does not compose with sweep yet "
-                    "(serve traffic on a single-replica scenario)"
-                )
             self._run_sweep(
                 spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter,
-                flap_jitter=sweep_flap_jitter,
+                flap_jitter=sweep_flap_jitter, traffic=traffic,
                 segment_ticks=segment_ticks, segment_store=segment_store,
             )
             return
@@ -645,10 +661,10 @@ class TpuSimCluster(ClusterDriver):
 
             agg = plane_stats(trace)
             if agg is not None:
+                from ringpop_tpu.traffic.engine import total_sends
+
                 delivered = max(int(m["delivered"].sum()), 1)
-                sends = int(m["proxy_sends"].sum()) + int(
-                    m["proxy_retries"].sum()
-                ) + int(m["handled_local"].sum())
+                sends = total_sends(m)
                 print(
                     f"latency: p50={agg['median']:.0f}ms "
                     f"p95={agg['p95']:.0f}ms p99={agg['p99']:.0f}ms "
@@ -657,18 +673,25 @@ class TpuSimCluster(ClusterDriver):
                     f"sends/delivered, "
                     f"{int(m['gray_timeouts'].sum())} gray timeouts"
                 )
+        if incident_name is not None:
+            from ringpop_tpu.scenarios import library as ilib
+
+            print(ilib.format_summary(
+                incident_name, ilib.incident_summary(trace)
+            ))
         if trace_out:
             trace.save(trace_out)
             print(f"trace ({trace.ticks} ticks x "
                   f"{len(trace.metrics) + 3} series) -> {trace_out}")
 
     def _run_sweep(self, spec, trace_out, replicas, loss_scales, kill_jitter,
-                   flap_jitter=None, segment_ticks=None, segment_store=None):
+                   flap_jitter=None, traffic=None, segment_ticks=None,
+                   segment_store=None):
         t0 = time.perf_counter()
         strace = self.cluster.run_sweep(
             spec, replicas,
             loss_scales=loss_scales, kill_jitter=kill_jitter,
-            flap_jitter=flap_jitter,
+            flap_jitter=flap_jitter, traffic=traffic,
             segment_ticks=segment_ticks, store=segment_store,
         )
         wall_ms = (time.perf_counter() - t0) * 1000
@@ -695,6 +718,24 @@ class TpuSimCluster(ClusterDriver):
               f"{dist(det, rep['detected'])}")
         print(f"  heal tick ({rep['healed']}/{replicas} healed): "
               f"{dist(heal, rep['healed'])}")
+        serving = strace.serving_summary()
+        if serving is not None:
+            # per-replica serving scorecards: the traffic-coupled sweep's
+            # one-dispatch answer (SweepTrace.serving_summary)
+            for row in serving:
+                line = (
+                    f"  replica {row['replica']}: goodput "
+                    f"{100 * row['goodput']:.1f}%, "
+                    f"{row['misroutes']} misroutes, "
+                    f"amplification {row['amplification']:.2f}"
+                )
+                if "lat_p99_ms" in row:
+                    line += (f", lat p50/p95/p99 {row['lat_p50_ms']:.0f}/"
+                             f"{row['lat_p95_ms']:.0f}/"
+                             f"{row['lat_p99_ms']:.0f}ms")
+                if "ov_gray_peak" in row:
+                    line += f", peak overload-gray {row['ov_gray_peak']}"
+                print(line)
         if trace_out:
             strace.save(trace_out)
             print(
@@ -799,6 +840,16 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                         help="tpu-sim: run a JSON scenario spec (compiled "
                              "fault timeline, one jitted dispatch; see "
                              "docs/simulation.md) instead of --script")
+    parser.add_argument("--incident", default=None, metavar="NAME",
+                        help="tpu-sim: replay a named outage from the "
+                             "incident library (scenarios/library.py; "
+                             "docs/incidents.md) at this cluster size — "
+                             "fault timeline plus its latency-coupled "
+                             "workload, streamed by default, with the "
+                             "detect/heal/serve summary printed (the "
+                             "golden-lane summary); see --list-incidents")
+    parser.add_argument("--list-incidents", action="store_true",
+                        help="print the incident catalog and exit")
     parser.add_argument("--trace-out", default=None, metavar="FILE",
                         help="with --scenario: write the per-tick telemetry "
                              "trace (.npz) here")
@@ -891,6 +942,12 @@ def main(argv: list[str] | None = None) -> None:
     add_args(parser)
     args = parser.parse_args(argv)
 
+    if args.list_incidents:
+        from ringpop_tpu.scenarios.library import format_catalog
+
+        print(format_catalog())
+        return
+
     if args.script_to_scenario:
         if not args.script:
             parser.error("--script-to-scenario needs --script")
@@ -933,29 +990,38 @@ def main(argv: list[str] | None = None) -> None:
         return
 
     backend = args.backend or ("host-sim" if args.sim else "proc")
-    if args.scenario and backend != "tpu-sim":
-        parser.error("--scenario needs --backend tpu-sim (the compiled "
-                     "scenario engine is a tensor-simulation feature)")
-    if args.sweep and not args.scenario:
-        parser.error("--sweep needs --scenario (it replicates a compiled "
-                     "scenario, not an interactive session)")
+    has_run = bool(args.scenario or args.incident)
+    if has_run and backend != "tpu-sim":
+        parser.error("--scenario/--incident need --backend tpu-sim (the "
+                     "compiled scenario engine is a tensor-simulation "
+                     "feature)")
+    if args.incident and args.scenario:
+        parser.error("--incident replays a library outage; it does not "
+                     "compose with --scenario (the incident IS the spec)")
+    if args.incident and args.traffic:
+        parser.error("--incident brings its own latency-coupled workload; "
+                     "drop --traffic (edit the library builder to vary it)")
+    if args.sweep and not has_run:
+        parser.error("--sweep needs --scenario/--incident (it replicates a "
+                     "compiled scenario, not an interactive session)")
     if args.traffic and not args.scenario:
         parser.error("--traffic needs --scenario (the workload co-runs "
                      "inside the compiled scenario scan)")
-    if args.traffic and args.sweep:
-        parser.error("--traffic does not compose with --sweep yet "
-                     "(serve traffic on a single-replica scenario)")
     if args.latency_buckets and not args.traffic:
         parser.error("--latency-buckets needs --traffic (it extends the "
                      "serving workload with the SLO latency plane)")
-    if args.segment_ticks is not None and not args.scenario:
-        parser.error("--segment-ticks needs --scenario (it segments a "
-                     "compiled scenario run)")
+    if args.segment_ticks is not None and not has_run:
+        parser.error("--segment-ticks needs --scenario/--incident (it "
+                     "segments a compiled scenario run)")
     if args.segment_ticks is not None and args.segment_ticks < 1:
         # the run_scenario plumbing treats a falsy segment_ticks as
         # "unsegmented", which would silently drop --checkpoint
         parser.error("--segment-ticks must be >= 1")
-    if (args.checkpoint or args.segment_store) and args.segment_ticks is None:
+    if (
+        (args.checkpoint or args.segment_store)
+        and args.segment_ticks is None
+        and not args.incident  # incidents stream by default
+    ):
         parser.error("--checkpoint/--segment-store need --segment-ticks "
                      "(they are streaming-run options)")
     if args.checkpoint and args.sweep:
@@ -997,7 +1063,7 @@ def main(argv: list[str] | None = None) -> None:
         profile_ctx = profile_trace(args.profile_dir)
     try:
         with profile_ctx:
-            if args.scenario:
+            if args.scenario or args.incident:
                 driver.run_scenario(
                     args.scenario, args.trace_out, sweep=args.sweep,
                     sweep_loss_scales=sweep_scales,
@@ -1009,6 +1075,7 @@ def main(argv: list[str] | None = None) -> None:
                     checkpoint=args.checkpoint,
                     checkpoint_every=args.checkpoint_every,
                     segment_store=args.segment_store,
+                    incident=args.incident,
                 )
             elif args.script:
                 run_script(driver, args.script)

@@ -287,6 +287,7 @@ class SimCluster:
         # precheck also hands back the normalized adjacency so the
         # mask-form host sync runs once per run, not again per dispatch
         adj = srunner.precheck(self.state, self.net, compiled, params)
+        srunner.precheck_overload(compiled, traffic, self.net)
         keys = scompile.key_schedule(self._split, compiled)
         start_tick = int(self.state.tick)
         self.state, self.net, ys = srunner.run_compiled(
@@ -347,6 +348,7 @@ class SimCluster:
         loss_scales: Sequence[float] | None = None,
         kill_jitter: Sequence[int] | None = None,
         flap_jitter: Sequence[int] | None = None,
+        traffic: Any | None = None,
         shard: bool = False,
         segment_ticks: int | None = None,
         store: str | None = None,
@@ -370,6 +372,14 @@ class SimCluster:
         cluster's own trajectory — only the cluster key moves (R
         draws), and nothing is appended to ``metrics_log``/``traces``
         (checkpoints round-trip ``Trace`` objects only).
+
+        ``traffic`` (a ``traffic.WorkloadSpec`` or its dict/JSON/
+        shorthand/pre-lowered form) co-runs the key workload in every
+        replica — one shared workload stream, so replica r's serving
+        counters are exactly a standalone ``run_scenario(spec_r,
+        traffic=...)``'s, and the SweepTrace answers per-replica
+        serving questions in one dispatch
+        (``SweepTrace.serving_summary``).
 
         ``segment_ticks=S`` streams the sweep (scenarios/stream.py):
         [R, S] telemetry slabs drain per pipelined segment dispatch
@@ -396,6 +406,7 @@ class SimCluster:
                 loss_scales=loss_scales,
                 kill_jitter=kill_jitter,
                 flap_jitter=flap_jitter,
+                traffic=traffic,
                 store=store,
                 assemble=assemble,
                 pipeline=pipeline,
@@ -411,6 +422,8 @@ class SimCluster:
         elif isinstance(spec, dict):
             spec = ScenarioSpec.from_dict(spec)
         spec.validate(self.n)
+        if traffic is not None:
+            traffic = self.compile_traffic(traffic)
         cs = ssweep.compile_sweep(
             spec,
             self.n,
@@ -423,12 +436,14 @@ class SimCluster:
         params = self.dparams if self.backend == "delta" else self.params
         # static rejections BEFORE drawing keys (run_scenario contract)
         srunner.precheck(self.state, self.net, cs.base, params)
+        srunner.precheck_overload(cs.base, traffic, self.net)
         if shard:
             ssweep.precheck_shard(replicas)
         replica_keys = [self._split() for _ in range(replicas)]
         keys = ssweep.sweep_key_schedule(replica_keys, cs)
         states, nets, ys = ssweep.run_sweep_compiled(
-            self.state, self.net, keys, cs, params, shard=shard
+            self.state, self.net, keys, cs, params, shard=shard,
+            traffic=traffic,
         )
         stacks = {k: np.asarray(v) for k, v in ys.items()}
         trace = ssweep.SweepTrace(
@@ -821,6 +836,14 @@ class SimCluster:
         self.net = self.net._replace(
             link_src=None, link_dst=None, link_p=None, link_d=None, link_j=None
         )
+
+    def clear_overload(self) -> None:
+        """Drop overload feedback state a finished ``overload`` run
+        left on the net (``NetState.ov_cnt``/``ov_gray``) — required
+        before a FRESH overload scenario on the same cluster (the
+        pressure would otherwise silently seed the new run; resume
+        keeps it on purpose)."""
+        self.net = self.net._replace(ov_cnt=None, ov_gray=None)
 
     def set_period(self, period) -> None:
         """Per-node protocol periods (int[N]; the gray-failure model):

@@ -283,6 +283,16 @@ class NetState(NamedTuple):
     link_d: jax.Array | None = None  # int32[K]
     link_j: jax.Array | None = None  # int32[K]
     period: jax.Array | None = None  # int32[N]
+    # Load-coupled gray degradation (scenarios/faults.OverloadConfig;
+    # None unless an ``overload`` scenario ran/is running): the
+    # per-node overload pressure counter accumulated from serve-plane
+    # sends vs the capacity knob, and the hysteresis "currently
+    # degraded" bit that pins ``period`` to the gray factor.  The step
+    # itself never reads these — the scenario scan carries them and
+    # applies the EFFECTIVE period; they live here so checkpoints and
+    # the final net round-trip the feedback state (stream resume).
+    ov_cnt: jax.Array | None = None  # int32[N]
+    ov_gray: jax.Array | None = None  # bool[N]
 
 
 def make_net(n: int, *, partitioned: bool = False) -> NetState:

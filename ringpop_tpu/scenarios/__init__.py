@@ -31,6 +31,11 @@ Layers:
   segment dispatches of one compiled executable, per-segment
   ``SegmentStore`` slabs + stats bridging (O(segment) host trace
   memory), checkpoint-every-segment and bit-exact ``resume``.
+* ``library`` — the incident library: named real-world outages
+  (region partitions with asymmetric heals, cascading overload,
+  deploys-during-partition, ...) as parameterized spec+workload
+  builders, with the golden detect/heal/serve summary the regression
+  lane pins (``tick-cluster --incident NAME`` / ``--list-incidents``).
 
 Entry points: ``SimCluster.run_scenario(spec[, segment_ticks=S])``,
 ``SimCluster.run_sweep(spec, replicas)``, and
@@ -65,6 +70,13 @@ from ringpop_tpu.scenarios.stream import (
     run_streamed,
     run_sweep_streamed,
 )
+from ringpop_tpu.scenarios.library import (
+    INCIDENTS,
+    Incident,
+    build_incident,
+    incident_names,
+    incident_summary,
+)
 
 __all__ = [
     "Event",
@@ -92,4 +104,9 @@ __all__ = [
     "resume",
     "run_streamed",
     "run_sweep_streamed",
+    "INCIDENTS",
+    "Incident",
+    "build_incident",
+    "incident_names",
+    "incident_summary",
 ]

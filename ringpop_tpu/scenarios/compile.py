@@ -66,6 +66,10 @@ class CompiledScenario(NamedTuple):
     has_delay: bool = False  # static: route through the in-flight buffer?
     has_gray: bool = False  # static: carry the per-node period row?
     delay_depth: int = 0  # static ring-buffer depth (0 = no delay)
+    # load-coupled gray feedback (faults.OverloadConfig, all-int and
+    # hashable -> a jit-static of the scan); None = no overload event
+    # and the compiled program carries no overload state at all
+    overload: Any | None = None
 
 
 def expand_events(
@@ -99,6 +103,10 @@ def expand_events(
             )
         elif e.op in ("link_loss", "delay", "gray"):
             pass  # lowered below via the marker ticks (faults.py)
+        elif e.op == "overload":
+            pass  # static config (faults.overload_config); the update
+            # is per-tick in-scan state, not a timeline op, and the
+            # host oracle carries it tick-by-tick itself — no marker
         else:
             out.append((e.at, e.op, e.node))
     out.extend(
@@ -157,6 +165,7 @@ def compile_spec(
         has_delay=ft is not None and ft.lr_d is not None,
         has_gray=ft is not None and bool(ft.pe_tick.shape[0]),
         delay_depth=sfaults.delay_depth(spec),
+        overload=sfaults.overload_config(spec),
     )
 
 

@@ -78,6 +78,70 @@ class FaultTensors(NamedTuple):
     pe_row: jax.Array  # int32[G, N] per-node period rows
 
 
+class OverloadConfig(NamedTuple):
+    """The load-coupled gray feedback loop's static knobs (all ints —
+    hashable, so the scan jit-specializes on them like its other
+    static facts).  Per tick ``t`` in ``[start, end)``, with
+    ``sends[i]`` the serve plane's send attempts landing on node i
+    (``traffic/engine.py`` ``node_sends``)::
+
+        pressure[i] = max(0, pressure[i] + sends[i] - capacity)
+        gray[i]     = pressure[i] >= threshold
+                      or (gray[i] and pressure[i] > recover)
+
+    and node i's EFFECTIVE protocol period at tick t+1 is
+    ``max(period[i], factor)`` while ``gray[i]`` — so retry storms can
+    *cause* gray, gray attracts more retries (the SLO latency plane's
+    duty-phase timeouts), and the backoff schedule is what must arrest
+    the cascade.  Outside the window pressure and gray are pinned to
+    zero (the feedback disarms and the cluster recovers its period).
+    The update is exact int32 arithmetic, which is what makes the
+    compiled scan and the host-loop oracle bit-identical
+    (tests/test_overload.py).
+    """
+
+    start: int  # window start tick (inclusive)
+    end: int  # window end tick (exclusive)
+    capacity: int  # sends a node absorbs per tick without pressure
+    threshold: int  # pressure at which the node degrades to gray
+    recover: int  # hysteresis: gray clears only at pressure <= recover
+    factor: int  # the degraded protocol period while gray
+
+
+def overload_config(spec: ScenarioSpec) -> OverloadConfig | None:
+    """The spec's (at most one) ``overload`` event as its static
+    config, or None — mirrors ``link_rules``/``period_switches`` as
+    the host-side single source of truth for both the compiler and
+    the parity oracle."""
+    for e in spec.events:
+        if e.op == "overload":
+            return OverloadConfig(
+                start=e.at,
+                end=e.until if e.until is not None else spec.ticks,
+                capacity=int(e.capacity),
+                threshold=int(e.threshold),
+                recover=int(e.recover) if e.recover is not None else 0,
+                factor=int(e.factor),
+            )
+    return None
+
+
+def overload_update(
+    cfg: OverloadConfig, in_window, pressure, gray, sends
+):
+    """One tick of the feedback-loop state update — shared arithmetic
+    for the compiled scan (jnp arrays) and the host oracle (numpy):
+    returns ``(pressure', gray')``.  Works elementwise on either array
+    namespace because it is pure ``maximum``/compare/bool algebra."""
+    np_like = jnp if isinstance(pressure, jax.Array) else np
+    cnt = np_like.maximum(pressure + sends - cfg.capacity, 0)
+    cnt = np_like.where(in_window, cnt, 0)
+    new_gray = in_window & (
+        (cnt >= cfg.threshold) | (gray & (cnt > cfg.recover))
+    )
+    return cnt, new_gray
+
+
 def link_rules(spec: ScenarioSpec) -> list[LinkRule]:
     """The spec's link_loss/delay events as rules, in (at, spec-order)
     — the deterministic order both the compiler and the host plan use

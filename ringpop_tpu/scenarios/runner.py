@@ -42,6 +42,7 @@ from ringpop_tpu.scenarios.compile import (
     CompiledScenario,
     expand_events,
 )
+from ringpop_tpu.scenarios import faults as sfaults
 from ringpop_tpu.scenarios.spec import ScenarioSpec
 from ringpop_tpu.traffic import engine as traffic_engine
 
@@ -131,12 +132,13 @@ def precheck(
                 f"{compiled.delay_depth}; drain it (tick past the old "
                 "horizon) or start from a fresh cluster"
             )
-    if compiled.has_gray:
+    if compiled.has_gray or compiled.overload is not None:
         sw = getattr(params, "swim", params)
         if sw is not None and getattr(sw, "phase_mod", 1) > 1:
             raise ValueError(
-                "gray events (per-node periods) do not compose with the "
-                "static phase_mod stagger: a period row of P subsumes it"
+                "gray/overload events (per-node periods) do not compose "
+                "with the static phase_mod stagger: a period row of P "
+                "subsumes it"
             )
     if not standing_ok:
         # The compiled scan derives its per-tick network configuration
@@ -171,6 +173,48 @@ def precheck(
                 "as gray events"
             )
     return _normalize_adj(net, compiled.n)
+
+
+def precheck_overload(
+    compiled: CompiledScenario,
+    traffic: Any | None,
+    net: NetState,
+    *,
+    standing_ok: bool = False,
+) -> None:
+    """Static rejections of the overload feedback loop, callable before
+    any PRNG key is drawn (the ``precheck`` contract).  Overload meters
+    serve-plane sends, so a spec carrying it needs a traffic workload
+    in the same scan; and a net carrying leftover feedback state from
+    a previous overload run would silently seed the new run's pressure
+    — reject unless resuming (``standing_ok``), whose net carries this
+    very run's own mid-window state."""
+    if compiled.overload is None:
+        return
+    if traffic is None:
+        raise ValueError(
+            "overload events meter the serve plane's per-node sends: "
+            "pass a traffic workload (run_scenario(spec, traffic=...))"
+        )
+    if not standing_ok and net.ov_cnt is not None:
+        if bool(np.asarray(net.ov_cnt).any() or np.asarray(net.ov_gray).any()):
+            raise ValueError(
+                "the cluster carries overload feedback state from a "
+                "previous run (net.ov_cnt/ov_gray): clear_overload() "
+                "first, or resume the run that wrote it"
+            )
+
+
+def overload_traffic(traffic: Any | None, compiled: CompiledScenario) -> Any:
+    """The traffic statics a scenario actually compiles: an overload
+    spec needs the serve plane's per-node send accounting, so its
+    workload compiles with ``track_load`` on (everything else ships the
+    exact program the workload was lowered with)."""
+    if traffic is None or compiled.overload is None:
+        return traffic
+    if traffic.static.track_load:
+        return traffic
+    return traffic._replace(static=traffic.static._replace(track_load=1))
 
 
 def _apply_revives(state, up, resp, m, ev_kind, ev_node):
@@ -224,10 +268,12 @@ def _scenario_scan_impl(
     tr_tensors=None,
     tick0=None,
     faults=None,
+    ov=None,
     *,
     params,
     has_revive: bool,
     traffic=None,
+    overload=None,
 ):
     # ``tick0`` (traced int32 scalar, or None for 0) offsets the tick
     # counter the event/partition/traffic comparisons see: a streamed
@@ -242,7 +288,7 @@ def _scenario_scan_impl(
     oob = jnp.int32(n)  # masked events scatter out of bounds -> dropped
 
     def body(carry, xs):
-        st, u, r, gid, per = carry
+        st, u, r, gid, per, ovc = carry
         t, key, loss_t = xs
         if ev_tick.shape[0]:
             m = ev_tick == t
@@ -280,7 +326,18 @@ def _scenario_scan_impl(
                     link_d=jnp.where(active, faults.lr_d, 0),
                     link_j=jnp.where(active, faults.lr_j, 0),
                 )
-        net = NetState(up=u, responsive=r, adj=gid, period=per, **link_kw)
+        # load-coupled gray degradation (faults.OverloadConfig): a node
+        # the feedback flagged last tick runs at the degraded period
+        # THIS tick — for its protocol step and its serve duty phase
+        # alike — so retry pressure causes gray and gray attracts the
+        # retries the latency plane's duty timeouts generate
+        per_eff = per
+        if overload is not None:
+            ov_cnt, ov_fl = ovc
+            per_eff = jnp.where(
+                ov_fl, jnp.maximum(per, jnp.int32(overload.factor)), per
+            )
+        net = NetState(up=u, responsive=r, adj=gid, period=per_eff, **link_kw)
         if is_delta:
             sp = params._replace(swim=params.swim._replace(loss=loss_t))
             st, metrics = sdelta.delta_step_impl(st, net, key, sp)
@@ -314,25 +371,39 @@ def _scenario_scan_impl(
                     u, r, tr_tensors, t, static=traffic,
                     damped=getattr(st, "damped", None),
                     # the SLO latency plane reads the tick's ACTIVE link
-                    # rules and period row (ignored when it is off)
-                    net=net, period=per,
+                    # rules and the EFFECTIVE period row (overload-
+                    # degraded; ignored when the plane is off)
+                    net=net, period=per_eff,
                 )
             )
-        return (st, u, r, gid, per), y
+        if overload is not None:
+            # this tick's send load closes the loop: pressure and the
+            # hysteresis gray bit update AFTER serving (the flag the
+            # serve/step above read is last tick's — causal), and the
+            # per-node vector is consumed here, never stacked
+            sends = y.pop("node_sends")
+            in_win = (t >= overload.start) & (t < overload.end)
+            ov_cnt, ov_fl = sfaults.overload_update(
+                overload, in_win, ov_cnt, ov_fl, sends
+            )
+            y["ov_gray_nodes"] = jnp.sum(ov_fl, dtype=jnp.int32)
+            y["ov_pressure_max"] = jnp.max(ov_cnt)
+            ovc = (ov_cnt, ov_fl)
+        return (st, u, r, gid, per, ovc), y
 
     t_idx = jnp.arange(ticks, dtype=jnp.int32)
     if tick0 is not None:
         t_idx = t_idx + tick0
     xs = (t_idx, keys, loss)
-    (state, up, responsive, adj, period), ys = jax.lax.scan(
-        body, (state, up, responsive, adj, period), xs
+    (state, up, responsive, adj, period, ov), ys = jax.lax.scan(
+        body, (state, up, responsive, adj, period, ov), xs
     )
-    return state, up, responsive, adj, period, ys
+    return state, up, responsive, adj, period, ov, ys
 
 
 _scenario_scan = jax.jit(
     _scenario_scan_impl,
-    static_argnames=("params", "has_revive", "traffic"),
+    static_argnames=("params", "has_revive", "traffic", "overload"),
     donate_argnums=(0, 1, 2, 3),
 )
 
@@ -370,7 +441,9 @@ def run_compiled(
         )
     if adj is None:
         adj = precheck(state, net, compiled, params)
-    state, period = prepare_faults(state, net, compiled, params)
+        precheck_overload(compiled, traffic, net)
+    traffic = overload_traffic(traffic, compiled)
+    state, period, ov = prepare_faults(state, net, compiled, params)
     _dispatches += 1
     meta = {
         "backend": "delta" if isinstance(state, DeltaState) else "dense",
@@ -383,7 +456,7 @@ def run_compiled(
     # ledger-off (the default): dispatch() is a plain call-through; on,
     # the dispatch is recorded with its compile/execute split and AOT
     # memory footprint (obs/ledger.py)
-    state, up, resp, adj, period, ys = default_ledger().dispatch(
+    state, up, resp, adj, period, ov, ys = default_ledger().dispatch(
         "run_scenario",
         _scenario_scan,
         state,
@@ -401,26 +474,30 @@ def run_compiled(
         traffic.tensors if traffic is not None else None,
         None,
         compiled.faults,
+        ov,
         params=params,
         has_revive=compiled.has_revive,
         traffic=traffic.static if traffic is not None else None,
+        overload=compiled.overload,
         _meta=meta,
     )
-    return state, final_net(up, resp, adj, period, compiled), ys
+    return state, final_net(up, resp, adj, period, compiled, ov=ov), ys
 
 
 def prepare_faults(
     state: Any, net: NetState, compiled: CompiledScenario,
     params: Any | None = None,
-) -> tuple[Any, jax.Array | None]:
+) -> tuple[Any, jax.Array | None, tuple[jax.Array, jax.Array] | None]:
     """Pre-scan failure-model setup shared by the one-dispatch runner,
     the sweep, and the streamed runner: install the in-flight claim
     buffer when the spec delays messages (from tick 0 — its presence
-    widens the step's key split, mirroring ``HostPlan.prepare``), and
+    widens the step's key split, mirroring ``HostPlan.prepare``),
     produce the initial per-node period carry row (the cluster's
     standing row, or all-ones when the scenario introduces gray
-    periods to a lockstep cluster).  ``params`` sizes the delta
-    backend's in-flight lanes (wire_cap)."""
+    periods to a lockstep cluster), and the overload feedback carry
+    ``(pressure int32[N], gray bool[N])`` — zeros for a fresh run, or
+    the net's checkpointed mid-window state on resume.  ``params``
+    sizes the delta backend's in-flight lanes (wire_cap)."""
     if compiled.has_delay:
         if isinstance(state, DeltaState):
             if state.pend_subj is None:
@@ -436,9 +513,21 @@ def prepare_faults(
                 )
             )
     period = net.period
-    if compiled.has_gray and period is None:
+    if (compiled.has_gray or compiled.overload is not None) and period is None:
         period = jnp.ones((compiled.n,), jnp.int32)
-    return state, period
+    ov = None
+    if compiled.overload is not None:
+        if net.ov_cnt is not None:
+            ov = (
+                jnp.asarray(net.ov_cnt, jnp.int32),
+                jnp.asarray(net.ov_gray, bool),
+            )
+        else:
+            ov = (
+                jnp.zeros((compiled.n,), jnp.int32),
+                jnp.zeros((compiled.n,), bool),
+            )
+    return state, period, ov
 
 
 def final_net(
@@ -447,6 +536,7 @@ def final_net(
     adj: jax.Array,
     period: jax.Array | None,
     compiled: CompiledScenario,
+    ov: tuple[jax.Array, jax.Array] | None = None,
 ) -> NetState:
     """The post-run NetState, link rules mirrored to their state at the
     final tick — exactly what the host loop's last ``faultcfg`` apply
@@ -467,6 +557,10 @@ def final_net(
                 link_d=jnp.where(active, ft.lr_d, 0),
                 link_j=jnp.where(active, ft.lr_j, 0),
             )
+    if ov is not None:
+        # the feedback carry persists on the net so checkpoints (and a
+        # stream resume) continue the pressure/hysteresis state exactly
+        kw.update(ov_cnt=ov[0], ov_gray=ov[1])
     return NetState(up=up, responsive=resp, adj=adj, period=period, **kw)
 
 
@@ -484,9 +578,15 @@ def run_host_loop(cluster, spec: ScenarioSpec):
     bootstrap join reads the post-edit live set, in expansion order),
     then partitions/loss/fault configuration."""
     from ringpop_tpu.scenarios import compile as scompile
-    from ringpop_tpu.scenarios import faults as sfaults
 
     spec.validate(cluster.n)
+    if any(e.op == "overload" for e in spec.events):
+        raise NotImplementedError(
+            "run_host_loop does not serve traffic, so it cannot drive "
+            "the overload feedback loop; the per-tick host oracle for "
+            "overload lives in tests/test_overload.py (run_scenario "
+            "with traffic= is the compiled path)"
+        )
     plan = sfaults.HostPlan(spec, cluster.n)
     plan.prepare(cluster)
     by_tick: dict[int, list[tuple[str, Any]]] = defaultdict(list)

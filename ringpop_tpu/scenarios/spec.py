@@ -64,6 +64,15 @@ compiles them, docs/simulation.md documents the host conventions):
 * ``rolling_restart`` — a staggered deploy wave: node k of ``nodes``
   is killed at ``at + k * every`` and revived (fresh incarnation,
   bootstrap re-join) ``down`` ticks later.
+* ``overload`` — the load-coupled gray feedback loop (needs a
+  ``traffic`` workload co-running in the scan): during ``[at, until)``
+  every node accumulates overload pressure ``max(0, pressure + sends
+  - capacity)`` from the serve plane's per-tick sends landing on it;
+  at ``pressure >= threshold`` the node's protocol period degrades to
+  ``factor`` (it goes gray — and with the SLO latency plane on, gray
+  holders time out off their duty phase, attracting the retry storms
+  that feed the pressure back), recovering with hysteresis only once
+  pressure drains to ``<= recover``.  At most one per spec.
 
 ``flap``/``rolling_restart`` expand to the kill/revive primitives at
 compile time (one shared expansion, so the compiled scan and the host
@@ -80,7 +89,8 @@ import json
 from typing import Any, NamedTuple
 
 _NODE_OPS = ("kill", "revive", "suspend", "resume")
-_FAULT_OPS = ("link_loss", "delay", "flap", "gray", "rolling_restart")
+_FAULT_OPS = ("link_loss", "delay", "flap", "gray", "rolling_restart",
+              "overload")
 _OPS = _NODE_OPS + ("partition", "heal", "loss", "loss_ramp") + _FAULT_OPS
 
 # ops that take a p value under the JSON key "p" (loss_ramp uses "to")
@@ -102,9 +112,12 @@ class Event(NamedTuple):
     up: int | None = None  # flap: ticks spent alive per cycle
     every: int | None = None  # rolling: ticks between node starts
     stagger: int | None = None  # flap: per-node cycle offset
-    factor: int | None = None  # gray: protocol-period multiplier
+    factor: int | None = None  # gray/overload: protocol-period multiplier
     delay: int | None = None  # delay: base latency ticks
     jitter: int | None = None  # delay: uniform extra latency bound
+    capacity: int | None = None  # overload: sends absorbed per tick
+    threshold: int | None = None  # overload: pressure that flips gray
+    recover: int | None = None  # overload: pressure that clears gray
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {"at": self.at, "op": self.op}
@@ -121,7 +134,7 @@ class Event(NamedTuple):
             if v is not None:
                 d[name] = list(v)
         for name in ("down", "up", "every", "stagger", "factor",
-                     "delay", "jitter"):
+                     "delay", "jitter", "capacity", "threshold", "recover"):
             v = getattr(self, name)
             if v is not None:
                 d[name] = v
@@ -160,6 +173,9 @@ class Event(NamedTuple):
             factor=int(d["factor"]) if "factor" in d else None,
             delay=int(d["delay"]) if "delay" in d else None,
             jitter=int(d["jitter"]) if "jitter" in d else None,
+            capacity=int(d["capacity"]) if "capacity" in d else None,
+            threshold=int(d["threshold"]) if "threshold" in d else None,
+            recover=int(d["recover"]) if "recover" in d else None,
         )
 
     def target_nodes(self) -> tuple[int, ...]:
@@ -263,6 +279,7 @@ class ScenarioSpec(NamedTuple):
             return targets
 
         gray_windows: dict[int, list[tuple[int, int]]] = {}
+        overload_seen = False
         for e in self.events:
             if not 0 <= e.at < self.ticks:
                 raise ValueError(
@@ -316,6 +333,33 @@ class ScenarioSpec(NamedTuple):
                                 "factor wins would be order-dependent"
                             )
                     gray_windows.setdefault(node, []).append((e.at, until))
+            elif e.op == "overload":
+                if overload_seen:
+                    raise ValueError(
+                        "at most one overload event per spec (which "
+                        "capacity/threshold wins would be order-dependent)"
+                    )
+                overload_seen = True
+                check_window(e, "overload")
+                if not (e.capacity and e.capacity >= 1):
+                    raise ValueError(
+                        f"overload needs capacity >= 1 (got {e.capacity})"
+                    )
+                if not (e.threshold and e.threshold >= 1):
+                    raise ValueError(
+                        f"overload needs threshold >= 1 (got {e.threshold})"
+                    )
+                rec = e.recover if e.recover is not None else 0
+                if not 0 <= rec < e.threshold:
+                    raise ValueError(
+                        f"overload needs 0 <= recover < threshold (got "
+                        f"recover={e.recover}, threshold={e.threshold})"
+                    )
+                if not (e.factor and e.factor >= 2):
+                    raise ValueError(
+                        f"overload needs factor >= 2 (got {e.factor}; "
+                        "1 would degrade nothing)"
+                    )
             elif e.op in ("link_loss", "delay"):
                 check_window(e, e.op)
                 for name in ("src", "dst"):

@@ -334,6 +334,7 @@ def run_streamed(
         cluster.dparams if cluster.backend == "delta" else cluster.params
     )
     adj = srunner.precheck(cluster.state, cluster.net, compiled, params_pre)
+    srunner.precheck_overload(compiled, traffic, cluster.net)
     if checkpoint_path and store is None:
         # resume must be able to reassemble the full trace, so a
         # checkpointed run always persists its slabs
@@ -451,6 +452,10 @@ def resume(
         # for fresh runs
         standing_ok=True,
     )
+    # same opt-out for the overload feedback carry: the checkpointed
+    # net's ov_cnt/ov_gray ARE this run's mid-window state, and
+    # prepare_faults resumes the pressure from them
+    srunner.precheck_overload(compiled, traffic, cluster.net, standing_ok=True)
     # cluster.key already holds the post-schedule key (the schedule was
     # fully drawn before the first segment); derive the schedule again
     # from the recorded start key without touching it
@@ -501,13 +506,15 @@ def _drive(
     led = default_ledger()
     is_delta = cluster.backend == "delta"
     params = cluster.dparams if is_delta else cluster.params
+    traffic = srunner.overload_traffic(traffic, compiled)
     tr_tensors = traffic.tensors if traffic is not None else None
     static_traffic = traffic.static if traffic is not None else None
     sink = cluster.stats_sink
-    f_state, period0 = srunner.prepare_faults(
+    f_state, period0, ov0 = srunner.prepare_faults(
         cluster.state, cluster.net, compiled, params
     )
-    carry = (f_state, cluster.net.up, cluster.net.responsive, adj, period0)
+    carry = (f_state, cluster.net.up, cluster.net.responsive, adj, period0,
+             ov0)
     pending: tuple | None = None
     slabs: list[Trace] = []  # only populated when there is no store
     state = {"prev_live": cursor.get("prev_live"), "last_slab": None,
@@ -525,10 +532,10 @@ def _drive(
             "segment_ticks": S,
             "total_ticks": T,
         }
-        if traffic is not None:
-            meta["traffic_m"] = traffic.static.m
+        if static_traffic is not None:
+            meta["traffic_m"] = static_traffic.m
         args = (
-            *carry,
+            *carry[:5],
             compiled.ev_tick,
             compiled.ev_kind,
             compiled.ev_node,
@@ -539,11 +546,13 @@ def _drive(
             tr_tensors,
             jnp.int32(a),
             compiled.faults,
+            carry[5],  # the overload feedback carry (or None)
         )
         statics = dict(
             params=params,
             has_revive=compiled.has_revive,
             traffic=static_traffic,
+            overload=compiled.overload,
         )
         srunner._dispatches += 1
         t0 = time.perf_counter()
@@ -627,6 +636,7 @@ def _drive(
             # the previous segment's compute lands — the one pipeline
             # bubble durability costs; drain + checkpoint write below
             # still overlap this segment's compute)
+            ov_snap = carry[5]
             snap = (
                 _to_host(carry[0]),
                 NetState(
@@ -636,10 +646,16 @@ def _drive(
                     period=(
                         np.asarray(carry[4]) if carry[4] is not None else None
                     ),
+                    ov_cnt=(
+                        np.asarray(ov_snap[0]) if ov_snap is not None else None
+                    ),
+                    ov_gray=(
+                        np.asarray(ov_snap[1]) if ov_snap is not None else None
+                    ),
                 ),
             )
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:5], out[5]
+        carry, ys = out[:6], out[6]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -662,9 +678,11 @@ def _drive(
         _drain(pending, overlapped=False)
 
     # the run is whole again: hand the final carry back to the cluster
-    f_state, f_up, f_resp, f_adj, f_per = carry
+    f_state, f_up, f_resp, f_adj, f_per, f_ov = carry
     cluster.state = f_state
-    cluster.net = srunner.final_net(f_up, f_resp, f_adj, f_per, compiled)
+    cluster.net = srunner.final_net(
+        f_up, f_resp, f_adj, f_per, compiled, ov=f_ov
+    )
     cluster.set_loss(float(compiled.loss[-1]))  # host mirror (run_scenario)
     if checkpoint_path is not None:
         # final checkpoint: cursor complete, final state — written
@@ -719,6 +737,7 @@ def run_sweep_streamed(
     loss_scales: Any | None = None,
     kill_jitter: Any | None = None,
     flap_jitter: Any | None = None,
+    traffic: Any | None = None,
     store: str | None = None,
     assemble: bool = True,
     pipeline: bool = True,
@@ -747,6 +766,8 @@ def run_sweep_streamed(
         raise ValueError(
             "assemble=False discards nothing only with a segment store"
         )
+    if traffic is not None:
+        traffic = cluster.compile_traffic(traffic)
     cs = ssweep.compile_sweep(
         spec,
         cluster.n,
@@ -758,6 +779,10 @@ def run_sweep_streamed(
     )
     params = cluster.dparams if cluster.backend == "delta" else cluster.params
     adj = srunner.precheck(cluster.state, cluster.net, cs.base, params)
+    srunner.precheck_overload(cs.base, traffic, cluster.net)
+    traffic = srunner.overload_traffic(traffic, cs.base)
+    tr_tensors = traffic.tensors if traffic is not None else None
+    static_traffic = traffic.static if traffic is not None else None
     # raising validation/IO precedes the replica-key draws: a failed
     # call may not advance cluster.key (see run_streamed)
     if shard:
@@ -769,7 +794,7 @@ def run_sweep_streamed(
     start_tick = int(cluster.state.tick)
     led = default_ledger()
     r = cs.replicas
-    f_state, period0 = srunner.prepare_faults(
+    f_state, period0, ov0 = srunner.prepare_faults(
         cluster.state, cluster.net, cs.base, params
     )
     carry = (
@@ -778,6 +803,7 @@ def run_sweep_streamed(
         ssweep._broadcast_replicas(cluster.net.responsive, r),
         ssweep._broadcast_replicas(adj, r),
         ssweep._broadcast_replicas(period0, r),
+        ssweep._broadcast_replicas(ov0, r),
     )
     sharding = ssweep._replica_sharding() if shard else None
     if sharding is not None:
@@ -823,7 +849,7 @@ def run_sweep_streamed(
             "total_ticks": T,
         }
         args = (
-            *carry,
+            *carry[:5],
             cs.ev_tick,
             cs.ev_kind,
             cs.ev_node,
@@ -833,8 +859,15 @@ def run_sweep_streamed(
             keys[:, a:b],
             jnp.int32(a),
             cs.base.faults,
+            tr_tensors,
+            carry[5],  # the overload feedback carry (or None)
         )
-        statics = dict(params=params, has_revive=cs.base.has_revive)
+        statics = dict(
+            params=params,
+            has_revive=cs.base.has_revive,
+            traffic=static_traffic,
+            overload=cs.base.overload,
+        )
         ssweep._dispatches += 1
         t0 = time.perf_counter()
         if led.enabled:
@@ -882,7 +915,7 @@ def run_sweep_streamed(
 
     for seg, (a, b) in enumerate(bounds):
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:5], out[5]
+        carry, ys = out[:6], out[6]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -896,8 +929,12 @@ def run_sweep_streamed(
     if pending is not None:
         _drain(pending, overlapped=False)
 
-    states, up, resp, adj_out, per_out = carry
-    nets = NetState(up=up, responsive=resp, adj=adj_out, period=per_out)
+    states, up, resp, adj_out, per_out, ov_out = carry
+    net_kw = {}
+    if ov_out is not None:
+        net_kw = dict(ov_cnt=ov_out[0], ov_gray=ov_out[1])
+    nets = NetState(up=up, responsive=resp, adj=adj_out, period=per_out,
+                    **net_kw)
     if not assemble:
         return store_obj
     trace = (

@@ -349,29 +349,38 @@ def _sweep_scan_impl(
     keys,
     tick0=None,
     faults=None,
+    tr_tensors=None,
+    ov=None,
     *,
     params,
     has_revive: bool,
+    traffic=None,
+    overload=None,
 ):
     # ``tick0`` (traced int32 scalar shared by every replica, or None
     # for 0) is the segment offset of the streamed sweep
     # (scenarios/stream.py): closed over rather than batched, so the
     # vmapped body sees the same global tick numbering per segment.
     def one(state, up, responsive, adj, period, ev_tick, ev_kind, ev_node,
-            p_tick, p_gid, loss, keys, faults):
+            p_tick, p_gid, loss, keys, faults, tr_tensors, ov):
         return runner._scenario_scan_impl(
             state, up, responsive, adj, period,
             ev_tick, ev_kind, ev_node, p_tick, p_gid, loss, keys,
-            None, tick0, faults,
-            params=params, has_revive=has_revive,
+            tr_tensors, tick0, faults, ov,
+            params=params, has_revive=has_revive, traffic=traffic,
+            overload=overload,
         )
 
     return jax.vmap(
         one,
-        # batched: state/net (leading replica axis, period carry
-        # included), node events (jitter reorders rows), loss (scaled),
-        # keys.  Shared: partition rows + failure-model tensors.
-        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0, 0, None),
+        # batched: state/net (leading replica axis, period + overload
+        # carries included), node events (jitter reorders rows), loss
+        # (scaled), keys.  Shared: partition rows, failure-model
+        # tensors, and the traffic workload (one key stream — every
+        # replica serves the identical key batches against its own
+        # trajectory, exactly what a standalone run_scenario with this
+        # workload would serve).
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0, 0, None, None, 0),
     )(
         state,
         up,
@@ -386,6 +395,8 @@ def _sweep_scan_impl(
         loss,
         keys,
         faults,
+        tr_tensors,
+        ov,
     )
 
 
@@ -394,7 +405,7 @@ def _sweep_scan_impl(
 # benchmarks/mem_census.py.
 _sweep_scan = jax.jit(
     _sweep_scan_impl,
-    static_argnames=("params", "has_revive"),
+    static_argnames=("params", "has_revive", "traffic", "overload"),
     donate_argnums=(0, 1, 2, 3),
 )
 
@@ -440,6 +451,7 @@ def run_sweep_compiled(
     params: Any,
     *,
     shard: bool = False,
+    traffic: Any | None = None,
 ) -> tuple[Any, Any, dict[str, jax.Array]]:
     """One jitted call: R replicas of the compiled scenario.
 
@@ -447,6 +459,13 @@ def run_sweep_compiled(
     stacks [R, ticks]).  ``state``/``net`` are the UNBATCHED starting
     point; they are broadcast to R fresh device copies here (the
     copies are donated to the scan; the caller's state is untouched).
+
+    ``traffic`` (a pre-lowered ``CompiledTraffic``) co-runs the key
+    workload in every replica — tensors shared across the replica axis
+    (one workload stream), so replica r's serving counters are exactly
+    what a standalone ``run_scenario(spec_r, traffic=ct)`` from its
+    replica key would report: incident sweeps emit R serving
+    scorecards in one dispatch (``SweepTrace.serving_summary``).
 
     ``shard=True`` splits the replica axis across the local devices
     (replicas are data-parallel by construction — no cross-replica
@@ -461,7 +480,8 @@ def run_sweep_compiled(
             f"({cs.replicas} replicas, {cs.base.ticks} ticks)"
         )
     adj = runner.precheck(state, net, cs.base, params)
-    state, period = runner.prepare_faults(state, net, cs.base, params)
+    traffic = runner.overload_traffic(traffic, cs.base)
+    state, period, ov = runner.prepare_faults(state, net, cs.base, params)
     r = cs.replicas
     batched = [
         _broadcast_replicas(state, r),
@@ -470,6 +490,7 @@ def run_sweep_compiled(
         _broadcast_replicas(adj, r),
         _broadcast_replicas(period, r),
     ]
+    ov_b = _broadcast_replicas(ov, r)
     if shard:
         precheck_shard(r)
         sharding = _replica_sharding()
@@ -481,10 +502,21 @@ def run_sweep_compiled(
                 for t in batched
             ]
             keys = jax.device_put(keys, sharding)
+            ov_b = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), ov_b
+            )
     _dispatches += 1
+    meta = {
+        "backend": "delta" if hasattr(params, "wire_cap") else "dense",
+        "n": cs.base.n,
+        "ticks": cs.base.ticks,
+        "replicas": r,
+    }
+    if traffic is not None:
+        meta["traffic_m"] = traffic.static.m
     # routed through the dispatch ledger (obs/ledger.py): a call-through
     # when disabled, a recorded compile/execute + footprint row when on
-    states, up, resp, adj, period, ys = default_ledger().dispatch(
+    states, up, resp, adj, period, ov, ys = default_ledger().dispatch(
         "run_sweep",
         _sweep_scan,
         *batched,
@@ -497,16 +529,18 @@ def run_sweep_compiled(
         keys,
         None,
         cs.base.faults,
+        traffic.tensors if traffic is not None else None,
+        ov_b,
         params=params,
         has_revive=cs.base.has_revive,
-        _meta={
-            "backend": "delta" if hasattr(params, "wire_cap") else "dense",
-            "n": cs.base.n,
-            "ticks": cs.base.ticks,
-            "replicas": r,
-        },
+        traffic=traffic.static if traffic is not None else None,
+        overload=cs.base.overload,
+        _meta=meta,
     )
-    nets = type(net)(up=up, responsive=resp, adj=adj, period=period)
+    net_kw = {}
+    if ov is not None:
+        net_kw = dict(ov_cnt=ov[0], ov_gray=ov[1])
+    nets = type(net)(up=up, responsive=resp, adj=adj, period=period, **net_kw)
     return states, nets, ys
 
 
@@ -722,6 +756,46 @@ class SweepTrace:
             "converged_final": int(self.converged[:, -1].sum()),
         }
         return out
+
+    def serving_summary(self) -> list[dict[str, Any]] | None:
+        """Per-replica serving scorecards (traffic-coupled sweeps; None
+        when the sweep served no workload): goodput, retry
+        amplification, latency percentiles from the replica's histogram
+        plane when the SLO plane ran, and the overload peaks when the
+        feedback loop ran — one row per replica, the incident sweep's
+        one-dispatch answer to "how did serving fare per seed"."""
+        if "lookups" not in self.metrics:
+            return None
+        rows = []
+        for r in range(self.replicas):
+            from ringpop_tpu.traffic.engine import total_sends
+
+            m = {k: v[r] for k, v in self.metrics.items()}
+            lookups = int(m["lookups"].sum())
+            delivered = int(m["delivered"].sum())
+            sends = total_sends(m)
+            row: dict[str, Any] = {
+                "replica": r,
+                "lookups": lookups,
+                "delivered": delivered,
+                "goodput": delivered / lookups if lookups else 0.0,
+                "misroutes": int(m["misroutes"].sum()),
+                "amplification": sends / delivered if delivered else 0.0,
+            }
+            if "gray_timeouts" in m:
+                row["gray_timeouts"] = int(m["gray_timeouts"].sum())
+            if "ov_gray_nodes" in m:
+                row["ov_gray_peak"] = int(m["ov_gray_nodes"].max())
+                row["ov_pressure_peak"] = int(m["ov_pressure_max"].max())
+            if "lat_hist_ms" in self.planes:
+                from ringpop_tpu.traffic.latency import hist_stats
+
+                agg = hist_stats(self.planes["lat_hist_ms"][r].sum(axis=0))
+                row["lat_p50_ms"] = agg["median"]
+                row["lat_p95_ms"] = agg["p95"]
+                row["lat_p99_ms"] = agg["p99"]
+            rows.append(row)
+        return rows
 
     # -- npz round trip ------------------------------------------------------
 

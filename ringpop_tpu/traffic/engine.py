@@ -31,6 +31,7 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ringpop_tpu.models.swim_sim import ALIVE, SUSPECT, _link_delay_bounds
 from ringpop_tpu.ops.ring_ops import DeviceRing, lookup_n_idx
@@ -54,6 +55,14 @@ class TrafficStatic(NamedTuple):
     # mechanism.
     latency_buckets: int = 0
     period_ms: int = 200  # tick -> ms conversion for link delays/backoff
+    # Per-node send-load accounting (the overload feedback's input,
+    # scenarios/faults.OverloadConfig).  0 = off: the compiled program
+    # is unchanged.  1 adds an int32[N] ``node_sends`` output — send
+    # attempts landing on each node this tick (local handling at the
+    # arrival viewer + every forward-chain attempt at its holder,
+    # retries included) — which the scenario scan consumes for the
+    # pressure update and never stacks into the trace.
+    track_load: int = 0
 
 
 class TrafficTensors(NamedTuple):
@@ -146,6 +155,19 @@ def lookup_n_masked_idx(
     )
 
 
+def total_sends(metrics: dict) -> int:
+    """The retry-amplification NUMERATOR — every send the serve plane
+    issued: local handling at the arrival viewer + first proxy sends +
+    consumed retries.  One definition shared by the sweep scorecards,
+    the incident summaries, and the CLI serving line (host-side trace
+    series: sums whole [T] arrays or single-tick rows alike)."""
+    return (
+        int(np.sum(metrics["handled_local"]))
+        + int(np.sum(metrics["proxy_sends"]))
+        + int(np.sum(metrics["proxy_retries"]))
+    )
+
+
 def counter_names(static: TrafficStatic) -> tuple[str, ...]:
     """The per-tick traffic counter series, in emission order — the
     trace schema for one compiled workload shape."""
@@ -223,6 +245,19 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
     # retry still gets its settle check.
     active = resolved & ~handled_local
     lat_extras: dict[str, jax.Array] = {}
+    track = bool(static.track_load)
+    # send attempts landing per node (track_load): the arrival viewer
+    # absorbs locally handled requests; each forward-chain iteration
+    # below adds its attempt at the holder it targets (dead/off-duty
+    # holders included — the send still lands on that node's inbox,
+    # which is exactly the load the overload feedback meters)
+    loads = (
+        jnp.zeros((n,), jnp.int32).at[viewer].add(
+            handled_local.astype(jnp.int32)
+        )
+        if track
+        else None
+    )
     if not static.latency_buckets:
         carry = (
             jnp.where(active, owner0, viewer),  # current holder
@@ -232,11 +267,14 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             jnp.zeros(static.m, dtype=jnp.int32),  # retries consumed
             active.astype(jnp.int32),  # forwards sent (first send counted)
             unresolved,
+            loads,
         )
 
         def hop(_, c):
-            h, settled, act, final, retries, forwards, unres = c
+            h, settled, act, final, retries, forwards, unres, lds = c
             hc = jnp.clip(h, 0, n - 1)
+            if track:
+                lds = lds.at[hc].add(act.astype(jnp.int32))
             has_retry = retries < static.max_retries
             alive_h = gossip[hc]
             retry_dead = act & ~alive_h & has_retry  # failed send, re-sent
@@ -250,9 +288,10 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             retries = retries + stepped
             forwards = forwards + stepped
             h = jnp.where(go, nxt, h)
-            return (h, settled, go | retry_dead, final, retries, forwards, unres)
+            return (h, settled, go | retry_dead, final, retries, forwards,
+                    unres, lds)
 
-        h, settled, act, final, retries, forwards, unresolved = (
+        h, settled, act, final, retries, forwards, unresolved, loads = (
             jax.lax.fori_loop(0, static.max_retries + 1, hop, carry)
         )
     else:
@@ -298,12 +337,15 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             lat0,  # accumulated latency, ms
             jnp.int32(0),  # gray timeouts (events)
             jnp.int32(0),  # failed send attempts (dead + gray)
+            loads,
         )
 
         def hop_lat(i, c):
             (h, settled, act, final, retries, forwards, unres, sender, lat,
-             gray_to, send_err) = c
+             gray_to, send_err, lds) = c
             hc = jnp.clip(h, 0, n - 1)
+            if track:
+                lds = lds.at[hc].add(act.astype(jnp.int32))
             has_retry = retries < static.max_retries
             alive_h = gossip[hc]
             # effective tick: the serve tick advanced by the backoff the
@@ -343,10 +385,10 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             h = jnp.where(stepping, new_holder, h)
             sender = jnp.where(stepping, new_sender, sender)
             return (h, settled, stepping, final, retries, forwards, unres,
-                    sender, lat, gray_to, send_err)
+                    sender, lat, gray_to, send_err, lds)
 
         (h, settled, act, final, retries, forwards, unresolved, sender, lat,
-         gray_to, send_err) = jax.lax.fori_loop(
+         gray_to, send_err, loads) = jax.lax.fori_loop(
             0, static.max_retries + 1, hop_lat, carry
         )
         # delivered proxied requests pay the return leg from their final
@@ -401,10 +443,12 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
         out["lookupns"] = count(served)
         out["lookupn_incomplete"] = count(served & ~complete)
     out.update(lat_extras)
+    if track:
+        out["node_sends"] = loads
     return out
 
 
-def _zero_counters(static: TrafficStatic) -> dict[str, jax.Array]:
+def _zero_counters(static: TrafficStatic, n: int) -> dict[str, jax.Array]:
     """The off-cadence tick's outputs: scalar zeros per counter plus a
     zero row per histogram plane (shapes must match the served branch)."""
     zeros: dict[str, jax.Array] = {
@@ -412,6 +456,10 @@ def _zero_counters(static: TrafficStatic) -> dict[str, jax.Array]:
     }
     for name, width in plane_names(static):
         zeros[name] = jnp.zeros((width,), jnp.int32)
+    if static.track_load:
+        # not a trace series (the scan consumes and pops it), but the
+        # cond branches must agree on structure
+        zeros["node_sends"] = jnp.zeros((n,), jnp.int32)
     return zeros
 
 
@@ -451,7 +499,7 @@ def serve_tick(
             get_rows(), up, responsive, tensors, t, static, damped,
             net=net, period=period,
         )
-    zeros = _zero_counters(static)
+    zeros = _zero_counters(static, up.shape[0])
     return jax.lax.cond(
         t % static.every == 0,
         lambda _: _serve_impl(

@@ -414,6 +414,7 @@ def test_registry_builders_cover_declared_backends():
     pairs = list(iter_entries())
     assert ("run_scenario", "delta") in pairs
     assert ("run_scenario+traffic", "dense") in pairs
+    assert ("run_scenario+incident", "delta") in pairs
     built = build_entry("run_scenario", "dense", n=8, ticks=2)
     assert built.key_roots["protocol"]
     assert built.donates
@@ -426,7 +427,7 @@ def test_full_registry_audits_clean():
     from ringpop_tpu.analysis.contracts import audit_all
 
     reports, findings = audit_all(n=32, ticks=3)
-    assert len(reports) == 9
+    assert len(reports) == 11  # + the (run_scenario+incident, *) pair
     bad = [f for f in findings if f.severity in ("warning", "error")]
     assert bad == [], [str(f) for f in bad]
 

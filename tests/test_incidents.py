@@ -1,0 +1,185 @@
+"""Incident library: builders, reference specs, the golden lane.
+
+Fast lane is host-only (builders validate across sizes, the checked-in
+reference specs match the library's rendering, summary arithmetic,
+catalog/CLI listing).  The golden grid — every (incident, backend)
+pair run at the pinned configuration and bit-compared against
+``tests/golden/incidents/*.json`` — compiles one scenario+traffic
+program per pair and rides the nightly slow lane (re-pin after an
+intentional change with ``python tools/pin_incidents.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.scenarios import library as lib
+from ringpop_tpu.scenarios.trace import Trace
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden", "incidents")
+
+GOLDEN_PAIRS = [
+    (name, backend)
+    for name in lib.incident_names()
+    for backend in lib.INCIDENTS[name].backends
+]
+
+
+# ---------------------------------------------------------------------------
+# fast: host-only
+# ---------------------------------------------------------------------------
+
+
+def test_catalog_shape():
+    assert len(lib.INCIDENTS) >= 6
+    both = [n for n, i in lib.INCIDENTS.items() if i.backends == ("dense", "delta")]
+    # the acceptance floor: at least six incidents run on BOTH backends
+    assert len(both) >= 6, both
+    text = lib.format_catalog()
+    for name in lib.incident_names():
+        assert name in text
+
+
+@pytest.mark.parametrize("name", lib.incident_names())
+def test_builders_validate_across_sizes(name):
+    for n in (8, 16, 64, 100):
+        spec, wl = lib.build_incident(name, n)
+        assert spec.ticks >= 40
+        assert wl.latency_buckets == lib.LATENCY_BUCKETS
+        # ticks override scales the windows without breaking validation
+        spec2, _ = lib.build_incident(name, n, ticks=spec.ticks + 60)
+        assert spec2.ticks == spec.ticks + 60
+    with pytest.raises(ValueError):
+        lib.build_incident(name, 4)  # too small
+    with pytest.raises(ValueError):
+        lib.build_incident("no_such_incident", 16)
+
+
+def test_dense_only_incidents_reject_delta():
+    dense_only = [
+        n for n, i in lib.INCIDENTS.items() if i.backends == ("dense",)
+    ]
+    assert dense_only  # revive-bearing incidents exist and say so
+    for name in dense_only:
+        assert any(
+            e.op in ("revive", "rolling_restart")
+            for e in lib.build_incident(name, 16)[0].events
+        )
+        with pytest.raises(ValueError, match="dense"):
+            lib.build_incident(name, 16, backend="delta")
+
+
+def test_reference_specs_in_sync():
+    """The checked-in scenarios/specs/*.json match the library's
+    rendering — the JSON is a durable artifact, the builder is the
+    source of truth (re-render via tools/pin_incidents.py)."""
+    for name in lib.incident_names():
+        path = os.path.join(lib.SPEC_DIR, f"{name}.json")
+        assert os.path.exists(path), f"missing reference spec {path}"
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert on_disk == lib.spec_document(name), (
+            f"{path} is stale; re-render with tools/pin_incidents.py"
+        )
+
+
+def test_incident_summary_arithmetic():
+    ticks = 8
+    conv = np.zeros(ticks, bool)
+    conv[5:] = True
+    metrics = {
+        "faulty_declared": np.array([0, 0, 2, 0, 0, 0, 0, 0], np.int32),
+        "suspects_declared": np.array([0, 1, 2, 0, 0, 0, 0, 0], np.int32),
+        "lookups": np.full(ticks, 10, np.int32),
+        "delivered": np.full(ticks, 9, np.int32),
+        "dropped": np.zeros(ticks, np.int32),
+        "misroutes": np.array([0, 0, 3, 1, 0, 0, 0, 0], np.int32),
+        "proxy_failed": np.ones(ticks, np.int32),
+        "handled_local": np.full(ticks, 4, np.int32),
+        "proxy_sends": np.full(ticks, 5, np.int32),
+        "proxy_retries": np.full(ticks, 2, np.int32),
+        "gray_timeouts": np.full(ticks, 1, np.int32),
+        "ov_gray_nodes": np.array([0, 1, 3, 2, 0, 0, 0, 0], np.int32),
+        "ov_pressure_max": np.array([0, 9, 40, 12, 0, 0, 0, 0], np.int32),
+    }
+    hist = np.zeros((ticks, 4), np.int32)
+    hist[:, 0] = 9
+    trace = Trace(
+        metrics=metrics, converged=conv, live=np.full(ticks, 9, np.int32),
+        loss=np.zeros(ticks, np.float32), n=10, backend="dense",
+        planes={"lat_hist_ms": hist},
+    )
+    s = lib.incident_summary(trace)
+    assert s["detect_tick"] == 2
+    assert s["heal_tick"] == 5
+    assert s["final_live"] == 9
+    assert s["sends"] == ticks * (4 + 5 + 2)
+    assert s["ov_gray_peak"] == 3
+    assert s["ov_pressure_peak"] == 40
+    assert s["lat_p50_ms"] == 0
+    assert all(isinstance(v, int) for v in s.values())
+    # never-converged and never-detected report -1
+    trace2 = Trace(
+        metrics={k: np.zeros(ticks, np.int32) for k in
+                 ("faulty_declared", "suspects_declared")},
+        converged=np.zeros(ticks, bool), live=np.full(ticks, 10, np.int32),
+        loss=np.zeros(ticks, np.float32), n=10, backend="dense",
+    )
+    s2 = lib.incident_summary(trace2)
+    assert s2["detect_tick"] == -1 and s2["heal_tick"] == -1
+    line = lib.format_summary("x", s)
+    assert "goodput" in line and "amplification" in line
+
+
+def test_overload_control_build():
+    spec, _ = lib.build_incident("cascading_overload", 16, overload=False)
+    assert not any(e.op == "overload" for e in spec.events)
+
+
+def test_cli_list_incidents(capsys):
+    from ringpop_tpu.cli import tick_cluster
+
+    tick_cluster.main(["--list-incidents"])
+    out = capsys.readouterr().out
+    for name in lib.incident_names():
+        assert name in out
+
+
+def test_cli_incident_flag_validation():
+    from ringpop_tpu.cli import tick_cluster
+
+    with pytest.raises(SystemExit):
+        tick_cluster.main(["--incident", "cascading_overload"])  # needs tpu-sim
+    with pytest.raises(SystemExit):
+        tick_cluster.main(
+            ["--backend", "tpu-sim", "--incident", "cascading_overload",
+             "--traffic", "zipf:64"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# slow: the golden regression grid (nightly lane)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,backend", GOLDEN_PAIRS)
+def test_golden_incident_grid(name, backend):
+    """Every incident's detect/heal/serve summary at the golden
+    configuration matches the pinned file bit-for-bit, per backend —
+    the outage suite every future perf/protocol PR is judged against."""
+    path = lib.golden_path(name, backend, GOLDEN_DIR)
+    assert os.path.exists(path), (
+        f"missing golden {path}; pin with tools/pin_incidents.py"
+    )
+    with open(path) as f:
+        want = json.load(f)
+    got = lib.run_golden(name, backend)
+    assert got == want, (
+        f"{name}.{backend} diverged from its golden summary; if the "
+        "change is intentional re-pin with tools/pin_incidents.py"
+    )

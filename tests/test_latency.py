@@ -629,6 +629,52 @@ def test_delta_delay_protocol_parity_and_maturity():
 
 
 @pytest.mark.slow
+def test_delta_full_sync_flip_deviation_pinned():
+    """The ONE documented delta delay deviation, pinned: the full-sync
+    flip applies in-tick even over a delayed link (it is a structural
+    base flip, not a claim payload the pending lanes can carry —
+    swim_delta.py phase-4 ack path; docs/simulation.md delay row).
+
+    The pin asserts (a) the deviation is actually exercised — full
+    syncs fire on ticks whose links are delaying claims — and (b) its
+    divergence stays BOUNDED: the early flip only accelerates
+    convergence to the receiver's view, so the delta run re-converges
+    within the same horizon as the dense run from the same seed and
+    both end at one checksum group with equal live sets.  If a future
+    change routes the flip through the lanes, this test's full-sync
+    counts shift and the pin (plus the doc row) must be updated
+    together."""
+    spec = {
+        "ticks": 40,
+        "events": [
+            {"at": 1, "op": "delay", "src": list(range(N)),
+             "dst": list(range(N)), "delay": 1, "jitter": 1, "until": 36},
+            {"at": 2, "op": "loss", "p": 0.25},
+            {"at": 4, "op": "kill", "node": 9},
+            {"at": 20, "op": "loss", "p": 0.0},
+        ],
+    }
+    kw = dict(capacity=N, wire_cap=N, claim_grid=3 * N * N)
+    d = SimCluster(N, LEAN, seed=3, backend="delta", **kw)
+    td = d.run_scenario(spec)
+    fs = td.metrics["full_syncs"]
+    dc = td.metrics["delayed_claims"]
+    # the deviation fired: full syncs landed while links were delaying
+    assert int(fs.sum()) > 0
+    assert int(((fs > 0) & (dc > 0)).sum()) > 0, (
+        "no full sync overlapped an active delay window; the deviation "
+        "was not exercised — strengthen the spec"
+    )
+    a = SimCluster(N, LEAN, seed=3, backend="dense")
+    ta = a.run_scenario(spec)
+    # bounded divergence: both backends heal inside the horizon
+    assert bool(td.converged[-1]) and bool(ta.converged[-1])
+    assert int(td.live[-1]) == int(ta.live[-1])
+    assert len(set(d.checksums().values())) == 1
+    assert len(set(a.checksums().values())) == 1
+
+
+@pytest.mark.slow
 def test_mem_census_latency_axis_linear_output_flat_segment():
     """The latency plane's footprint shape: the whole-horizon program's
     OUTPUT bytes grow with T (the [T, B] histogram rows), while the

@@ -1,0 +1,467 @@
+"""Load-coupled gray degradation: the overload feedback loop.
+
+The acceptance oracle is the per-tick host walk
+(``_host_overload_walk``): the compiled scenario scan's serving
+counters, latency histogram, overload telemetry, final state, final
+net (pressure + gray bits included), and membership checksums must be
+bit-identical to a host loop that steps the protocol with the same key
+schedule, serves every tick's batch through ``ring_for`` host rings
+with the same duty phases, counts the same per-node send loads, and
+folds them through the SAME ``faults.overload_update`` arithmetic —
+on both backends (PR 12's latency-oracle pattern; the update is exact
+int32 algebra, so parity is equality, not tolerance).
+
+Fast lane: pure-host update/validation units + the dense oracle (one
+small scenario+traffic+overload compile — the tier-1 representative).
+The delta twin, the streamed/resume bit-parity, and the no-feedback
+control comparison ride the slow lane.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import SwimParams
+from ringpop_tpu.ops import ring_ops
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios import faults as sfaults
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+from ringpop_tpu.traffic import engine as tengine
+from ringpop_tpu.traffic import latency as tlat
+
+N = 10
+LEAN = SwimParams(suspicion_ticks=8, ping_req_size=1)
+B = 10
+# exact-window workload: the host rings and the masked walk agree on
+# every key, so the oracle is equality with no unresolved residue
+OV_WL = {"kind": "zipf", "keys_per_tick": 24, "pool": 256, "zipf_s": 1.2,
+         "window": N * ring_ops.DEFAULT_REPLICA_POINTS,
+         "latency_buckets": B}
+
+OV_SPEC = {
+    "ticks": 12,
+    "events": [
+        # seed gray: two slow-but-alive nodes attract duty timeouts
+        {"at": 1, "op": "gray", "nodes": [1, 2], "factor": 4, "until": 10},
+        {"at": 3, "op": "kill", "node": 9},
+        {"at": 1, "op": "overload", "until": 12, "capacity": 1,
+         "threshold": 5, "recover": 1, "factor": 4},
+    ],
+}
+
+SLO_COUNTERS = ("lookups", "dropped", "handled_local", "delivered",
+                "proxy_retries", "proxy_failed", "send_errors",
+                "retry_succeeded", "gray_timeouts", "lat_count",
+                "lat_sum_ms", "lat_max_ms")
+
+
+# ---------------------------------------------------------------------------
+# fast: pure-host units
+# ---------------------------------------------------------------------------
+
+
+def test_overload_spec_validation():
+    def bad(ev):
+        with pytest.raises(ValueError):
+            ScenarioSpec.from_dict({"ticks": 20, "events": [ev]}).validate(N)
+
+    ok = {"at": 2, "op": "overload", "until": 18, "capacity": 4,
+          "threshold": 12, "recover": 3, "factor": 5}
+    ScenarioSpec.from_dict({"ticks": 20, "events": [ok]}).validate(N)
+    bad(dict(ok, capacity=0))
+    bad(dict(ok, threshold=0))
+    bad(dict(ok, recover=12))  # recover must be < threshold
+    bad(dict(ok, factor=1))
+    bad(dict(ok, until=30))
+    with pytest.raises(ValueError):  # at most one overload event
+        ScenarioSpec.from_dict(
+            {"ticks": 20, "events": [ok, dict(ok, at=3)]}
+        ).validate(N)
+    # JSON round trip keeps the overload fields
+    spec = ScenarioSpec.from_dict({"ticks": 20, "events": [ok]})
+    assert ScenarioSpec.from_json(spec.to_json()) == spec
+
+
+def test_overload_config_lowering():
+    spec = ScenarioSpec.from_dict(OV_SPEC)
+    cfg = sfaults.overload_config(spec)
+    assert cfg == sfaults.OverloadConfig(
+        start=1, end=12, capacity=1, threshold=5, recover=1, factor=4
+    )
+    assert sfaults.overload_config(ScenarioSpec(ticks=5)) is None
+    compiled = scompile.compile_spec(spec, N)
+    assert compiled.overload == cfg
+    # "until" defaults to the end of the run
+    spec2 = ScenarioSpec.from_dict(
+        {"ticks": 9, "events": [{"at": 2, "op": "overload", "capacity": 2,
+                                 "threshold": 4, "factor": 3}]}
+    )
+    cfg2 = sfaults.overload_config(spec2)
+    assert (cfg2.end, cfg2.recover) == (9, 0)
+
+
+def test_overload_update_hysteresis():
+    cfg = sfaults.OverloadConfig(start=0, end=100, capacity=2, threshold=6,
+                                 recover=2, factor=4)
+    p = np.zeros(3, np.int32)
+    g = np.zeros(3, bool)
+    # node 0 hammered, node 1 at capacity, node 2 idle
+    for _ in range(3):
+        p, g = sfaults.overload_update(cfg, True, p, g, np.array([5, 2, 0]))
+    assert list(p) == [9, 0, 0] and list(g) == [True, False, False]
+    # drain: pressure falls 2/tick; gray HOLDS until <= recover
+    p, g = sfaults.overload_update(cfg, True, p, g, np.array([0, 0, 0]))
+    assert list(p) == [7, 0, 0] and g[0]
+    for _ in range(2):
+        p, g = sfaults.overload_update(cfg, True, p, g, np.array([0, 0, 0]))
+    assert list(p) == [3, 0, 0] and g[0]  # 3 > recover: still held
+    p, g = sfaults.overload_update(cfg, True, p, g, np.array([0, 0, 0]))
+    assert list(p) == [1, 0, 0] and not g[0]  # 1 <= recover: cleared
+    # outside the window everything pins to zero
+    p, g = sfaults.overload_update(cfg, False, np.array([9, 9, 9], np.int32),
+                                   np.array([True, True, True]),
+                                   np.array([9, 9, 9]))
+    assert not p.any() and not g.any()
+
+
+def test_overload_requires_traffic_and_clear():
+    c = SimCluster(N, LEAN, seed=2)
+    with pytest.raises(ValueError, match="traffic"):
+        c.run_scenario(OV_SPEC)
+    # host loop cannot drive the feedback (it serves no traffic)
+    from ringpop_tpu.scenarios.runner import run_host_loop
+
+    with pytest.raises(NotImplementedError):
+        run_host_loop(c, ScenarioSpec.from_dict(OV_SPEC))
+
+
+# ---------------------------------------------------------------------------
+# the host walk (the latency walk of tests/test_latency.py + per-node
+# send loads + the overload fold)
+# ---------------------------------------------------------------------------
+
+
+def _host_slo_tick_loads(cluster, ct, t):
+    """One SLO traffic tick on the host: identical batch, forward
+    chains over ``ring_for`` rings, latency-stream draws, backoff and
+    duty phases — plus the per-node send loads the overload feedback
+    meters (engine ``node_sends``: local handling at the viewer, every
+    chain iteration's attempt at its holder, dead/off-duty included).
+    Returns (counters, hist int64[B], loads int64[N])."""
+    st = ct.static
+    m = st.m
+    idx, viewers = tengine.sample_tick(ct.tensors, jnp.int32(t), m)
+    idx, viewers = np.asarray(idx), np.asarray(viewers)
+    # the oracle spec has no delay rules, so the latency-stream jitter
+    # draws all scale to zero legs — the walk never needs to draw them
+    bo_ms = tlat.backoff_ms_schedule(st.max_retries)
+    bo_ticks = tlat.backoff_tick_offsets(st.max_retries, st.period_ms)
+
+    net = cluster.net
+    period = (
+        np.asarray(net.period) if net.period is not None
+        else np.ones(cluster.n, np.int32)
+    )
+
+    def duty(h, te):
+        per = max(int(period[h]), 1)
+        return te % per == (h * (0x9E37 | 1)) % per
+
+    live = set(int(i) for i in cluster.live_indices())
+    keys = ct.spec.pool_keys()
+    addr_index = cluster.book.index
+    rings: dict[int, object] = {}
+
+    def ring_of(node):
+        if node not in rings:
+            rings[node] = cluster.ring_for(node)
+        return rings[node]
+
+    counts = {k: 0 for k in SLO_COUNTERS}
+    hist = np.zeros(st.latency_buckets, np.int64)
+    loads = np.zeros(cluster.n, np.int64)
+
+    def deliver(lat, retries):
+        counts["delivered"] += 1
+        counts["lat_count"] += 1
+        counts["lat_sum_ms"] += lat
+        counts["lat_max_ms"] = max(counts["lat_max_ms"], lat)
+        if retries > 0:
+            counts["retry_succeeded"] += 1
+        hist[int(tlat.bucket_index(np.int64(lat), st.latency_buckets))] += 1
+
+    for k in range(m):
+        v = int(viewers[k])
+        if v not in live:
+            counts["dropped"] += 1
+            continue
+        counts["lookups"] += 1
+        key = keys[int(idx[k])]
+        owner0 = addr_index[ring_of(v).lookup(key)]
+        if owner0 == v:
+            counts["handled_local"] += 1
+            loads[v] += 1
+            deliver(0, 0)
+            continue
+        h, retries = owner0, 0
+        lat = 0  # no delay rules in the oracle spec: zero link legs
+        settled, final = False, -1
+        for i in range(st.max_retries + 1):
+            loads[h] += 1  # the attempt lands on h's inbox either way
+            te = t + int(bo_ticks[min(retries, st.max_retries)])
+            alive_h = h in live
+            if not alive_h or not duty(h, te):
+                counts["send_errors"] += 1
+                if alive_h:
+                    counts["gray_timeouts"] += 1
+                if retries < st.max_retries:
+                    lat += int(bo_ms[retries])
+                    retries += 1
+                    continue
+                break
+            nxt = addr_index[ring_of(h).lookup(key)]
+            if nxt == h:
+                settled, final = True, h
+                break
+            if retries < st.max_retries:
+                lat += int(bo_ms[retries])
+                h = nxt
+                retries += 1
+                continue
+            break
+        counts["proxy_retries"] += retries
+        if settled:
+            deliver(lat, retries)
+        else:
+            counts["proxy_failed"] += 1
+    return counts, hist, loads
+
+
+def _host_overload_walk(backend, spec_obj, wl, seed, **kw):
+    """Step the protocol per tick exactly as the compiled scan does —
+    events at tick start, the EFFECTIVE (overload-degraded) period row
+    installed before the step, the schedule key — then serve the
+    tick's batch on the host and fold its loads through
+    ``faults.overload_update``.  Returns (cluster, per-tick rows)."""
+    c = SimCluster(N, LEAN, seed=seed, backend=backend, **kw)
+    ct = c.compile_traffic(wl)
+    cfg = sfaults.overload_config(spec_obj)
+    compiled = scompile.compile_spec(spec_obj, c.n, base_loss=c.params.loss)
+    keys = scompile.key_schedule(c._split, compiled)
+    switches = sfaults.period_switches(spec_obj, c.n)
+    by_tick = defaultdict(list)
+    for at, op, arg in scompile.expand_events(spec_obj, c.params.loss):
+        by_tick[at].append((op, arg))
+    pressure = np.zeros(c.n, np.int32)
+    gray = np.zeros(c.n, bool)
+    rows = []
+    for t in range(spec_obj.ticks):
+        ops = sorted(by_tick.get(t, ()), key=lambda x: scompile._OP_RANK[x[0]])
+        for op, arg in ops:
+            if op == "kill":
+                c.kill(arg)
+            elif op == "suspend":
+                c.suspend(arg)
+            elif op == "resume":
+                c.resume(arg)
+            elif op == "loss":
+                c.set_loss(arg)
+            # faultcfg (gray switches) handled via the period fold below
+        row = np.ones(c.n, np.int32)
+        for at, r in switches:
+            if at <= t:
+                row = r
+        per_eff = np.where(gray, np.maximum(row, cfg.factor), row)
+        c.net = c.net._replace(period=jnp.asarray(per_eff.astype(np.int32)))
+        if backend == "delta":
+            c.state, _ = sdelta.delta_step(
+                c.state, c.net, keys[t], params=c.dparams
+            )
+        else:
+            c.state, _ = sim.swim_step(c.state, c.net, keys[t], params=c.params)
+        counts, hist, loads = _host_slo_tick_loads(c, ct, t)
+        in_win = cfg.start <= t < cfg.end
+        pressure, gray = sfaults.overload_update(
+            cfg, in_win, pressure, gray, loads.astype(np.int32)
+        )
+        rows.append((counts, hist, int(gray.sum()), int(pressure.max())))
+    return c, pressure, gray, rows
+
+
+def _assert_overload_parity(backend, **kw):
+    spec_obj = ScenarioSpec.from_dict(OV_SPEC)
+    a = SimCluster(N, LEAN, seed=11, backend=backend, **kw)
+    ct = a.compile_traffic(OV_WL)
+    trace = a.run_scenario(spec_obj, traffic=ct)
+    b, pressure, gray, rows = _host_overload_walk(
+        backend, spec_obj, OV_WL, seed=11, **kw
+    )
+    for t, (counts, hist, gray_nodes, p_max) in enumerate(rows):
+        for name, value in counts.items():
+            got = int(trace.metrics[name][t])
+            assert got == value, (t, name, got, value)
+        np.testing.assert_array_equal(
+            trace.planes["lat_hist_ms"][t], hist, err_msg=f"tick {t}"
+        )
+        assert int(trace.metrics["ov_gray_nodes"][t]) == gray_nodes, t
+        assert int(trace.metrics["ov_pressure_max"][t]) == p_max, t
+    # the feedback state itself round-trips onto the final net
+    np.testing.assert_array_equal(np.asarray(a.net.ov_cnt), pressure)
+    np.testing.assert_array_equal(np.asarray(a.net.ov_gray), gray)
+    # state + net + checksum parity (the trajectory the degraded
+    # periods steered is identical)
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state), jax.tree_util.tree_leaves(b.state)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    np.testing.assert_array_equal(np.asarray(a.net.up), np.asarray(b.net.up))
+    np.testing.assert_array_equal(
+        np.asarray(a.net.responsive), np.asarray(b.net.responsive)
+    )
+    assert a.checksums() == b.checksums()
+    # the storm actually fired: pressure crossed the threshold and the
+    # duty timeouts it causes are visible
+    assert int(trace.metrics["ov_gray_nodes"].max()) > 0
+    assert int(trace.metrics["gray_timeouts"].sum()) > 0
+
+
+def test_overload_parity_dense():
+    """Tier-1 acceptance oracle (dense arm): compiled scan ==
+    per-tick host walk, bit for bit — counters, histogram, overload
+    telemetry, final state/net/checksums."""
+    _assert_overload_parity("dense")
+
+
+@pytest.mark.slow
+def test_overload_parity_delta():
+    """The delta twin of the acceptance oracle (same machinery on the
+    O(N*C) state; its scenario+traffic+overload program is its own XLA
+    compile, so it rides the nightly lane)."""
+    _assert_overload_parity(
+        "delta", capacity=N, wire_cap=N, claim_grid=3 * N * N
+    )
+
+
+# ---------------------------------------------------------------------------
+# slow: execution-strategy + control-arm contracts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_overload_streamed_and_resume_bit_identical(tmp_path):
+    """Streaming an overload run is an execution strategy (same trace,
+    same final pressure), and a SIGKILL mid-incident resumes from the
+    checkpoint v5 ov tensors to a bit-identical end state."""
+    from ringpop_tpu.scenarios import stream as sstream
+
+    spec = {
+        "ticks": 24,
+        "events": [
+            {"at": 2, "op": "overload", "until": 24, "capacity": 1,
+             "threshold": 5, "recover": 1, "factor": 4},
+        ],
+    }
+    a = SimCluster(N, LEAN, seed=7)
+    ta = a.run_scenario(spec, traffic=OV_WL)
+    b = SimCluster(N, LEAN, seed=7)
+    tb = b.run_scenario(spec, traffic=OV_WL, segment_ticks=7)
+    for k in ta.metrics:
+        np.testing.assert_array_equal(ta.metrics[k], tb.metrics[k], err_msg=k)
+    np.testing.assert_array_equal(
+        np.asarray(a.net.ov_cnt), np.asarray(b.net.ov_cnt)
+    )
+    assert int(np.asarray(a.net.ov_cnt).max()) > 0  # mid-window at the end
+
+    # killed-after-first-checkpoint + resume == uninterrupted
+    ckpt_path = str(tmp_path / "ov.npz")
+    cv = SimCluster(N, LEAN, seed=7)
+    with pytest.raises(sstream.StreamInterrupted):
+        sstream.run_streamed(
+            cv, spec, segment_ticks=7, traffic=OV_WL,
+            checkpoint_path=ckpt_path, interrupt_after=1,
+        )
+    # the checkpoint carries nonzero mid-run pressure
+    from ringpop_tpu import checkpoint as ckpt
+
+    mid = ckpt.load(ckpt_path)
+    assert mid.net.ov_cnt is not None
+    cr, result = sstream.resume(ckpt_path)
+    tr = result
+    for k in ta.metrics:
+        np.testing.assert_array_equal(ta.metrics[k], tr.metrics[k], err_msg=k)
+    np.testing.assert_array_equal(
+        np.asarray(a.net.ov_cnt), np.asarray(cr.net.ov_cnt)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.net.ov_gray), np.asarray(cr.net.ov_gray)
+    )
+    assert a.checksums() == cr.checksums()
+
+
+@pytest.mark.slow
+def test_overload_control_run_has_no_feedback():
+    """The no-feedback CONTROL arm (the BASELINE comparison): same
+    traffic, overload event stripped — the protocol trajectory matches
+    the feedback run only until the first node degrades, and the
+    control trace carries no overload series."""
+    from ringpop_tpu.scenarios import library as ilib
+
+    n = 16
+    spec_fb, wl = ilib.build_incident("cascading_overload", n, ticks=60)
+    spec_ctl, _ = ilib.build_incident(
+        "cascading_overload", n, ticks=60, overload=False
+    )
+    assert any(e.op == "overload" for e in spec_fb.events)
+    assert not any(e.op == "overload" for e in spec_ctl.events)
+    a = SimCluster(n, LEAN, seed=5)
+    tfb = a.run_scenario(spec_fb, traffic=wl)
+    c = SimCluster(n, LEAN, seed=5)
+    tctl = c.run_scenario(spec_ctl, traffic=wl)
+    assert "ov_gray_nodes" in tfb.metrics
+    assert "ov_gray_nodes" not in tctl.metrics
+    assert int(tfb.metrics["ov_gray_nodes"].max()) > 0
+    # gray degradation really steered serving: the feedback arm sees
+    # duty timeouts the control arm cannot
+    assert int(tfb.metrics["gray_timeouts"].sum()) > int(
+        tctl.metrics["gray_timeouts"].sum()
+    )
+
+
+@pytest.mark.slow
+def test_overload_sweep_parity_and_scorecards():
+    """A traffic-coupled sweep replica is bit-identical to the
+    standalone run from its replica key (the sweep parity contract now
+    extended to serving + overload series), and serving_summary emits
+    one scorecard per replica."""
+    spec = {
+        "ticks": 16,
+        "events": [
+            {"at": 1, "op": "overload", "until": 16, "capacity": 1,
+             "threshold": 5, "recover": 1, "factor": 4},
+        ],
+    }
+    c = SimCluster(N, LEAN, seed=9)
+    ct = c.compile_traffic(OV_WL)
+    strace = c.run_sweep(spec, 2, traffic=ct)
+    rows = strace.serving_summary()
+    assert rows is not None and len(rows) == 2
+    assert all("ov_gray_peak" in r for r in rows)
+    # replica 1 standalone: a cluster whose key IS replica key 1
+    d = SimCluster(N, LEAN, seed=9)
+    d.key = jnp.asarray(strace.replica_keys[1])
+    td = d.run_scenario(spec, traffic=ct)
+    rep = strace.replica(1)
+    for k in td.metrics:
+        np.testing.assert_array_equal(rep.metrics[k], td.metrics[k], err_msg=k)
+    np.testing.assert_array_equal(
+        rep.planes["lat_hist_ms"], td.planes["lat_hist_ms"]
+    )

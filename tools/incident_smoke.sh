@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# CPU incident smoke: one fast incident end to end through the CLI.
+# Asserts the incident library's whole chain — named builder ->
+# compiled scenario+traffic scan (streamed) -> detect/heal/serve
+# summary — produces real detections, re-convergence, and a summary
+# BIT-IDENTICAL to the pinned golden (tests/golden/incidents/).
+# This is the CI incident-smoke job's body; run it locally the same
+# way:  tools/incident_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/ringpop-incident.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+
+# the catalog lists every incident without starting a cluster
+JAX_PLATFORMS=cpu python -m ringpop_tpu tick-cluster --list-incidents \
+  | tee "$workdir/catalog.txt"
+grep -q "cascading_overload" "$workdir/catalog.txt"
+grep -q "region_partition_asym_heal" "$workdir/catalog.txt"
+
+# region_partition_asym_heal at the GOLDEN configuration (n=16 seed=3,
+# streamed by default): detections fire through the lossy one-way
+# heal, the cluster re-converges, and the summary matches the pin
+echo "== incident run (golden configuration)"
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+  python -m ringpop_tpu tick-cluster --backend tpu-sim -n 16 --seed 3 \
+  --incident region_partition_asym_heal \
+  --trace-out "$workdir/trace.npz" \
+  | tee "$workdir/run.log"
+
+grep -q "incident region_partition_asym_heal:" "$workdir/run.log"
+
+JAX_PLATFORMS=cpu python - "$workdir" <<'EOF'
+import json
+import sys
+
+from ringpop_tpu.scenarios import library as lib
+from ringpop_tpu.scenarios.trace import Trace
+
+workdir = sys.argv[1]
+trace = Trace.load(f"{workdir}/trace.npz")
+summary = lib.incident_summary(trace)
+
+# nonzero detections: the asymmetric heal produced faulty declarations
+assert summary["detect_tick"] >= 0, summary
+assert summary["faulty_declared"] > 0, summary
+# re-convergence: the cluster healed and stayed healed
+assert summary["heal_tick"] >= 0, summary
+assert summary["final_live"] == lib.GOLDEN_N, summary
+# golden-summary match: the CLI run IS the golden configuration
+with open("tests/golden/incidents/region_partition_asym_heal.dense.json") as f:
+    want = json.load(f)
+assert summary == want, (
+    f"incident summary diverged from the golden pin:\n got {summary}\n"
+    f"want {want}\nre-pin with tools/pin_incidents.py if intentional"
+)
+print("incident smoke OK:", lib.format_summary("region_partition_asym_heal",
+                                               summary))
+EOF
+
+echo "incident smoke passed"

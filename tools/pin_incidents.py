@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""(Re)pin the golden incident summaries + reference specs.
+
+Runs every (incident, backend) pair of the library at the golden
+configuration (scenarios/library.py GOLDEN_*) and writes the summary
+JSON under tests/golden/incidents/, plus re-renders the reference
+specs under ringpop_tpu/scenarios/specs/.  Run after an INTENTIONAL
+protocol or serving change; the nightly golden lane
+(tests/test_incidents.py::test_golden_incident_grid) compares against
+these files bit-for-bit.
+
+    JAX_PLATFORMS=cpu python tools/pin_incidents.py [NAME ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN_DIR = os.path.join(REPO, "tests", "golden", "incidents")
+
+
+def main(argv: list[str]) -> None:
+    sys.path.insert(0, REPO)
+    from ringpop_tpu.scenarios import library as lib
+
+    names = argv or lib.incident_names()
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    for name in names:
+        for backend in lib.INCIDENTS[name].backends:
+            t0 = time.time()
+            summary = lib.run_golden(name, backend)
+            path = lib.golden_path(name, backend, GOLDEN_DIR)
+            with open(path, "w") as f:
+                json.dump(summary, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"{name}.{backend}: {time.time() - t0:.1f}s -> {path}")
+    written = lib.write_specs()
+    print(f"re-rendered {len(written)} reference specs")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

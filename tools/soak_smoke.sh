@@ -9,6 +9,10 @@
 #      checkpoint; its final checksums and assembled trace must be
 #      BIT-IDENTICAL to the reference's (the checkpoint-v5 cursor +
 #      segment-exact key schedule contract, scenarios/stream.py).
+# The soak is INCIDENT-SHAPED: a zipf workload with the SLO latency
+# plane co-runs in the scan and the spec carries an overload feedback
+# window, so the checkpoint round-trips the ov_cnt/ov_gray tensors and
+# the resumed run's serving + overload series must bit-match too.
 # This is the CI soak-resume-smoke job's body; run it locally the
 # same way:  tools/soak_smoke.sh
 set -euo pipefail
@@ -26,12 +30,15 @@ cat > "$spec" <<'EOF'
   "events": [
     {"at": 40,  "op": "kill", "node": 23},
     {"at": 80,  "op": "loss", "p": 0.05},
-    {"at": 300, "op": "loss", "p": 0.0}
+    {"at": 300, "op": "loss", "p": 0.0},
+    {"at": 60,  "op": "overload", "until": 560, "capacity": 2,
+     "threshold": 12, "recover": 3, "factor": 5}
   ]
 }
 EOF
 
 run_args=(--backend tpu-sim -n 24 --seed 1 --scenario "$spec"
+          --traffic zipf:96 --latency-buckets 8
           --segment-ticks 20 --checkpoint-every 1)
 
 echo "== act 1: uninterrupted reference run"
@@ -90,6 +97,12 @@ np.testing.assert_array_equal(ref.loss, res.loss)
 assert set(ref.metrics) == set(res.metrics)
 for k in ref.metrics:
     np.testing.assert_array_equal(ref.metrics[k], res.metrics[k], err_msg=k)
+# the incident shape really ran: serving + overload series present,
+# the feedback loop fired, and the latency plane reassembled bit-equal
+assert ref.metrics["ov_gray_nodes"].max() > 0, "overload never degraded a node"
+assert set(ref.planes) == set(res.planes) and "lat_hist_ms" in ref.planes
+for k in ref.planes:
+    np.testing.assert_array_equal(ref.planes[k], res.planes[k], err_msg=k)
 
 # the victim + resume shared one run_id; per-segment rows carry the
 # pipelining forensics the obs-ledger summarizer reads
