@@ -19,7 +19,7 @@ rough element count.  Two backends:
 
 Usage: python -m benchmarks.hlo_census [--backend dense|delta]
        [--recv-merge sorted|scatter|pallas] [--temps [--min-elems E]]
-       [n] [capacity]
+       [--collectives [--mesh D]] [n] [capacity]
 
 ``--temps`` switches to the temporary-tensor census (the trace-contract
 auditor's contract 5, ringpop_tpu/analysis/contracts.py): one JSON row
@@ -27,6 +27,14 @@ per distinct (shape, dtype, producing primitive, jaxpr path) whose
 intermediate is ``[N, N]``-shaped or at/above the element threshold —
 the machine-readable target list for the footprint hunt (ROADMAP item
 2a: which wide temporaries to bit-pack or fuse next).
+
+``--collectives`` censuses the SHARDED step's partitioned HLO instead
+(the partitioning auditor's contract 6, analysis/partitioning.py): one
+JSON row per (collective op, dtype, shape, protocol phase) with
+bytes-moved and the member-gather classification — which phases pay
+replication for cross-shard gossip today, i.e. ROADMAP item 1's
+remote-copy target list.  Runs on CPU virtual devices; ``--mesh D``
+picks the mesh size (default 2).
 
 ``tests/test_hlo_census.py`` pins the dense tallies as a regression
 guard (future PRs must not silently re-materialize the permuted claim
@@ -231,6 +239,21 @@ def temp_rows(
     return temp_census(closed, dims=dims, min_elems=floor, entry=entry)
 
 
+def collective_rows(n: int, mesh: int) -> list[dict]:
+    """Collective-census rows of the mesh-sharded dense step at the
+    given mesh size, via the partitioning auditor's walker.  Needs
+    ``mesh`` local devices (the caller provisions CPU virtual devices
+    before jax's backend initializes)."""
+    from ringpop_tpu.analysis.contracts import _trace_and_lower
+    from ringpop_tpu.analysis.partitioning import collective_census
+    from ringpop_tpu.analysis.registry import _build_sharded_step
+
+    built = _build_sharded_step("dense", n=n, mesh=mesh)
+    _, _, _, compiled = _trace_and_lower(built, lower=False,
+                                         compile_hlo=True)
+    return collective_census(compiled.as_text(), dims=built.dims)
+
+
 def report(txt: str, header: str) -> None:
     tallies, elems = census_text(txt)
     print(f"{header}  module: {len(txt) / 1e6:.1f} MB text")
@@ -264,9 +287,29 @@ def main():
         help="--temps threshold override (default: N*C on delta, "
              "N*N on dense)",
     )
+    ap.add_argument(
+        "--collectives",
+        action="store_true",
+        help="emit the collective census of the mesh-sharded dense "
+             "step's partitioned HLO (one JSON row per collective op x "
+             "phase: count, bytes, member-gather flag)",
+    )
+    ap.add_argument("--mesh", type=int, default=2,
+                    help="--collectives mesh size (CPU virtual devices)")
     ap.add_argument("n", nargs="?", type=int, default=None)
     ap.add_argument("capacity", nargs="?", type=int, default=256)
     args = ap.parse_args()
+
+    if args.collectives:
+        import json
+
+        from ringpop_tpu.utils import provision_virtual_devices
+
+        provision_virtual_devices(args.mesh)
+        n = args.n if args.n is not None else 64
+        for row in collective_rows(n, args.mesh):
+            print(json.dumps(row), flush=True)
+        return
 
     if args.temps:
         import json

@@ -27,7 +27,7 @@ program needs, not the HBM the arrays occupy.
 Usage:  python -m benchmarks.mem_census [--backend dense|delta|both]
             [--n 1024[,4096,...]] [--replicas 8] [--ticks 8]
             [--capacity 64] [--programs run,scenario,sweep]
-            [--segment-ticks S]
+            [--segment-ticks S] [--mesh D] [--latency B]
 
 ``--segment-ticks S`` adds the streamed runner's S-tick segment
 program (scenarios/stream.py) next to each whole-horizon
@@ -212,6 +212,23 @@ def census_scenario(
             "replicas": 1, "ticks": ticks, "segment_ticks": s, **row}
 
 
+def census_sharded_step(n: int, mesh: int) -> dict:
+    """The mesh-sharded dense step (parallel/mesh.py) through the same
+    memory_analysis lens: the per-chip footprint story row sharding is
+    supposed to buy (argument bytes split across the mesh while the
+    collective all-gathers keep full-plane temporaries alive — the
+    partitioning auditor's census names which phases; this row prices
+    them)."""
+    from ringpop_tpu.analysis.contracts import _trace_and_lower
+    from ringpop_tpu.analysis.registry import _build_sharded_step
+
+    built = _build_sharded_step("dense", n=n, mesh=mesh)
+    _, _, _, compiled = _trace_and_lower(built, lower=False,
+                                         compile_hlo=True)
+    return {"program": "sharded_step", "backend": "dense", "n": n,
+            "replicas": 1, "mesh": mesh, **memory_row(compiled)}
+
+
 def census_sweep(
     backend: str, n: int, ticks: int, capacity: int, replicas: int
 ) -> dict:
@@ -261,6 +278,7 @@ def run(
     programs=("run", "scenario", "sweep"),
     segment_ticks: int | None = None,
     latency_buckets: int = 0,
+    mesh: int | None = None,
 ) -> list[dict]:
     """Every requested census row (the test entry point).
 
@@ -304,6 +322,8 @@ def run(
                 rows.append(
                     census_sweep(backend, n, ticks, capacity, replicas)
                 )
+            if mesh is not None and backend == "dense":
+                rows.append(census_sharded_step(n, mesh))
     for row in rows:
         row["platform"] = jax.default_backend()
     return rows
@@ -326,6 +346,10 @@ def main() -> None:
                     help="also census the streamed S-tick segment program "
                          "next to each run_scenario row (its footprint is "
                          "flat in --ticks; scenarios/stream.py)")
+    ap.add_argument("--mesh", type=int, default=None, metavar="D",
+                    help="also census the mesh-sharded dense step at a "
+                         "D-device mesh (parallel/mesh.py; needs D local "
+                         "or virtual devices)")
     ap.add_argument("--latency", type=int, default=0, metavar="B",
                     help="also census the traffic + SLO-latency-plane "
                          "scenario program with B log2 buckets "
@@ -340,7 +364,7 @@ def main() -> None:
     for row in run(backends=backends, ns=ns, ticks=args.ticks,
                    capacity=args.capacity, replicas=args.replicas,
                    programs=programs, segment_ticks=args.segment_ticks,
-                   latency_buckets=args.latency):
+                   latency_buckets=args.latency, mesh=args.mesh):
         print(json.dumps(row), flush=True)
 
 

@@ -10,14 +10,23 @@ CPU, in seconds:
 
 * ``registry``  — the audited entry points (swim_run, delta_run,
   run_scenario, run_sweep, the traffic+latency-coupled scan,
-  recv_merge_pallas), each with a small lowerable fixture;
+  recv_merge_pallas, and the SHARDED fixtures: the mesh-2/4 dense
+  step and the replica-sharded sweep), each with a small lowerable
+  fixture;
 * ``jaxpr_walk`` — recursive jaxpr traversal: sub-jaxpr iteration,
   primary-scan carry extraction, PRNG key-lineage dataflow;
 * ``contracts`` — the five trace-contract checks over a lowered entry
   point (host transfers, donation, carry dtypes, key lineage,
   temporary-tensor census);
-* ``budgets``  — the pinned per-entry carry dtype budget table (a
-  widened carry slot fails the audit instead of eating HBM);
+* ``partitioning`` — the three compiled-level contracts over the
+  post-SPMD HLO of the sharded entries (collective census with
+  per-phase bytes and the member-gather rule, sharding-propagation
+  survival, pinned byte budgets) — audited against CPU virtual
+  devices, no chip required;
+* ``budgets``  — the pinned budget tables: per-entry carry dtype
+  multisets, per-(entry, mesh) collective censuses, per-(entry, n)
+  compiled-byte footprints (a widened slot / new collective / bytes
+  regression fails the audit; re-pin via tools/pin_budgets.py);
 * ``lint``     — the AST-level lint layer for repo hazards in library
   source (host syncs, ``np.asarray`` on traced values, Python ``if``
   on traced booleans, wall-clock reads in scan bodies);

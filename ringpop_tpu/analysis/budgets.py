@@ -1,21 +1,37 @@
-"""Pinned carry dtype budgets per (entry point, backend).
+"""Pinned budgets per compiled program: carry dtypes, collectives, bytes.
 
-The multiset of primary-scan carry dtypes each compiled program is
-ALLOWED to hold.  The auditor (``contracts.check_carry_dtypes``)
-compares the traced carries against this table: a widened slot (int32
-where int8 was pinned), a new carry leaf, or a dropped one fails the
-audit until the change is justified and the row here re-pinned — the
-review gate ROADMAP item 2(a)'s footprint hunt needs (carry bytes are
-the resident-HBM floor of every streamed soak).
+Three tables, one review-gate idea: the auditor compares what the
+trace/lowering ACTUALLY produces against what a human explicitly
+ALLOWED, so a silent regression (a widened carry slot, a new
+all-gather in a sharded program, a compiled-bytes jump) fails the
+audit until the change is justified and the row re-pinned.
 
-Regenerate a row after an intentional carry change with:
+* ``CARRY_BUDGETS``      — the multiset of primary-scan carry dtypes
+  per (entry, backend); shape-independent, one pin covers every n
+  (``contracts.check_carry_dtypes``).
+* ``COLLECTIVE_BUDGETS`` — the census of collective ops in the
+  PARTITIONED HLO per (entry, backend, mesh size): op-kind counts
+  plus the member-gather count (all-gathers that rebuild a full
+  member-axis tensor — the replication traffic ROADMAP item 1's
+  remote-copy gossip must drive to zero).  Counts are partitioner
+  decisions, so each row records the fixture ``n`` it was pinned at
+  and is only compared at that shape
+  (``partitioning.check_collectives``).
+* ``BYTE_BUDGETS``       — XLA ``memory_analysis`` footprints per
+  (entry, backend, n) at a pinned tick count, with a tolerance band:
+  over-band fails (the 65k wall got closer), under-band by more than
+  the tolerance is a prompt to re-pin and LOCK IN the reduction
+  (``partitioning.check_byte_budget``).
 
+Regenerate after an intentional change with::
+
+    python tools/pin_budgets.py            # all three tables
     python -m ringpop_tpu audit --entry NAME --backend B --print-budget
 
-The counts are shape-independent (dtype multiset only), so one pin
-covers every n.  ``run_scenario+traffic`` rows include the serving
-plane's counters; the plain ``run_scenario`` row is the protocol-only
-program.
+All three tables assume the pinned jax build
+(``ringpop_tpu.utils.jaxpin``): a version bump makes them stale, and
+the partitioning checks downgrade to a warning instead of bit-diffing
+a different partitioner's output.
 """
 
 from __future__ import annotations
@@ -49,11 +65,108 @@ CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
     ("run_sweep", "dense"): {"bool": 2, "int32": 3, "int8": 2},
     ("run_sweep", "delta"): {"bool": 3, "int32": 8, "int8": 2, "uint32": 1},
     ("recv_merge_pallas", "dense"): {"int32": 2},
+    # the sharded step has no tick scan: its "carries" are the int32
+    # loop state of the step's 22 inner sort/fori kernels (primary at
+    # this program's top level); the sharded sweep's carry is
+    # bit-identical to the unsharded run_sweep rows — sharding the
+    # replica axis must never change WHAT the scan carries, only where
+    # it lives
+    ("sharded_step", "dense"): {"int32": 44},
+    ("sharded_step@4", "dense"): {"int32": 44},
+    ("run_sweep+shard", "dense"): {"bool": 2, "int32": 3, "int8": 2},
+    ("run_sweep+shard", "delta"): {"bool": 3, "int32": 8, "int8": 2,
+                                   "uint32": 1},
 }
 
 
 def expected(entry: str, backend: str) -> dict[str, int] | None:
     return CARRY_BUDGETS.get((entry, backend))
+
+
+# (entry, backend, mesh size) -> {"n": fixture n the row was pinned at,
+# "counts": {collective kind: op count}}.  "member-gather" counts the
+# all-gathers whose output rebuilds a full member-axis tensor (an
+# [N, *]-class plane re-replicated across the mesh) — the current
+# viewer-row sharded step pays dozens of them per tick, which is
+# exactly why ROADMAP item 1 wants remote-copy gossip; this table is
+# the regression gate AND the progress ledger for that rebuild (the
+# pinned member-gather count must only ever go DOWN).  run_sweep+shard
+# is data-parallel by construction: its only collectives are the
+# scalar-telemetry all-reduces, and any member-gather appearing there
+# is a broken replica axis.  Pinned via tools/pin_budgets.py.
+COLLECTIVE_BUDGETS: dict[tuple[str, str, int], dict] = {
+    # the dense sharded step is ALL-GATHER-SHAPED today: 75 of its 143
+    # all-gathers rebuild full [N, *] member planes (30 in
+    # swim.recv_merge alone — the sorted merge's row permutation
+    # re-replicated per call site).  This row is the honest baseline
+    # the remote-copy rebuild (ROADMAP item 1) measures against; the
+    # member-gather count has license to fall, never to rise.
+    ("sharded_step", "dense", 2): {
+        "n": 64,
+        "counts": {"all-gather": 143, "all-reduce": 58,
+                   "collective-permute": 36, "member-gather": 75},
+    },
+    # mesh 4 re-partitions the same program: identical gather/reduce
+    # structure, double the permute lanes (ring hops scale with mesh)
+    ("sharded_step@4", "dense", 4): {
+        "n": 64,
+        "counts": {"all-gather": 143, "all-reduce": 58,
+                   "collective-permute": 72, "member-gather": 75},
+    },
+    # the replica-sharded sweeps are data-parallel by construction:
+    # dense reduces its 10 scalar telemetry sums, delta is fully local
+    # (every reduction already lives inside a replica's rows) — both
+    # entries also declare p2p_only, so ANY member-gather is an error
+    # before the count is even compared
+    ("run_sweep+shard", "dense", 2): {"n": 64, "counts": {"all-reduce": 10}},
+    ("run_sweep+shard", "delta", 2): {"n": 64, "counts": {}},
+}
+
+
+def collective_budget(entry: str, backend: str, mesh: int) -> dict | None:
+    return COLLECTIVE_BUDGETS.get((entry, backend, mesh))
+
+
+# (entry, backend, n) -> {"ticks": pinned tick count, then the
+# obs.ledger.memory_row byte fields}.  Compared only when the audited
+# (n, ticks) match the pin, within BYTE_TOLERANCE (compile scheduling
+# wiggle; the interesting regressions are way outside the band).
+# cpu-platform numbers: the audit always runs on the CPU host, and
+# relative movement there tracks the compiled program's shape — the
+# TPU-absolute numbers live in mem_census/BENCH rows.  Pinned via
+# tools/pin_budgets.py; the n=65,536 delta row is the ROADMAP item 2
+# flagship ledger (the program that killed the round-5 worker), pinned
+# in the slow lane.
+BYTE_BUDGETS: dict[tuple[str, str, int], dict[str, int]] = {
+    # the fast gate: dense pays ~890 MB peak at n=4096 (the [N, N]
+    # planes) vs delta's ~56 MB — the 16x gap IS the reason delta is
+    # the scale flagship
+    ("run_scenario", "dense", 4096): {
+        "ticks": 4, "argument_bytes": 100687936,
+        "output_bytes": 100688256, "temp_bytes": 789048440,
+        "peak_bytes": 889736756,
+    },
+    ("run_scenario", "delta", 4096): {
+        "ticks": 4, "argument_bytes": 2715716, "output_bytes": 2716116,
+        "temp_bytes": 53730592, "peak_bytes": 56446768,
+    },
+    # the flagship ledger (slow lane): the n=65,536 delta program that
+    # killed the round-5 TPU worker pins at ~903 MB derived peak on
+    # the CPU analysis — ROADMAP item 2a's ">=30% reduction" target is
+    # peak_bytes <= ~632 MB on this exact row
+    ("run_scenario", "delta", 65536): {
+        "ticks": 4, "argument_bytes": 43450436,
+        "output_bytes": 43450836, "temp_bytes": 859516192,
+        "peak_bytes": 902967088,
+    },
+}
+
+# Fractional tolerance band around every pinned byte field.
+BYTE_TOLERANCE = 0.10
+
+
+def byte_budget(entry: str, backend: str, n: int) -> dict[str, int] | None:
+    return BYTE_BUDGETS.get((entry, backend, n))
 
 
 def format_multiset(ms: Counter | dict[str, int]) -> str:

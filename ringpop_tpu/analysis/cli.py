@@ -7,6 +7,13 @@ reaches ``--fail-on`` severity.  The CI audit job runs
 benching to know the program it is about to measure still honors the
 pinned contracts.
 
+The sharded entries (``sharded_step``, ``sharded_step@4``,
+``run_sweep+shard``) audit the PARTITIONED programs and need a
+multi-device mesh; on a CPU host the CLI provisions virtual devices
+automatically (``--xla_force_host_platform_device_count=4``, set
+before the backend initializes) so the partitioning contracts gate on
+any machine.
+
 Examples:
 
     python -m ringpop_tpu audit
@@ -14,6 +21,8 @@ Examples:
         --no-compile --json
     python -m ringpop_tpu audit --entry run_scenario+traffic \\
         --backend delta --print-budget
+    python -m ringpop_tpu audit --entry sharded_step --collectives
+    python -m ringpop_tpu audit --entry run_scenario --n 4096  # byte gate
     python -m ringpop_tpu audit --lint-only
 """
 
@@ -25,9 +34,18 @@ import sys
 from collections import Counter
 from pathlib import Path
 
-from ringpop_tpu.analysis.findings import SEVERITY_RANK, at_least
-from ringpop_tpu.analysis.lint import lint_paths
-from ringpop_tpu.analysis.registry import ENTRY_POINTS
+# Virtual CPU devices for the sharded entries: must land in the
+# environment before the first backend initialization (harmless later —
+# the flag only shapes the CPU platform, and an already-initialized
+# backend simply ignores it, leaving the mesh entries to skip with an
+# info finding naming the flag).
+from ringpop_tpu.utils import provision_virtual_devices
+
+provision_virtual_devices(4)
+
+from ringpop_tpu.analysis.findings import SEVERITY_RANK, at_least  # noqa: E402
+from ringpop_tpu.analysis.lint import lint_paths  # noqa: E402
+from ringpop_tpu.analysis.registry import ENTRY_POINTS  # noqa: E402
 
 
 def _parse(argv):
@@ -52,6 +70,9 @@ def _parse(argv):
                     help="one JSON object per entry report (machine lane)")
     ap.add_argument("--census", action="store_true",
                     help="print the temporary-tensor census rows")
+    ap.add_argument("--collectives", action="store_true",
+                    help="print the collective-census rows of the "
+                         "partitioned HLO (sharded entries)")
     ap.add_argument("--census-min-elems", type=int, default=None,
                     help="census threshold override (default: the "
                          "entry's [N, C]-class floor)")
@@ -63,8 +84,10 @@ def _parse(argv):
     ap.add_argument("--lint-only", action="store_true",
                     help="run only the AST lint layer (no tracing)")
     ap.add_argument("--print-budget", action="store_true",
-                    help="print the carry-budget rows for "
-                         "analysis/budgets.py pinning")
+                    help="print ready-to-paste analysis/budgets.py rows "
+                         "(carry dtypes always; collective counts for "
+                         "sharded entries; byte footprints — forces a "
+                         "compile; see also tools/pin_budgets.py)")
     ap.add_argument("--list", action="store_true",
                     help="list registered entry points and exit")
     return ap.parse_args(argv)
@@ -107,6 +130,7 @@ def main(argv: list[str] | None = None) -> None:
             replicas=args.replicas,
             compile_programs=not args.no_compile,
             census_min_elems=args.census_min_elems,
+            force_compile=args.print_budget,
         )
         findings += audit_findings
 
@@ -125,10 +149,20 @@ def main(argv: list[str] | None = None) -> None:
             sev = Counter(f.severity for f in r.findings)
             status = ("clean" if not r.findings else
                       " ".join(f"{v} {k}" for k, v in sorted(sev.items())))
+            mesh_part = ""
+            if r.mesh_size:
+                from ringpop_tpu.analysis.partitioning import (
+                    collective_counts,
+                )
+
+                cc = collective_counts(r.collectives)
+                mesh_part = (f", mesh={r.mesh_size} collectives="
+                             f"{sum(cc.values()) - cc.get('member-gather', 0)}"
+                             f" member-gathers={cc.get('member-gather', 0)}")
             print(
                 f"{r.entry} [{r.backend}] n={r.n}: {status}; "
                 f"{len(r.census)} census rows, aliased={r.aliased_outputs}, "
-                f"prng roots={r.prng.get('roots', {})}"
+                f"prng roots={r.prng.get('roots', {})}{mesh_part}"
             )
             if args.census:
                 for row in r.census:
@@ -138,6 +172,15 @@ def main(argv: list[str] | None = None) -> None:
                         f"{row['primitive']} @ {row['path']} "
                         f"({row['bytes_each'] / 1e6:.2f} MB each)"
                     )
+            if args.collectives:
+                for row in r.collectives:
+                    star = " MEMBER" if row["member"] else ""
+                    print(
+                        f"    [{row['tag']}]{star} {row['op']} "
+                        f"{row['dtype']}{row['shape']} x{row['count']} "
+                        f"@ {row['phase']} "
+                        f"({row['bytes_each'] / 1e3:.1f} kB each)"
+                    )
             if args.print_budget:
                 ms = Counter()
                 for leaves in r.carries.values():
@@ -145,6 +188,24 @@ def main(argv: list[str] | None = None) -> None:
                         ms[leaf.split("[")[0]] += 1
                 print(f"    (\"{r.entry}\", \"{r.backend}\"): "
                       f"{dict(sorted(ms.items()))},")
+                if r.mesh_size:
+                    from ringpop_tpu.analysis.partitioning import (
+                        collective_counts,
+                    )
+
+                    print(f"    (\"{r.entry}\", \"{r.backend}\", "
+                          f"{r.mesh_size}): {{\"n\": {r.n}, \"counts\": "
+                          f"{collective_counts(r.collectives)}}},")
+                if r.mem_bytes is not None:
+                    fields = {k: int(r.mem_bytes[k])
+                              for k in ("argument_bytes", "output_bytes",
+                                        "temp_bytes", "peak_bytes")
+                              if k in r.mem_bytes}
+                    print(f"    (\"{r.entry}\", \"{r.backend}\", {r.n}): "
+                          f"{{\"ticks\": {args.ticks}, "
+                          + ", ".join(f"\"{k}\": {v}"
+                                      for k, v in fields.items())
+                          + "},")
         lint_findings = [f for f in findings
                          if f.contract.startswith("lint:")]
         shown = [f for f in findings
@@ -160,6 +221,17 @@ def main(argv: list[str] | None = None) -> None:
             f"{total.get('error', 0)} errors / "
             f"{total.get('warning', 0)} warnings / "
             f"{total.get('info', 0)} info"
+        )
+
+    # fail CLOSED on capability gaps too: a selection that matched
+    # registered pairs but audited ZERO programs (every fixture skipped
+    # — e.g. mesh entries on a host whose backend initialized with too
+    # few devices) must not green-light the push
+    if not args.lint_only and not reports:
+        sys.exit(
+            "audit: 0 programs audited — every selected entry was "
+            "skipped in this environment (the info findings above name "
+            "what each one needs)"
         )
 
     if args.fail_on != "never" and at_least(findings, args.fail_on):

@@ -29,8 +29,12 @@ StableHLO; ``audit_all`` sweeps the registry.  Checks:
    machine-readable target list for the footprint hunt (ROADMAP item
    2a), also surfaced via ``benchmarks/hlo_census.py --temps``.
 
-All checks are trace/lower-level only; nothing executes or compiles.
-The StableHLO lowering (donation attributes + donation-dropped
+Contracts 6–8 — the PARTITIONING contracts (``partitioning.py``:
+collective-census, sharding-propagation, byte-budget) — operate one
+layer lower, on the compiled executable: they activate for sharded
+entries and for shapes with a pinned byte budget, and are the only
+checks that pay a ``.compile()``.  Everything else is trace/lower-level
+only; the StableHLO lowering (donation attributes + donation-dropped
 warnings both surface there) is skippable with
 ``compile_programs=False`` for big-n census runs where only the jaxpr
 checks are wanted.
@@ -38,6 +42,7 @@ checks are wanted.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 import re
@@ -47,7 +52,7 @@ from typing import Any
 
 import jax
 
-from ringpop_tpu.analysis import budgets
+from ringpop_tpu.analysis import budgets, partitioning
 from ringpop_tpu.analysis.findings import Finding
 from ringpop_tpu.analysis.jaxpr_walk import (
     all_avals,
@@ -56,7 +61,12 @@ from ringpop_tpu.analysis.jaxpr_walk import (
     primary_scans,
     scan_carry_avals,
 )
-from ringpop_tpu.analysis.registry import Built, build_entry, iter_entries
+from ringpop_tpu.analysis.registry import (
+    Built,
+    EntryUnavailable,
+    build_entry,
+    iter_entries,
+)
 
 # Primitive names that imply a host round-trip inside the compiled
 # program.  Matched exactly or as a substring ("callback" covers
@@ -65,6 +75,9 @@ _HOST_PRIM_EXACT = frozenset({"infeed", "outfeed", "host_local_array"})
 _HOST_PRIM_SUBSTR = ("callback",)
 
 _ALIAS_RE = re.compile(r"tf\.aliasing_output")
+# Multi-device lowerings carry donation in the COMPILED module's
+# input_output_alias table instead of StableHLO parameter attributes.
+_HLO_ALIAS_RE = re.compile(r"(?:may|must)-alias")
 _DONATION_WARNING_RE = re.compile(
     r"donated buffer|buffers were not usable", re.IGNORECASE
 )
@@ -87,6 +100,12 @@ class EntryReport:
     carries: dict[str, list[str]]  # scan path -> carry "dtype[shape]" list
     aliased_outputs: int
     host_prims: int
+    # partitioning-contract material (sharded / byte-budgeted entries)
+    mesh_size: int = 0
+    collectives: list[dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    mem_bytes: dict[str, Any] | None = None
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -129,13 +148,20 @@ def check_host_transfers(closed, entry: str) -> tuple[list[Finding], int]:
 
 
 def check_donation(
-    built: Built, lowered_text: str | None, warning_msgs: list[str]
+    built: Built, lowered_text: str | None, warning_msgs: list[str],
+    compiled_text: str | None = None,
 ) -> tuple[list[Finding], int]:
-    """Contract 2: donation declared must be donation applied."""
+    """Contract 2: donation declared must be donation applied.  A
+    multi-device lowering drops the StableHLO ``tf.aliasing_output``
+    attrs and records donation in the compiled module's
+    ``input_output_alias`` table instead, so sharded entries pass the
+    optimized HLO as the fallback evidence."""
     findings: list[Finding] = []
     aliased = (
         len(_ALIAS_RE.findall(lowered_text)) if lowered_text is not None else 0
     )
+    if not aliased and compiled_text is not None:
+        aliased = len(_HLO_ALIAS_RE.findall(compiled_text))
     dropped = [m for m in warning_msgs if _DONATION_WARNING_RE.search(m)]
     if not built.donates:
         return findings, aliased
@@ -175,6 +201,15 @@ def check_carry_dtypes(
     for path, eqn in primary_scans(closed):
         avals = scan_carry_avals(eqn)
         label = path or "<top>"
+        # several primary scans can share one path (the sharded step's
+        # inner sort/fori kernels all sit under "pjit"); disambiguate
+        # so the report — and the --print-budget multiset derived from
+        # it — keeps every scan instead of silently overwriting
+        if label in carries:
+            k = 2
+            while f"{label}#{k}" in carries:
+                k += 1
+            label = f"{label}#{k}"
         carries[label] = [f"{a.dtype}{list(a.shape)}" for a in avals]
         for a in avals:
             multiset[str(a.dtype)] += 1
@@ -312,20 +347,31 @@ def _trace(built: Built):
 
 
 def _trace_and_lower(
-    built: Built, *, lower: bool
-) -> tuple[Any, str | None, list[str]]:
-    """One trace serves both halves: the AOT ``.trace`` yields the
-    closed jaxpr AND (optionally) the StableHLO lowering, so an entry
-    point is traced exactly once per audit and the disallow transfer
-    guard covers the whole trace→lower span.  Returns ``(closed_jaxpr,
-    lowered_text | None, warning messages)`` — donation-dropped
-    warnings surface at lowering."""
+    built: Built, *, lower: bool, compile_hlo: bool = False
+) -> tuple[Any, str | None, list[str], Any]:
+    """One trace serves every layer: the AOT ``.trace`` yields the
+    closed jaxpr AND (optionally) the StableHLO lowering AND
+    (optionally) the compiled executable — the partitioning contracts
+    need the post-SPMD optimized HLO and the memory analysis, which
+    only exist after ``.compile()``.  The entry point is traced exactly
+    once per audit; the disallow transfer guard covers the whole
+    trace→lower span, and the entry's ``trace_context`` (e.g. the mesh
+    path's SPMD-safe recv-merge form) wraps all of it.  Returns
+    ``(closed_jaxpr, lowered_text | None, warning messages,
+    compiled | None)`` — donation-dropped warnings surface at
+    lowering."""
+    ctx = (built.trace_context() if built.trace_context is not None
+           else contextlib.nullcontext())
     with _warnings.catch_warnings(record=True) as caught:
         _warnings.simplefilter("always")
-        with jax.transfer_guard("disallow"):
-            traced = built.jitted.trace(*built.args, **built.statics)
-            text = traced.lower().as_text() if lower else None
-    return traced.jaxpr, text, [str(w.message) for w in caught]
+        with ctx:
+            with jax.transfer_guard("disallow"):
+                traced = built.jitted.trace(*built.args, **built.statics)
+                lowered = (traced.lower() if (lower or compile_hlo)
+                           else None)
+                text = lowered.as_text() if lower else None
+            compiled = lowered.compile() if compile_hlo else None
+    return (traced.jaxpr, text, [str(w.message) for w in caught], compiled)
 
 
 def _lower_text(built: Built) -> tuple[str | None, list[str]]:
@@ -349,23 +395,37 @@ def audit_entry(
     replicas: int = 2,
     compile_programs: bool = True,
     census_min_elems: int | None = None,
+    force_compile: bool = False,
     **extra: Any,
 ) -> EntryReport:
     """Run every trace contract against one (entry, backend) at the
     given fixture shape; ``compile_programs=False`` skips the StableHLO
     lowering (donation check degrades to a skip) for big-n census-only
-    runs."""
+    runs.  The program is additionally COMPILED — the partitioning
+    contracts' layer — when it is sharded, when a byte budget is
+    pinned at this (n,), or under ``force_compile`` (the budget-pinning
+    path)."""
     built = build_entry(
         name, backend, n=n, ticks=ticks, capacity=capacity,
         replicas=replicas, **extra,
     )
     findings: list[Finding] = []
-    closed, text, warns = _trace_and_lower(built, lower=compile_programs)
+    compile_hlo = compile_programs and (
+        force_compile
+        or built.mesh_size > 0
+        or budgets.byte_budget(built.name, built.backend, n) is not None
+    )
+    closed, text, warns, compiled = _trace_and_lower(
+        built, lower=compile_programs, compile_hlo=compile_hlo
+    )
+    compiled_text = compiled.as_text() if compiled is not None else None
 
     host_findings, host_hits = check_host_transfers(closed, built.name)
     findings += host_findings
 
-    donation_findings, aliased = check_donation(built, text, warns)
+    donation_findings, aliased = check_donation(
+        built, text, warns, compiled_text
+    )
     findings += donation_findings
 
     carry_findings, carries = check_carry_dtypes(closed, built)
@@ -373,6 +433,26 @@ def audit_entry(
 
     prng_findings, prng = check_key_lineage(closed, built)
     findings += prng_findings
+
+    collectives: list[dict[str, Any]] = []
+    mem: dict[str, Any] | None = None
+    if compiled is not None:
+        from ringpop_tpu.obs.ledger import memory_row
+
+        mem = memory_row(compiled)
+        findings += partitioning.check_byte_budget(
+            built, mem, n=n, ticks=ticks
+        )
+        if built.mesh_size:
+            collectives = partitioning.collective_census(
+                compiled_text, dims=built.dims
+            )
+            findings += partitioning.check_collectives(
+                built, collectives, n=n
+            )
+            findings += partitioning.check_sharding_propagation(
+                built, compiled, closed
+            )
 
     census = temp_census(
         closed,
@@ -391,6 +471,9 @@ def audit_entry(
         carries=carries,
         aliased_outputs=aliased,
         host_prims=host_hits,
+        mesh_size=built.mesh_size,
+        collectives=collectives,
+        mem_bytes=mem,
     )
 
 
@@ -398,11 +481,25 @@ def audit_all(
     names=None, backends=None, **kw: Any
 ) -> tuple[list[EntryReport], list[Finding]]:
     """Audit every registered (entry, backend); returns the reports
-    and the concatenated findings."""
+    and the concatenated findings.  A fixture that cannot build in
+    this environment (a mesh entry on a 1-device host) yields an info
+    finding, not a crash — the audit still fails CLOSED on real
+    violations while degrading visibly on capability gaps."""
     reports = []
     findings: list[Finding] = []
     for name, backend in iter_entries(names, backends):
-        report = audit_entry(name, backend, **kw)
+        try:
+            report = audit_entry(name, backend, **kw)
+        except EntryUnavailable as e:
+            findings.append(
+                Finding(
+                    contract="registry",
+                    severity="info",
+                    entry=name,
+                    message=f"skipped [{backend}]: {e}",
+                )
+            )
+            continue
         reports.append(report)
         findings += report.findings
     return reports, findings

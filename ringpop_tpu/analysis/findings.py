@@ -19,7 +19,8 @@ SEVERITY_RANK = {"info": 0, "warning": 1, "error": 2}
 @dataclasses.dataclass
 class Finding:
     contract: str  # e.g. "host-transfer", "donation", "carry-dtype",
-    #                "prng-lineage", "lint:RPL001"
+    #                "prng-lineage", "collective-census",
+    #                "sharding-propagation", "byte-budget", "lint:RPL001"
     severity: str  # "error" | "warning" | "info"
     entry: str  # entry-point name, or file path for lint findings
     message: str

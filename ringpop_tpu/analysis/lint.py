@@ -18,7 +18,16 @@ silently serialize) the compiled paths:
   ``jnp.where``);
 * **RPL004 wallclock-in-traced** — ``time.time`` / ``perf_counter`` /
   ``datetime.now`` inside a traced context: wall-clock reads bake a
-  constant into the compiled program ("Date-free scan bodies").
+  constant into the compiled program ("Date-free scan bodies");
+* **RPL005 implicit-replication** — in the SHARDING-path modules
+  (``parallel/``, ``scenarios/``): a ``jax.device_put`` with no
+  placement argument, or a ``shard_map`` without explicit
+  ``in_specs``/``out_specs``.  A bare ``device_put`` commits the
+  array replicated (or to device 0) and every later sharded consumer
+  pays a silent reshard; spec-less ``shard_map`` leaves the layout to
+  inference — the partitioning contracts
+  (``analysis/partitioning.py``) can only audit layouts somebody
+  DECLARED.
 
 **Traced contexts** are functions the compiler traces: any function
 named ``*_impl``, any function decorated with ``jax.jit`` (bare or via
@@ -48,6 +57,11 @@ from ringpop_tpu.analysis.findings import Finding
 # sits in.  obs/ and cli/ are host-side by design (the ledger's drain
 # IS its job) and are not scanned by default.
 COMPILED_PATH_DIRS = ("models", "scenarios", "traffic", "ops", "parallel")
+
+# Modules that place arrays onto meshes: the implicit-replication rule
+# (RPL005) applies here — everywhere else bare device_put is host code
+# moving a result around, not a layout decision.
+SHARDING_PATH_DIRS = ("parallel", "scenarios")
 
 _ALLOW_RE = re.compile(r"#\s*audit:\s*allow(?:=(?P<codes>[\w,]+))?")
 
@@ -94,10 +108,12 @@ def _dotted(node: ast.expr) -> str | None:
 
 
 class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, source: str, compiled_path: bool):
+    def __init__(self, path: str, source: str, compiled_path: bool,
+                 sharding_path: bool = False):
         self.path = path
         self.lines = source.splitlines()
         self.compiled_path = compiled_path
+        self.sharding_path = sharding_path
         self.findings: list[Finding] = []
         self.stack: list[_Ctx] = []
 
@@ -154,6 +170,35 @@ class _Linter(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
+        if self.sharding_path and name:
+            tail = name.split(".")[-1]
+            if tail == "device_put":
+                kwargs = {kw.arg for kw in node.keywords}
+                if len(node.args) < 2 and not kwargs & {"device", "sharding"}:
+                    self._emit(
+                        node, "RPL005",
+                        "device_put without a placement argument in a "
+                        "sharding-path module: the array commits "
+                        "replicated/device-0 and sharded consumers pay "
+                        "a silent reshard — pass a NamedSharding, or "
+                        "mark '# audit: allow=RPL005'",
+                    )
+            elif tail == "shard_map":
+                # shard_map(f, mesh, in_specs, out_specs): either spec
+                # may arrive positionally or by keyword — mixed calls
+                # are fully explicit too
+                kwargs = {kw.arg for kw in node.keywords}
+                has_in = "in_specs" in kwargs or len(node.args) >= 3
+                has_out = "out_specs" in kwargs or len(node.args) >= 4
+                if not (has_in and has_out):
+                    self._emit(
+                        node, "RPL005",
+                        "shard_map without explicit in_specs/out_specs "
+                        "in a sharding-path module: inferred layouts "
+                        "are exactly what the partitioning auditor "
+                        "cannot hold to a declared contract — spell "
+                        "the specs out, or mark '# audit: allow=RPL005'",
+                    )
         if isinstance(node.func, ast.Attribute):
             if node.func.attr == "block_until_ready" and self.compiled_path:
                 self._emit(
@@ -228,11 +273,13 @@ class _Linter(ast.NodeVisitor):
 
 
 def lint_source(source: str, path: str = "<string>",
-                compiled_path: bool = True) -> list[Finding]:
+                compiled_path: bool = True,
+                sharding_path: bool = False) -> list[Finding]:
     """Lint one module's source text; ``compiled_path`` enables the
-    module-wide RPL001 host-sync rule."""
+    module-wide RPL001 host-sync rule, ``sharding_path`` the RPL005
+    implicit-replication rule."""
     tree = ast.parse(source, filename=path)
-    linter = _Linter(path, source, compiled_path)
+    linter = _Linter(path, source, compiled_path, sharding_path)
     linter.visit(tree)
     return linter.findings
 
@@ -251,6 +298,7 @@ def lint_paths(root: str | Path,
             findings += lint_source(
                 p.read_text(), str(p.relative_to(root.parent)),
                 compiled_path=True,
+                sharding_path=d in SHARDING_PATH_DIRS,
             )
     for p in sorted(root.glob("*.py")):
         if p not in seen:

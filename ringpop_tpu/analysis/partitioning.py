@@ -1,0 +1,442 @@
+"""The partitioning contracts: collectives, sharding survival, bytes.
+
+PR 13's five contracts audit what the TRACER emits; these three audit
+what the PARTITIONER and the compiler emit — the layer where "SPMD"
+programs silently degenerate.  XLA will happily lower a sharded gossip
+step that all-gathers every [N, N] plane back to every chip, and a
+widened carry that blows the n=65,536 footprint is invisible until the
+TPU worker dies on first dispatch.  All three checks run on a CPU host
+against virtual devices (``--xla_force_host_platform_device_count``),
+so the contract gates in CI before any chip sees the program.
+
+1. **collective-census** (``collective_census`` + ``check_collectives``)
+   — walk the post-SPMD optimized HLO for ``all-gather`` /
+   ``all-reduce`` / ``collective-permute`` / ``all-to-all`` /
+   ``reduce-scatter`` / DMA-flavored ``custom-call`` ops; attribute op
+   count and bytes-moved per collective, mapped to protocol phases via
+   the PR 5 ``jax.named_scope`` annotations that survive into HLO
+   ``op_name`` metadata.  Every all-gather whose output rebuilds a
+   full member-axis tensor is a **member-gather**: replication where
+   gossip should be point-to-point.  The census diffs against the
+   pinned per-(entry, backend, mesh) budget
+   (``budgets.COLLECTIVE_BUDGETS``), and entries that declare
+   ``p2p_only`` (the contract ROADMAP item 1's remote-copy gossip
+   builder must assert) fail on ANY member-gather.
+
+2. **sharding-propagation** (``check_sharding_propagation``) — the
+   declared input ``NamedSharding``s must SURVIVE propagation to the
+   outputs without an explicit out-shardings crutch: any output leaf
+   still carrying the member axis that comes back fully replicated
+   (or partitioned on a different axis) is flagged with its shape,
+   dtype and flat position.  The registry audits the UNCONSTRAINED
+   lowering (``mesh.sharded_step_jit(constrain_outputs=False)``): if
+   row sharding only survives because an output constraint re-shards
+   it, a hidden gather/slice pair pays for every step.
+
+3. **byte-budget** (``check_byte_budget``) — XLA ``memory_analysis``
+   footprints (argument / output / temp / peak bytes, the
+   ``obs.ledger.memory_row`` field set) compared against pinned
+   per-(entry, backend, n) rows with a tolerance band
+   (``budgets.BYTE_BUDGETS``): over-band is a regression gate for
+   ROADMAP item 2's "drive compiled bytes DOWN", under-band is a
+   prompt to re-pin and lock the reduction in.
+
+Budget comparisons are partitioner/compiler output, so they assume the
+pinned jax build (``ringpop_tpu.utils.jaxpin``); under a different
+version they downgrade to one warning instead of bit-diffing a
+different compiler's decisions.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+from typing import Any
+
+import jax
+
+from ringpop_tpu.analysis import budgets
+from ringpop_tpu.analysis.findings import Finding
+from ringpop_tpu.utils.jaxpin import PINNED_JAX_VERSION, jax_version_matches
+
+# The cross-chip data movers in optimized (post-SPMD) HLO.
+COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# One HLO instruction line: "%name = <result type(s)> <op>(...)", with
+# the result possibly a tuple for variadic collectives.
+_COLL_LINE_RE = re.compile(
+    r"=\s+(?P<rtype>\(?[a-z0-9]+\[[0-9,]*\][^=]*?)\s+"
+    r"(?P<op>" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
+)
+_TYPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+# DMA-flavored custom calls (Pallas/Mosaic remote copies arrive as
+# tpu_custom_call; explicit DMA targets name themselves) — the op
+# family ROADMAP item 1's ring gossip is supposed to lower to.
+_DMA_CALL_RE = re.compile(r'custom_call_target="(?P<tgt>[^"]*)"')
+_DMA_TARGETS = ("tpu_custom_call", "dma", "SendDone", "RecvDone")
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# A named_scope path component: "swim.recv_merge", "delta.route_claims",
+# "traffic.serve" — lowercase dotted, no parens (jit(...)/transpose(...)
+# wrappers and primitive names never match).
+_SCOPE_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z0-9_.]+$")
+
+
+def _phase(op_name: str) -> str:
+    """The innermost protocol-phase scope on one HLO op's metadata
+    path, or 'unscoped' — the PR 5 annotations survive lowering as
+    op_name components."""
+    scopes = [p for p in op_name.split("/") if _SCOPE_RE.match(p)]
+    return scopes[-1] if scopes else "unscoped"
+
+
+def _result_components(rtype: str) -> list[tuple[str, tuple[int, ...]]]:
+    """(dtype, shape) per component of an HLO result type string
+    (tuple results of variadic collectives yield several)."""
+    out = []
+    for dt, dims in _TYPE_RE.findall(rtype):
+        shape = tuple(int(d) for d in dims.split(",") if d) if dims else ()
+        out.append((dt, shape))
+    return out
+
+
+def collective_census(
+    hlo_text: str, *, dims: dict[str, int], member_dim: str = "N"
+) -> list[dict[str, Any]]:
+    """Census rows over one optimized-HLO module's collectives, grouped
+    by (op, dtype, shape, phase): count, bytes-moved-each (full output
+    footprint — the replication cost an all-gather pays per chip), the
+    named-dim tag, and whether the op rebuilds a member-axis tensor
+    (``member`` — an [N, *]-class output on all-gather)."""
+    n = dims.get(member_dim, 0)
+    grouped: dict[tuple, dict[str, Any]] = {}
+    for line in hlo_text.splitlines():
+        m = _COLL_LINE_RE.search(line)
+        if m is None:
+            dm = _DMA_CALL_RE.search(line)
+            if dm is None or not any(
+                t in dm.group("tgt") for t in _DMA_TARGETS
+            ):
+                continue
+            # scope the result type to the text between "=" and the op:
+            # XLA's default instruction naming puts the opcode in the
+            # NAME too ("%custom-call.7 = s32[...] custom-call(...)"),
+            # and the tail of the line holds operand types and metadata
+            kind = "custom-call:" + dm.group("tgt")
+            after = line.split(" = ", 1)
+            rtype = (after[1] if len(after) == 2 else line).split(
+                "custom-call", 1
+            )[0]
+        else:
+            kind, rtype = m.group("op"), m.group("rtype")
+        comps = _result_components(rtype)
+        if not comps:
+            continue
+        dtype, shape = comps[0]
+        bytes_each = sum(
+            math.prod(s) * _HLO_DTYPE_BYTES.get(d, 4) for d, s in comps
+        )
+        om = _OPNAME_RE.search(line)
+        phase = _phase(om.group(1)) if om else "unscoped"
+        # [N, *]-class only: rebuilding a member ROW TENSOR is the
+        # replication the contract bans; [N] vectors are the O(N)
+        # replicated-by-design plumbing (mesh.py's layout doc)
+        member = (
+            kind == "all-gather" and n > 1 and len(shape) >= 2
+            and any(d == n for d in shape)
+        )
+        key = (kind, dtype, shape, phase, member)
+        row = grouped.get(key)
+        if row is None:
+            grouped[key] = row = {
+                "op": kind,
+                "dtype": dtype,
+                "shape": list(shape),
+                "tag": "x".join(_tag(d, dims) for d in shape) or "scalar",
+                "phase": phase,
+                "member": member,
+                "count": 0,
+                "bytes_each": bytes_each,
+            }
+        row["count"] += 1
+    rows = sorted(
+        grouped.values(),
+        key=lambda r: (-r["member"], -r["bytes_each"] * r["count"], r["op"]),
+    )
+    return rows
+
+
+def _tag(d: int, dims: dict[str, int]) -> str:
+    matches = [name for name, val in dims.items() if d == val]
+    return "|".join(matches) if matches else str(d)
+
+
+def collective_counts(rows: list[dict[str, Any]]) -> dict[str, int]:
+    """The budget-table multiset for a census: per-op-kind instruction
+    counts plus the headline ``member-gather`` count."""
+    counts: Counter = Counter()
+    for r in rows:
+        counts[r["op"]] += r["count"]
+        if r["member"]:
+            counts["member-gather"] += r["count"]
+    return dict(sorted(counts.items()))
+
+
+def _version_guard(entry: str, what: str) -> list[Finding]:
+    if jax_version_matches():
+        return []
+    return [
+        Finding(
+            contract=what,
+            severity="warning",
+            entry=entry,
+            message=(
+                f"jax {jax.__version__} != pinned {PINNED_JAX_VERSION}: "
+                f"the pinned {what} budget reflects the pinned "
+                "partitioner/compiler — comparison skipped; re-pin via "
+                "tools/pin_budgets.py on an intentional bump"
+            ),
+        )
+    ]
+
+
+def check_collectives(
+    built, rows: list[dict[str, Any]], *, n: int
+) -> list[Finding]:
+    """Contract 6 (collective-census): p2p-only entries admit no
+    member-gather; every sharded entry's collective counts match the
+    pinned per-(entry, backend, mesh) budget at the pinned shape."""
+    findings: list[Finding] = []
+    member_rows = [r for r in rows if r["member"]]
+    if built.p2p_only:
+        for r in member_rows:
+            findings.append(
+                Finding(
+                    contract="collective-census",
+                    severity="error",
+                    entry=built.name,
+                    message=(
+                        f"member-tensor all-gather in a point-to-point "
+                        f"gossip path: {r['dtype']}{r['shape']} "
+                        f"[{r['tag']}] x{r['count']} in phase "
+                        f"'{r['phase']}' ({r['bytes_each']} bytes each) "
+                        "— inter-shard traffic must be remote-copy / "
+                        "permute, not replication"
+                    ),
+                    where=r["phase"],
+                )
+            )
+    pinned = budgets.collective_budget(built.name, built.backend,
+                                       built.mesh_size)
+    actual = collective_counts(rows)
+    if pinned is None:
+        findings.append(
+            Finding(
+                contract="collective-census",
+                severity="warning",
+                entry=built.name,
+                message=(
+                    f"no pinned collective budget for ({built.name}, "
+                    f"{built.backend}, mesh {built.mesh_size}); actual at "
+                    f"n={n}: {budgets.format_multiset(actual)} — pin it "
+                    "in analysis/budgets.py (tools/pin_budgets.py)"
+                ),
+            )
+        )
+        return findings
+    if pinned.get("n") != n:
+        findings.append(
+            Finding(
+                contract="collective-census",
+                severity="info",
+                entry=built.name,
+                message=(
+                    f"collective budget pinned at n={pinned.get('n')}, "
+                    f"audited at n={n}: partitioner decisions are "
+                    "shape-dependent, counts not compared"
+                ),
+            )
+        )
+        return findings
+    guard = _version_guard(built.name, "collective-census")
+    if guard:
+        return findings + guard
+    if Counter(pinned["counts"]) != Counter(actual):
+        findings.append(
+            Finding(
+                contract="collective-census",
+                severity="error",
+                entry=built.name,
+                message=(
+                    "collective budget drift at mesh "
+                    f"{built.mesh_size}, n={n}: pinned "
+                    f"{budgets.format_multiset(pinned['counts'])} but the "
+                    f"partitioned HLO holds "
+                    f"{budgets.format_multiset(actual)} — a new "
+                    "collective (or a lost one) must be justified and "
+                    "re-pinned in analysis/budgets.py"
+                ),
+            )
+        )
+    return findings
+
+
+def check_sharding_propagation(built, compiled, closed) -> list[Finding]:
+    """Contract 7 (sharding-propagation): every output leaf still
+    carrying the member axis must come out of UNCONSTRAINED propagation
+    partitioned over the declared mesh axis — an implicitly replicated
+    (or re-axised) member tensor means XLA gave up on the declared
+    layout and every step pays the resharding."""
+    findings: list[Finding] = []
+    n = built.dims.get("N", 0)
+    try:
+        out_sh = jax.tree_util.tree_leaves(compiled.output_shardings)
+    except Exception as e:  # noqa: BLE001 — backends without the API
+        return [
+            Finding(
+                contract="sharding-propagation",
+                severity="warning",
+                entry=built.name,
+                message=f"compiled output shardings unavailable: {e}",
+            )
+        ]
+    outvars = closed.jaxpr.outvars
+    if len(out_sh) != len(outvars):
+        return [
+            Finding(
+                contract="sharding-propagation",
+                severity="warning",
+                entry=built.name,
+                message=(
+                    f"output sharding leaves ({len(out_sh)}) do not align "
+                    f"with jaxpr outputs ({len(outvars)}); propagation "
+                    "not checked"
+                ),
+            )
+        ]
+    for i, (var, sh) in enumerate(zip(outvars, out_sh)):
+        aval = getattr(var, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            continue
+        shape = tuple(int(d) for d in aval.shape)
+        if len(shape) < 2 or n <= 1 or n not in shape:
+            # scalar telemetry, no member axis, or a rank-1 [N] vector
+            # (O(N) replicated-by-design plumbing — same class the
+            # census's member-gather rule exempts): replication is fine
+            continue
+        if getattr(sh, "is_fully_replicated", False):
+            findings.append(
+                Finding(
+                    contract="sharding-propagation",
+                    severity="error",
+                    entry=built.name,
+                    message=(
+                        f"member-axis output leaf #{i} "
+                        f"{aval.dtype}{list(shape)} came back FULLY "
+                        f"REPLICATED from propagation — the declared "
+                        f"'{built.mesh_axis}' sharding did not survive "
+                        "lowering (XLA inserted an all-gather and kept "
+                        "the result everywhere)"
+                    ),
+                    where=f"output[{i}]",
+                )
+            )
+            continue
+        spec = getattr(sh, "spec", None)
+        if spec is not None and built.mesh_axis:
+            dim0 = spec[0] if len(spec) else None
+            axes = dim0 if isinstance(dim0, tuple) else (dim0,)
+            if built.mesh_axis not in axes:
+                findings.append(
+                    Finding(
+                        contract="sharding-propagation",
+                        severity="error",
+                        entry=built.name,
+                        message=(
+                            f"member-axis output leaf #{i} "
+                            f"{aval.dtype}{list(shape)} was RESHARDED: "
+                            f"declared leading-axis '{built.mesh_axis}' "
+                            f"partitioning, propagation produced "
+                            f"{spec} — the layout changed under the "
+                            "program"
+                        ),
+                        where=f"output[{i}]",
+                    )
+                )
+    return findings
+
+
+def check_byte_budget(
+    built, mem: dict[str, int], *, n: int, ticks: int
+) -> list[Finding]:
+    """Contract 8 (byte-budget): the compiled footprint against the
+    pinned per-(entry, backend, n) row, within ``BYTE_TOLERANCE``."""
+    pinned = budgets.byte_budget(built.name, built.backend, n)
+    if pinned is None:
+        return []  # bytes are pinned at flagship shapes only
+    if pinned.get("ticks") != ticks:
+        return [
+            Finding(
+                contract="byte-budget",
+                severity="info",
+                entry=built.name,
+                message=(
+                    f"byte budget for n={n} pinned at ticks="
+                    f"{pinned.get('ticks')}, audited at ticks={ticks}: "
+                    "output bytes scale with the horizon, not compared"
+                ),
+            )
+        ]
+    guard = _version_guard(built.name, "byte-budget")
+    if guard:
+        return guard
+    findings: list[Finding] = []
+    tol = budgets.BYTE_TOLERANCE
+    for field, want in pinned.items():
+        if field == "ticks":
+            continue
+        have = int(mem.get(field, 0))
+        if have > want * (1 + tol):
+            findings.append(
+                Finding(
+                    contract="byte-budget",
+                    severity="error",
+                    entry=built.name,
+                    message=(
+                        f"compiled {field} at n={n} grew past the pinned "
+                        f"budget: {have:,} > {want:,} (+{tol:.0%} band) — "
+                        "the footprint regressed; shrink it or justify "
+                        "and re-pin (tools/pin_budgets.py)"
+                    ),
+                )
+            )
+        elif have < want * (1 - tol):
+            findings.append(
+                Finding(
+                    contract="byte-budget",
+                    severity="info",
+                    entry=built.name,
+                    message=(
+                        f"compiled {field} at n={n} dropped below the "
+                        f"pinned band: {have:,} < {want:,} (-{tol:.0%}) — "
+                        "re-pin to lock the reduction in as the new "
+                        "ceiling"
+                    ),
+                )
+            )
+    return findings

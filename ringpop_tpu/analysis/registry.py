@@ -33,6 +33,21 @@ class Built(NamedTuple):
     min_aliased: int  # pinned floor of tf.aliasing_output params
     census_min_elems: int  # census threshold (>= [N, C]-class)
     dims: dict[str, int]  # named dims for shape tagging (N, C, ...)
+    # --- partitioning contracts (sharded entries only) ---
+    mesh_size: int = 0  # devices in the entry's mesh (0 = unsharded)
+    mesh_axis: str = ""  # the mesh axis member tensors shard over
+    p2p_only: bool = False  # forbid ANY member-tensor all-gather (the
+    #   contract item 1's remote-copy gossip builder must declare)
+    trace_context: Any = None  # zero-arg ctx-manager factory wrapped
+    #   around trace/lower (e.g. forcing the SPMD-safe recv-merge form)
+
+
+class EntryUnavailable(RuntimeError):
+    """The fixture cannot build in this environment — e.g. a sharded
+    entry needs more local devices than the host exposes.  The audit
+    records an info finding and moves on (set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=D`` to audit
+    mesh entries on any CPU host)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -357,6 +372,108 @@ def _build_recv_merge(backend: str, *, n: int, **_ignored) -> Built:
     )
 
 
+def _require_devices(mesh: int, entry: str) -> None:
+    import jax
+
+    have = len(jax.devices())
+    if have < mesh:
+        raise EntryUnavailable(
+            f"{entry} needs a {mesh}-device mesh but only {have} local "
+            f"device(s) exist — run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={mesh} (CPU virtual "
+            "devices; the CI audit job does)"
+        )
+
+
+def _build_sharded_step(backend: str, *, n: int, mesh: int = 2,
+                        **_ignored: Any) -> Built:
+    """The viewer-row sharded dense step (parallel/mesh.py) at a fixed
+    mesh size, lowered UNCONSTRAINED (no out_shardings) so the
+    sharding-propagation contract checks what XLA actually decides.
+    The partitioned HLO of this program is the collective census's
+    subject: today it is all-gather-shaped (the pinned budget documents
+    exactly how much), and ROADMAP item 1's remote-copy rebuild must
+    drive the member-gather row to zero and flip ``p2p_only``."""
+    import jax
+
+    from ringpop_tpu.models import swim_sim as sim
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    _require_devices(mesh, f"sharded_step (mesh {mesh})")
+    if n % mesh:
+        raise EntryUnavailable(
+            f"sharded_step needs n divisible by the mesh ({n} % {mesh})"
+        )
+    m = pmesh.make_mesh(mesh)
+    state, net, params = _dense_fixture(n)
+    state, net = pmesh.shard_cluster(state, net, m)
+    key = jax.random.PRNGKey(0)
+    jitted = pmesh.sharded_step_jit(m, constrain_outputs=False)
+    # params rides positionally: a pjit with in_shardings rejects
+    # kwargs outright (static_argnames still applies by signature).
+    # It trails the key, so the PRNG root's flat index is unaffected.
+    args = (state, net, key, params)
+    return Built(
+        name="sharded_step" if mesh == 2 else f"sharded_step@{mesh}",
+        backend=backend,
+        jitted=jitted,
+        args=args,
+        statics={},
+        key_roots={"protocol": tree_flat_index_of(args, key)},
+        donates=True,
+        min_aliased=1,
+        census_min_elems=n * n,
+        dims=dict(N=n),
+        mesh_size=mesh,
+        mesh_axis=pmesh.AXIS,
+        p2p_only=False,  # the gossip path is all-gather-shaped TODAY;
+        #   the pinned collective budget holds the line until item 1
+        trace_context=pmesh._mesh_recv_merge,
+    )
+
+
+def _build_sharded_sweep(backend: str, *, n: int, ticks: int,
+                         capacity: int, replicas: int, mesh: int = 2,
+                         **_ignored: Any) -> Built:
+    """``run_sweep(shard=True)``'s program: the vmapped scenario scan
+    with every replica-batched arg device_put onto a replica-axis mesh
+    (scenarios/sweep.py `_replica_sharding`, here at a fixed mesh size
+    so the budget rows are host-independent).  Replicas are
+    data-parallel by construction, so the ONLY sanctioned collectives
+    are the scalar-telemetry all-reduces: a member-gather here means
+    the replica axis broke."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    _require_devices(mesh, f"run_sweep+shard (mesh {mesh})")
+    if replicas % mesh:
+        raise EntryUnavailable(
+            f"run_sweep+shard needs replicas divisible by the mesh "
+            f"({replicas} % {mesh})"
+        )
+    base = _build_sweep(backend, n=n, ticks=ticks, capacity=capacity,
+                        replicas=replicas)
+    rmesh = Mesh(np.asarray(jax.devices()[:mesh]), ("replicas",))
+    rsh = NamedSharding(rmesh, PartitionSpec("replicas"))
+    args = tuple(
+        jax.tree_util.tree_map(lambda a: jax.device_put(a, rsh), arg)
+        if i in (0, 1, 2, 3, 11) else arg
+        for i, arg in enumerate(base.args)
+    )
+    keys = args[11]
+    return base._replace(
+        name="run_sweep+shard",
+        args=args,
+        key_roots={"protocol": tree_flat_index_of(args, keys)},
+        mesh_size=mesh,
+        mesh_axis="replicas",
+        # data-parallel: any member-tensor all-gather is a bug, not a
+        # lowering strategy — the strictest contract holds already
+        p2p_only=True,
+    )
+
+
 ENTRY_POINTS: dict[str, EntrySpec] = {
     "swim_run": EntrySpec(
         "swim_run", ("dense",), _build_run,
@@ -386,6 +503,20 @@ ENTRY_POINTS: dict[str, EntrySpec] = {
         "recv_merge_pallas", ("dense",), _build_recv_merge,
         "the Pallas receiver-merge kernel wrapper "
         "(ops/recv_merge_pallas.py, interpret lowering)"),
+    "sharded_step": EntrySpec(
+        "sharded_step", ("dense",),
+        lambda backend, **kw: _build_sharded_step(backend, mesh=2, **kw),
+        "the viewer-row sharded dense step on a 2-device mesh "
+        "(parallel/mesh.py; partitioning contracts)"),
+    "sharded_step@4": EntrySpec(
+        "sharded_step@4", ("dense",),
+        lambda backend, **kw: _build_sharded_step(backend, mesh=4, **kw),
+        "the viewer-row sharded dense step on a 4-device mesh"),
+    "run_sweep+shard": EntrySpec(
+        "run_sweep+shard", ("dense", "delta"),
+        lambda backend, **kw: _build_sharded_sweep(backend, mesh=2, **kw),
+        "run_sweep(shard=True): the replica-axis-sharded sweep scan on "
+        "a 2-device mesh (scenarios/sweep.py)"),
 }
 
 def build_entry(name: str, backend: str, *, n: int = 64, ticks: int = 4,
@@ -397,7 +528,7 @@ def build_entry(name: str, backend: str, *, n: int = 64, ticks: int = 4,
         raise ValueError(f"{name} has no {backend} backend "
                          f"(has {spec.backends})")
     kw: dict[str, Any] = dict(n=n, ticks=ticks, capacity=capacity, **extra)
-    if name == "run_sweep":
+    if name.startswith("run_sweep"):
         kw["replicas"] = replicas
     return spec.build(backend, **kw)
 

@@ -125,6 +125,39 @@ def shard_cluster(
     )
 
 
+def sharded_step_jit(
+    mesh: Mesh,
+    damping: bool = False,
+    net_like: NetState | None = None,
+    *,
+    constrain_outputs: bool = True,
+) -> Callable:
+    """The raw jitted sharded step — the program ``sharded_step`` wraps
+    and the partitioning-contract auditor lowers (analysis/registry.py).
+
+    ``constrain_outputs=False`` drops the explicit ``out_shardings`` so
+    XLA's sharding propagation decides the output layout on its own:
+    the production wrapper keeps the constraint (a misplaced output is
+    a bug the constraint fixes for free), while the auditor checks the
+    UNCONSTRAINED propagation — if the row sharding only survives
+    because the constraint re-shards it back, a hidden all-gather +
+    dynamic-slice pair is paying for every step."""
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        swim_step_impl,
+        static_argnames=("params",),
+        in_shardings=(
+            state_sharding(mesh, damping),
+            net_sharding(mesh, like=net_like),
+            rep,
+        ),
+        out_shardings=(
+            (state_sharding(mesh, damping), rep) if constrain_outputs else None
+        ),
+        donate_argnums=(0,),
+    )
+
+
 def sharded_step(
     mesh: Mesh,
     damping: bool = False,
@@ -139,18 +172,7 @@ def sharded_step(
     deep inside jit with an opaque pytree-structure error)."""
     if like is not None:
         damping = like.damp is not None
-    rep = NamedSharding(mesh, P())
-    jitted = jax.jit(
-        swim_step_impl,
-        static_argnames=("params",),
-        in_shardings=(
-            state_sharding(mesh, damping),
-            net_sharding(mesh, like=net_like),
-            rep,
-        ),
-        out_shardings=(state_sharding(mesh, damping), rep),
-        donate_argnums=(0,),
-    )
+    jitted = sharded_step_jit(mesh, damping, net_like)
 
     expect_adj = _adj_layout(net_like)
 

@@ -1,23 +1,33 @@
 """Cross-cutting utilities (reference: lib/util.js, lib/nulls.js)."""
 
 from ringpop_tpu.utils.events import EventEmitter
+from ringpop_tpu.utils.jaxpin import (
+    PINNED_JAX_VERSION,
+    golden_skip_reason,
+    jax_version_matches,
+)
 from ringpop_tpu.utils.misc import (
     capture_host,
     num_or_default,
     parse_arg,
     enable_compilation_cache,
     pin_cpu_if_requested,
+    provision_virtual_devices,
     safe_parse,
 )
 from ringpop_tpu.utils.nulls import NullLogger, NullStatsd
 
 __all__ = [
     "EventEmitter",
+    "PINNED_JAX_VERSION",
+    "golden_skip_reason",
+    "jax_version_matches",
     "capture_host",
     "num_or_default",
     "parse_arg",
     "enable_compilation_cache",
     "pin_cpu_if_requested",
+    "provision_virtual_devices",
     "safe_parse",
     "NullLogger",
     "NullStatsd",

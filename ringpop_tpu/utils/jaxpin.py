@@ -1,0 +1,51 @@
+"""The pinned jax version every bit-exact lane assumes.
+
+The repo's golden lanes are reproducible only under one jax build:
+the incident goldens and the seeded golden traces replay CPU threefry
+draws bit-for-bit (PR 14 note), and the analysis budget tables —
+carry-dtype multisets, collective censuses of the partitioned HLO,
+compiled byte footprints — pin what ONE version of the tracer and the
+SPMD partitioner emits.  A jax bump does not make any of them wrong,
+it makes them STALE: the right response is "re-pin", not a wall of
+bit-diff failures.
+
+``tests/test_jax_pin.py`` asserts the pin itself (one loud, fast
+failure naming everything to re-pin); the golden-lane tests call
+``golden_skip_reason()`` and SKIP with the re-pin instruction instead
+of exploding one assert at a time; the partitioning auditor downgrades
+its budget comparisons to a warning on mismatch
+(``analysis/partitioning.py``).
+
+On an intentional bump: update ``PINNED_JAX_VERSION``, then re-pin
+goldens (``tools/pin_incidents.py``) and budgets
+(``tools/pin_budgets.py``).
+"""
+
+from __future__ import annotations
+
+PINNED_JAX_VERSION = "0.4.37"
+
+
+def jax_version() -> str:
+    import jax
+
+    return jax.__version__
+
+
+def jax_version_matches() -> bool:
+    """True when the running jax is the pinned build."""
+    return jax_version() == PINNED_JAX_VERSION
+
+
+def golden_skip_reason() -> str | None:
+    """None under the pinned jax; otherwise the skip message the
+    golden-lane tests surface (explicit re-pin instruction, not a
+    bit-diff explosion)."""
+    if jax_version_matches():
+        return None
+    return (
+        f"jax {jax_version()} != pinned {PINNED_JAX_VERSION}: PRNG- and "
+        "partitioner-dependent goldens are stale, not wrong — re-pin "
+        "(tools/pin_incidents.py, tools/pin_budgets.py) and bump "
+        "ringpop_tpu/utils/jaxpin.py before trusting bit-exact lanes"
+    )

@@ -137,3 +137,23 @@ def _host_cpu_tag() -> str:
         pass
     raw = f"{platform.machine()}|{model}"
     return f"host-{hashlib.sha1(raw.encode()).hexdigest()[:12]}"
+
+
+def provision_virtual_devices(count: int = 4) -> None:
+    """Ask XLA for ``count`` virtual CPU devices, if nobody asked yet.
+
+    Appends ``--xla_force_host_platform_device_count=count`` to
+    ``XLA_FLAGS`` unless the flag is already present (an operator's or
+    conftest's explicit choice always wins).  Must run before the CPU
+    backend initializes — XLA reads the flags once; afterwards the call
+    is a harmless no-op and multi-device callers (the partitioning
+    auditor's mesh entries) degrade with their own capability message.
+    The flag only shapes the CPU platform, so setting it under a real
+    accelerator is safe."""
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(count)}"
+        ).strip()

@@ -6,23 +6,29 @@ Two lanes:
   SPECIFIC violation is reported: a host-sync scan (contract 1), a
   dropped donation (2), an f64 carry and a budget drift (3), a shared
   key lineage and a key drawn twice (4), an [N, N] temporary landing
-  in the census (5);
+  in the census (5), an all-gathering "sharded gossip" program (6), a
+  dropped output sharding (7), an over-budget widened carry tripping
+  the byte contract (8);
 * the clean lane: a well-formed program yields ZERO findings, and the
-  real registry entry points audit clean (the fast representative here
-  is ``swim_run``; the full registry runs in the CI audit job and the
-  slow lane).
+  real registry entry points audit clean (the fast representatives are
+  ``swim_run`` and the mesh-2 ``sharded_step``; the full registry —
+  including the n=4096 / n=65,536 byte pins — runs in the CI audit job
+  and the slow lane).
 """
 
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ringpop_tpu.analysis import budgets, lint_source
+from ringpop_tpu.analysis import budgets, lint_source, partitioning
 from ringpop_tpu.analysis.contracts import (
     EntryReport,
     _lower_text,
+    _trace_and_lower,
     audit_entry,
     check_carry_dtypes,
     check_donation,
@@ -39,12 +45,13 @@ from ringpop_tpu.obs.ledger import DispatchLedger
 
 
 def _fixture_built(jitted, args, statics=None, *, donates=False,
-                   min_aliased=0, key_roots=None, name="fixture"):
+                   min_aliased=0, key_roots=None, name="fixture",
+                   dims=None, **extra):
     return Built(
         name=name, backend="dense", jitted=jitted, args=args,
         statics=statics or {}, key_roots=key_roots or {},
         donates=donates, min_aliased=min_aliased,
-        census_min_elems=1 << 30, dims={},
+        census_min_elems=1 << 30, dims=dims or {}, **extra,
     )
 
 
@@ -415,21 +422,73 @@ def test_registry_builders_cover_declared_backends():
     assert ("run_scenario", "delta") in pairs
     assert ("run_scenario+traffic", "dense") in pairs
     assert ("run_scenario+incident", "delta") in pairs
+    assert ("sharded_step", "dense") in pairs
+    assert ("sharded_step@4", "dense") in pairs
+    assert ("run_sweep+shard", "delta") in pairs
     built = build_entry("run_scenario", "dense", n=8, ticks=2)
     assert built.key_roots["protocol"]
     assert built.donates
+    assert built.mesh_size == 0
+    sharded = build_entry("sharded_step", "dense", n=8)
+    assert sharded.mesh_size == 2 and sharded.mesh_axis == "nodes"
+    # the data-parallel sweep declares the strict point-to-point
+    # contract; the gossip step cannot yet (ROADMAP item 1)
+    assert build_entry("run_sweep+shard", "dense", n=8, ticks=2).p2p_only
+    assert not sharded.p2p_only
 
 
 @pytest.mark.slow
 def test_full_registry_audits_clean():
-    # the whole registry, both backends (the CI audit job's assertion,
-    # kept out of the tier-1 wall)
+    # the whole registry, both backends, at the PINNED fixture shape
+    # (the CI audit job's assertion, kept out of the tier-1 wall):
+    # n=64 is where the collective budgets compare for real
     from ringpop_tpu.analysis.contracts import audit_all
 
-    reports, findings = audit_all(n=32, ticks=3)
-    assert len(reports) == 11  # + the (run_scenario+incident, *) pair
+    reports, findings = audit_all(n=64, ticks=4)
+    assert len(reports) == 15  # 11 + sharded_step{,@4} + 2x sweep+shard
     bad = [f for f in findings if f.severity in ("warning", "error")]
     assert bad == [], [str(f) for f in bad]
+    sharded = {(r.entry, r.backend): r for r in reports if r.mesh_size}
+    assert set(sharded) == {
+        ("sharded_step", "dense"), ("sharded_step@4", "dense"),
+        ("run_sweep+shard", "dense"), ("run_sweep+shard", "delta"),
+    }
+    # the data-parallel sweeps hold the strict contract TODAY: zero
+    # member-gathers on both backends (delta is fully collective-free)
+    for backend in ("dense", "delta"):
+        counts = partitioning.collective_counts(
+            sharded[("run_sweep+shard", backend)].collectives
+        )
+        assert counts.get("member-gather", 0) == 0, (backend, counts)
+
+
+@pytest.mark.slow
+def test_byte_budget_pins_match_at_4096():
+    # the fast byte gate's shape: dense + delta run_scenario at n=4096
+    # must sit inside the pinned band (a drift here is the ROADMAP
+    # item 2 regression this contract exists for)
+    for backend in ("dense", "delta"):
+        report = audit_entry("run_scenario", backend, n=4096, ticks=4)
+        assert report.mem_bytes is not None, backend
+        bad = [f for f in report.findings
+               if f.severity in ("warning", "error")]
+        assert bad == [], [str(f) for f in bad]
+        assert ("run_scenario", backend, 4096) in budgets.BYTE_BUDGETS
+
+
+@pytest.mark.slow
+def test_flagship_byte_budget_65536_delta():
+    # the n=65,536 delta program (the round-5 worker-killer) pins at
+    # ~903 MB derived peak; this is item 2a's progress ledger — a PR
+    # that shrinks it re-pins DOWN, a PR that grows it fails here
+    report = audit_entry("run_scenario", "delta", n=65536, ticks=4)
+    bad = [f for f in report.findings
+           if f.severity in ("warning", "error")]
+    assert bad == [], [str(f) for f in bad]
+    pinned = budgets.BYTE_BUDGETS[("run_scenario", "delta", 65536)]
+    assert report.mem_bytes["peak_bytes"] <= pinned["peak_bytes"] * (
+        1 + budgets.BYTE_TOLERANCE
+    )
 
 
 @pytest.mark.slow
@@ -449,8 +508,248 @@ def test_delta_run_census_lists_nc_intermediates():
 
 
 # ---------------------------------------------------------------------------
-# the AST lint layer
+# contracts 6-8: the partitioning contracts (analysis/partitioning.py)
 # ---------------------------------------------------------------------------
+
+
+def _mesh2():
+    return Mesh(np.asarray(jax.devices()[:2]), ("nodes",))
+
+
+def _audit_fixture(built, n):
+    """The partitioning slice of audit_entry, on a hand-built fixture."""
+    closed, _, _, compiled = _trace_and_lower(
+        built, lower=False, compile_hlo=True
+    )
+    rows = partitioning.collective_census(compiled.as_text(),
+                                          dims=built.dims)
+    findings = partitioning.check_collectives(built, rows, n=n)
+    findings += partitioning.check_sharding_propagation(
+        built, compiled, closed
+    )
+    return findings, rows
+
+
+def test_member_allgather_fixture_detected(monkeypatch):
+    # the known-bad sharded "gossip" program: a row-sharded [N, K]
+    # member table forced back to full replication — exactly the
+    # all-gather shape the p2p-only contract bans
+    n = 8
+    mesh = _mesh2()
+    row = NamedSharding(mesh, P("nodes", None))
+    rep = NamedSharding(mesh, P())
+    bad = jax.jit(lambda x: x * 2, in_shardings=(row,), out_shardings=rep)
+    x = jax.device_put(jnp.zeros((n, 4), jnp.int32), row)
+    built = _fixture_built(
+        bad, (x,), name="bad-allgather", dims={"N": n},
+        mesh_size=2, mesh_axis="nodes", p2p_only=True,
+    )
+    monkeypatch.setitem(
+        budgets.COLLECTIVE_BUDGETS, ("bad-allgather", "dense", 2),
+        {"n": n, "counts": {}},
+    )
+    findings, rows = _audit_fixture(built, n)
+    member = [f for f in findings
+              if "member-tensor all-gather" in f.message]
+    assert member and member[0].severity == "error"
+    assert member[0].contract == "collective-census"
+    # the replicated output is flagged by the propagation check too
+    repl = [f for f in findings if "FULLY REPLICATED" in f.message]
+    assert repl and repl[0].severity == "error"
+    # and the census rows carry the machine-readable evidence
+    assert any(r["member"] and r["tag"] == "Nx4" for r in rows)
+    # budget drift fires as well: the pinned empty census vs reality
+    assert any("collective budget drift" in f.message for f in findings)
+
+
+def test_dropped_output_sharding_detected():
+    # sharding-propagation: a member-axis output pinned replicated
+    # inside the program — propagation "survives" only as replication
+    n = 8
+    mesh = _mesh2()
+    row = NamedSharding(mesh, P("nodes", None))
+    rep = NamedSharding(mesh, P())
+
+    def drops(x):
+        return jax.lax.with_sharding_constraint(x + 1, rep)
+
+    f = jax.jit(drops, in_shardings=(row,))
+    x = jax.device_put(jnp.zeros((n, 4), jnp.float32), row)
+    built = _fixture_built(
+        f, (x,), name="bad-resharded", dims={"N": n},
+        mesh_size=2, mesh_axis="nodes",
+    )
+    closed, _, _, compiled = _trace_and_lower(
+        built, lower=False, compile_hlo=True
+    )
+    findings = partitioning.check_sharding_propagation(
+        built, compiled, closed
+    )
+    (f1,) = [f for f in findings if f.severity == "error"]
+    assert f1.contract == "sharding-propagation"
+    assert "float32[8, 4]" in f1.message and "nodes" in f1.message
+    assert f1.where == "output[0]"
+
+
+def test_partitioned_output_sharding_clean():
+    # the healthy twin: row sharding survives propagation untouched
+    n = 8
+    mesh = _mesh2()
+    row = NamedSharding(mesh, P("nodes", None))
+    f = jax.jit(lambda x: x + 1, in_shardings=(row,))
+    x = jax.device_put(jnp.zeros((n, 4), jnp.float32), row)
+    built = _fixture_built(
+        f, (x,), name="ok-sharded", dims={"N": n},
+        mesh_size=2, mesh_axis="nodes",
+    )
+    closed, _, _, compiled = _trace_and_lower(
+        built, lower=False, compile_hlo=True
+    )
+    findings = partitioning.check_sharding_propagation(
+        built, compiled, closed
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_byte_budget_drift_detected(monkeypatch):
+    # the widened-carry fixture: an int64 carry doubles every byte
+    # field past the pinned band -> the byte contract trips (and the
+    # wide-dtype carry rule fires alongside, as in a real regression)
+    def run(init, xs):
+        return jax.lax.scan(lambda c, x: (c + x, c.sum()), init, xs)
+
+    jitted32 = jax.jit(run)
+    args32 = (jnp.zeros((256,), jnp.int32), jnp.zeros((8, 256), jnp.int32))
+    built32 = _fixture_built(jitted32, args32, name="bb-fx")
+    _, _, _, c32 = _trace_and_lower(built32, lower=False, compile_hlo=True)
+    from ringpop_tpu.obs.ledger import memory_row
+
+    baseline = memory_row(c32)
+    monkeypatch.setitem(
+        budgets.BYTE_BUDGETS, ("bb-fx", "dense", 256),
+        {"ticks": 8, **{k: baseline[k] for k in (
+            "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes")}},
+    )
+    # in-band: clean
+    ok = partitioning.check_byte_budget(built32, baseline, n=256, ticks=8)
+    assert ok == [], [str(f) for f in ok]
+    # the widened carry (int64 state) blows through the +10% band
+    jax.config.update("jax_enable_x64", True)
+    try:
+        args64 = (jnp.zeros((256,), jnp.int64),
+                  jnp.zeros((8, 256), jnp.int64))
+        built64 = _fixture_built(jax.jit(run), args64, name="bb-fx")
+        _, _, _, c64 = _trace_and_lower(built64, lower=False,
+                                        compile_hlo=True)
+        widened = memory_row(c64)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    findings = partitioning.check_byte_budget(
+        built64, widened, n=256, ticks=8
+    )
+    over = [f for f in findings if f.severity == "error"]
+    assert over, [str(f) for f in findings]
+    assert any("grew past the pinned budget" in f.message for f in over)
+    # a mismatched horizon is an explicit skip, not a bogus comparison
+    skip = partitioning.check_byte_budget(built64, widened, n=256, ticks=4)
+    assert [f.severity for f in skip] == ["info"]
+
+
+def test_byte_budget_underrun_prompts_repin(monkeypatch):
+    def run(init, xs):
+        return jax.lax.scan(lambda c, x: (c + x, c.sum()), init, xs)
+
+    built = _fixture_built(
+        jax.jit(run),
+        (jnp.zeros((64,), jnp.int32), jnp.zeros((4, 64), jnp.int32)),
+        name="bb-under",
+    )
+    _, _, _, c = _trace_and_lower(built, lower=False, compile_hlo=True)
+    from ringpop_tpu.obs.ledger import memory_row
+
+    mem = memory_row(c)
+    monkeypatch.setitem(
+        budgets.BYTE_BUDGETS, ("bb-under", "dense", 64),
+        {"ticks": 4, "peak_bytes": mem["peak_bytes"] * 2},
+    )
+    findings = partitioning.check_byte_budget(built, mem, n=64, ticks=4)
+    assert [f.severity for f in findings] == ["info"]
+    assert "re-pin to lock the reduction in" in findings[0].message
+
+
+def test_collective_census_parses_phases_and_bytes():
+    # parser unit: phases from named_scope'd op_name metadata, bytes
+    # from the result type, member classification from the dims
+    hlo = "\n".join([
+        '  %ag = s32[64,64]{1,0} all-gather(s32[32,64]{1,0} %x), '
+        'metadata={op_name="jit(f)/jit(main)/swim.recv_merge/gather"}',
+        '  %ar = f32[] all-reduce(f32[] %y), '
+        'metadata={op_name="jit(f)/jit(main)/add"}',
+        '  %cp = u32[16]{0} collective-permute(u32[16]{0} %z)',
+        # XLA's DEFAULT instruction naming puts the opcode in the name
+        # too — the result type must still be found after the "="
+        '  %custom-call.7 = s32[64,8]{1,0} custom-call(s32[64,8]{1,0} '
+        '%w, s32[999]{0} %big), custom_call_target="tpu_custom_call"',
+    ])
+    rows = partitioning.collective_census(hlo, dims={"N": 64})
+    by_op = {r["op"]: r for r in rows}
+    ag = by_op["all-gather"]
+    assert ag["member"] and ag["phase"] == "swim.recv_merge"
+    assert ag["bytes_each"] == 64 * 64 * 4 and ag["tag"] == "NxN"
+    assert by_op["all-reduce"]["phase"] == "unscoped"
+    # DMA-flavored custom calls are censused by their RESULT type only
+    # (operand types later in the line must not inflate the bytes)
+    dma = by_op["custom-call:tpu_custom_call"]
+    assert dma["bytes_each"] == 64 * 8 * 4 and not dma["member"]
+    assert partitioning.collective_counts(rows) == {
+        "all-gather": 1, "all-reduce": 1, "collective-permute": 1,
+        "custom-call:tpu_custom_call": 1, "member-gather": 1,
+    }
+
+
+def test_sharded_step_audits_clean():
+    # the clean sharded lane's fast representative: the real mesh-2
+    # sharded dense step at the PINNED budget shape must satisfy every
+    # partitioning contract — collective census matching the pinned
+    # (all-gather-shaped, honestly) budget, member-bearing outputs
+    # still row-sharded after unconstrained propagation, donation via
+    # the compiled alias table
+    report = audit_entry("sharded_step", "dense", n=64)
+    assert report.mesh_size == 2
+    assert [f for f in report.findings if f.severity != "info"] == [], [
+        str(f) for f in report.findings
+    ]
+    assert report.aliased_outputs >= 1
+    counts = partitioning.collective_counts(report.collectives)
+    assert counts.get("member-gather", 0) > 0  # today's honest baseline
+    phases = {r["phase"] for r in report.collectives if r["member"]}
+    assert any(p.startswith("swim.") for p in phases)
+
+
+def test_registry_sharded_entries_skip_without_devices(monkeypatch):
+    # a 1-device host must degrade to an info finding, not a crash
+    from ringpop_tpu.analysis.contracts import audit_all
+    from ringpop_tpu.analysis import registry as reg
+
+    monkeypatch.setattr(
+        reg, "_require_devices",
+        lambda mesh, entry: (_ for _ in ()).throw(
+            reg.EntryUnavailable(f"{entry} needs {mesh} devices")),
+    )
+    reports, findings = audit_all(
+        names=("sharded_step",), compile_programs=False
+    )
+    assert reports == []
+    (f,) = findings
+    assert f.severity == "info" and "devices" in f.message
+    # ...but the CLI fails CLOSED when the skip leaves ZERO audited
+    # programs: an explicit mesh-entry selection on a capability-poor
+    # host must not green-light the push
+    from ringpop_tpu.analysis.cli import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--entry", "sharded_step", "--no-lint"])
+    assert "0 programs audited" in str(exc.value)
 
 
 def test_lint_block_until_ready_flagged_and_pragma():
@@ -515,6 +814,48 @@ def test_lint_nested_scan_body_inherits_traced_context():
            "    return body\n")
     (f,) = lint_source(src, "m.py")
     assert f.contract == "lint:RPL002"
+
+
+def test_lint_rpl005_device_put_and_shard_map():
+    # the silent-replication footgun: bare device_put in a
+    # sharding-path module
+    src = ("import jax\n"
+           "def place(x):\n"
+           "    return jax.device_put(x)\n")
+    (f,) = lint_source(src, "parallel/m.py", sharding_path=True)
+    assert f.contract == "lint:RPL005" and "placement" in f.message
+    # an explicit sharding (positional or keyword) passes
+    ok = ("import jax\n"
+          "def place(x, sh):\n"
+          "    a = jax.device_put(x, sh)\n"
+          "    return jax.device_put(x, device=sh)\n")
+    assert lint_source(ok, "parallel/m.py", sharding_path=True) == []
+    # outside the sharding dirs the same call is host plumbing
+    assert lint_source(src, "obs/m.py", sharding_path=False) == []
+    # the pragma wins, as everywhere
+    allowed = ("import jax\n"
+               "def place(x):\n"
+               "    return jax.device_put(x)  # audit: allow=RPL005\n")
+    assert lint_source(allowed, "parallel/m.py", sharding_path=True) == []
+    # shard_map without explicit specs
+    sm = ("from jax.experimental.shard_map import shard_map\n"
+          "def build(f, mesh):\n"
+          "    return shard_map(f, mesh)\n")
+    (f2,) = lint_source(sm, "scenarios/m.py", sharding_path=True)
+    assert f2.contract == "lint:RPL005" and "in_specs" in f2.message
+    sm_ok = ("from jax.experimental.shard_map import shard_map\n"
+             "from jax.sharding import PartitionSpec as P\n"
+             "def build(f, mesh):\n"
+             "    return shard_map(f, mesh, in_specs=P('x'), "
+             "out_specs=P('x'))\n")
+    assert lint_source(sm_ok, "scenarios/m.py", sharding_path=True) == []
+    # mixed positional/keyword specs are fully explicit too
+    sm_mixed = ("from jax.experimental.shard_map import shard_map\n"
+                "from jax.sharding import PartitionSpec as P\n"
+                "def build(f, mesh, inspec):\n"
+                "    return shard_map(f, mesh, inspec, "
+                "out_specs=P('x'))\n")
+    assert lint_source(sm_mixed, "scenarios/m.py", sharding_path=True) == []
 
 
 def test_lint_library_tree_clean():

@@ -1,0 +1,54 @@
+"""The jax version pin guard (ringpop_tpu/utils/jaxpin.py).
+
+One fast, loud failure when the environment's jax is not the pinned
+build — instead of dozens of inscrutable bit-diff failures across the
+golden lanes (incident goldens, seeded golden traces, carry /
+collective / byte budget tables), this names exactly what to do: bump
+the pin, re-pin the goldens (tools/pin_incidents.py) and the budgets
+(tools/pin_budgets.py).  The golden-lane tests themselves consult
+``golden_skip_reason()`` and SKIP with the same instruction, so a jax
+bump degrades the suite visibly rather than explosively.
+"""
+
+import jax
+
+from ringpop_tpu.utils.jaxpin import (
+    PINNED_JAX_VERSION,
+    golden_skip_reason,
+    jax_version_matches,
+)
+
+
+def test_running_jax_is_the_pinned_build():
+    assert jax.__version__ == PINNED_JAX_VERSION, (
+        f"jax {jax.__version__} != pinned {PINNED_JAX_VERSION}.  The "
+        "golden lanes (tests/golden/incidents, the seeded golden "
+        "traces) and every analysis budget table (carry dtypes, "
+        "collective censuses, byte footprints) were pinned under "
+        f"{PINNED_JAX_VERSION}'s threefry + partitioner.  On an "
+        "intentional bump: update ringpop_tpu/utils/jaxpin.py, then "
+        "re-pin via tools/pin_incidents.py and tools/pin_budgets.py."
+    )
+
+
+def test_skip_reason_contract():
+    # under the pinned build the guard is silent; the skip message —
+    # whenever it fires — must carry the re-pin instruction, because
+    # it is the only thing a CI log will show
+    if jax_version_matches():
+        assert golden_skip_reason() is None
+    else:
+        reason = golden_skip_reason()
+        assert reason and "re-pin" in reason
+        assert "pin_budgets" in reason and "pin_incidents" in reason
+
+
+def test_partitioning_budget_checks_degrade_on_mismatch(monkeypatch):
+    # the auditor's budget comparisons must turn into ONE warning per
+    # check under a foreign jax, not a wall of drift errors
+    from ringpop_tpu.analysis import partitioning
+
+    monkeypatch.setattr(partitioning, "jax_version_matches", lambda: False)
+    guard = partitioning._version_guard("fx", "collective-census")
+    (f,) = guard
+    assert f.severity == "warning" and "re-pin" in f.message

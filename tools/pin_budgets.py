@@ -1,0 +1,128 @@
+"""Mechanical re-pin helper for every analysis/budgets.py table.
+
+Runs the auditor in budget-printing mode and emits READY-TO-PASTE rows
+for all three budget kinds — carry-dtype multisets, collective
+censuses of the sharded entries, and compiled byte footprints — so
+"re-pin by hand after every intentional change" (the CHANGES.md chore
+since PR 13) becomes one command:
+
+    python tools/pin_budgets.py                 # all three tables
+    python tools/pin_budgets.py --kinds carry
+    python tools/pin_budgets.py --kinds bytes --flagship   # + n=65,536
+
+The byte rows compile ``run_scenario`` dense+delta at n=4096 (~20 s on
+a CPU host); ``--flagship`` adds the delta n=65,536 row (the round-5
+worker-killer, ~30 s to compile — the ROADMAP item 2 progress ledger).
+Collective rows need >= 4 local devices; the script provisions CPU
+virtual devices itself.
+
+Paste the emitted rows over the matching entries in
+``ringpop_tpu/analysis/budgets.py`` and re-run
+``python -m ringpop_tpu audit`` to confirm a clean board.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from ringpop_tpu.utils import provision_virtual_devices  # noqa: E402
+
+provision_virtual_devices(4)
+
+BYTE_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes", "peak_bytes")
+
+
+def _carry_multiset(report) -> dict[str, int]:
+    from collections import Counter
+
+    ms: Counter = Counter()
+    for leaves in report.carries.values():
+        for leaf in leaves:
+            ms[leaf.split("[")[0]] += 1
+    return dict(sorted(ms.items()))
+
+
+def pin_carry(n: int, ticks: int) -> None:
+    from ringpop_tpu.analysis.contracts import audit_all
+
+    print("# CARRY_BUDGETS rows (audit fixtures; shape-independent):")
+    reports, _ = audit_all(n=n, ticks=ticks, compile_programs=False)
+    for r in reports:
+        print(f'    ("{r.entry}", "{r.backend}"): {_carry_multiset(r)},')
+
+
+def pin_collectives(n: int, ticks: int) -> None:
+    from ringpop_tpu.analysis.contracts import audit_all
+    from ringpop_tpu.analysis.partitioning import collective_counts
+
+    print(f"# COLLECTIVE_BUDGETS rows (sharded entries, n={n}):")
+    reports, _ = audit_all(
+        names=("sharded_step", "sharded_step@4", "run_sweep+shard"),
+        n=n, ticks=ticks,
+    )
+    for r in reports:
+        counts = collective_counts(r.collectives)
+        print(f'    ("{r.entry}", "{r.backend}", {r.mesh_size}): '
+              f'{{"n": {r.n}, "counts": {counts}}},')
+
+
+def pin_bytes(n: int, ticks: int, flagship: bool) -> None:
+    from ringpop_tpu.analysis.contracts import audit_entry
+
+    shapes = [("run_scenario", "dense", n), ("run_scenario", "delta", n)]
+    if flagship:
+        shapes.append(("run_scenario", "delta", 65536))
+    print(f"# BYTE_BUDGETS rows (cpu platform, ticks={ticks}):")
+    for entry, backend, nn in shapes:
+        r = audit_entry(entry, backend, n=nn, ticks=ticks,
+                        force_compile=True)
+        fields = ", ".join(
+            f'"{f}": {int(r.mem_bytes[f])}' for f in BYTE_FIELDS
+        )
+        print(f'    ("{entry}", "{backend}", {nn}): '
+              f'{{"ticks": {ticks}, {fields}}},')
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--kinds", default="carry,collectives,bytes",
+                    help="comma list of carry,collectives,bytes")
+    ap.add_argument("--n", type=int, default=64,
+                    help="fixture n for carry/collective rows (the "
+                         "audit default; collective budgets are "
+                         "compared at their pinned n)")
+    ap.add_argument("--ticks", type=int, default=4)
+    ap.add_argument("--n-bytes", type=int, default=4096,
+                    help="n for the byte-budget rows")
+    ap.add_argument("--flagship", action="store_true",
+                    help="also pin the delta n=65,536 byte row "
+                         "(ROADMAP item 2's ledger; ~30 s compile)")
+    args = ap.parse_args()
+
+    kinds = set(args.kinds.split(","))
+    unknown = kinds - {"carry", "collectives", "bytes"}
+    if unknown:
+        sys.exit(f"pin_budgets: unknown kind(s) {sorted(unknown)}")
+    from ringpop_tpu.utils.jaxpin import PINNED_JAX_VERSION, jax_version
+
+    if jax_version() != PINNED_JAX_VERSION:
+        print(f"# WARNING: jax {jax_version()} != pinned "
+              f"{PINNED_JAX_VERSION} — also bump "
+              "ringpop_tpu/utils/jaxpin.py if this re-pin is the "
+              "version migration")
+    if "carry" in kinds:
+        pin_carry(args.n, args.ticks)
+    if "collectives" in kinds:
+        pin_collectives(args.n, args.ticks)
+    if "bytes" in kinds:
+        pin_bytes(args.n_bytes, args.ticks, args.flagship)
+
+
+if __name__ == "__main__":
+    main()
