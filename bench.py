@@ -85,9 +85,19 @@ TPU_DELTA_LADDER = (
     # LARGEST rung clearing vs_baseline >= 1.0 (the last, since n
     # ascends), and a crash stops the climb with the prior rungs
     # already in hand.
-    ("delta@64", 8192),
-    ("delta@64", 16384),
-    ("delta@64", 32768),
+    #
+    # The banked rungs below 65,536 run STREAMED (``+stream``): the
+    # tick batch is dispatched as STREAM_SEGMENTS back-to-back
+    # segment-sized delta_run programs (the scenarios/stream.py
+    # segment-dispatch shape) instead of one monolithic 100-tick
+    # scan.  Each compiled program is 4x smaller — itself a plausible
+    # fix for the worker crash, and it keeps the banked ladder's
+    # programs disjoint from the flagship one under suspicion.  The
+    # 65,536+ rungs stay monolithic: they measure the exact program
+    # whose footprint analysis/budgets.py pins.
+    ("delta@64+stream", 8192),
+    ("delta@64+stream", 16384),
+    ("delta@64+stream", 32768),
     ("delta@64", 65536),
     ("delta@256", 65536),
     ("delta@64", 131072),
@@ -120,8 +130,23 @@ CPU_ATTEMPTS = (
 # (see bench_once's big-n branch).  Falls through to CPU_ATTEMPTS.
 CPU_LADDER = (
     ("delta@64", 65536, 1500),
-    ("delta@64", 32768, 600),
+    ("delta@64+stream", 32768, 600),
 )
+
+# ``+stream`` rungs split each tick batch into this many back-to-back
+# segment dispatches (scenarios/stream.py's shape, applied to the raw
+# delta_run hot loop): same ticks, 4x-smaller compiled programs.
+STREAM_SEGMENTS = 4
+
+
+def _stream_plan(batch_ticks: int) -> tuple[int, int]:
+    """(segments, ticks_per_segment) for a ``+stream`` rung's batch.
+
+    Pure so the banked-ladder shape is testable without a backend;
+    segments * ticks_per_segment may round below batch_ticks (the rate
+    math uses the product, so the measurement stays exact)."""
+    seg_ticks = max(1, batch_ticks // STREAM_SEGMENTS)
+    return batch_ticks // seg_ticks, seg_ticks
 
 
 # ---------------------------------------------------------------------------
@@ -151,6 +176,9 @@ def bench_once(n: int, layout: str = "dense") -> float:
         from ringpop_tpu.models import swim_delta as sd
 
         _, _, cap = layout.partition("@")
+        streamed = cap.endswith("+stream")
+        if streamed:
+            cap = cap[: -len("+stream")]
         params = sd.DeltaParams(
             swim=sim.SwimParams(loss=0.01), wire_cap=16, claim_grid=64
         )
@@ -169,10 +197,39 @@ def bench_once(n: int, layout: str = "dense") -> float:
         # a lax.scan batch fits even double-buffered: one dispatch +
         # one host sync per batch, vs per-tick dispatch whose ~70 ms
         # tunnel sync would dominate a ~15 ms tick.
-        def step(st, nt, k, p):
-            return sd.delta_run(st, nt, k, p, delta_ticks)
+        if streamed:
+            # Segment dispatches (see TPU_DELTA_LADDER): the batch is
+            # STREAM_SEGMENTS async back-to-back delta_run programs,
+            # still one host sync per batch.  Overflow/occupancy are
+            # reduced across segments so the CapacityOverflow guard
+            # keeps batch-wide scope.
+            import jax.numpy as jnp
 
-        ticks_per_step = delta_ticks
+            segs, seg_ticks = _stream_plan(delta_ticks)
+
+            def step(st, nt, k, p):
+                m = None
+                for sk in jax.random.split(k, segs):
+                    st, seg_m = sd.delta_run(st, nt, sk, p, seg_ticks)
+                    if m is None:
+                        m = dict(seg_m)
+                    else:
+                        m = dict(
+                            seg_m,
+                            overflow_drops=m["overflow_drops"]
+                            + seg_m["overflow_drops"],
+                            max_occupancy=jnp.maximum(
+                                m["max_occupancy"], seg_m["max_occupancy"]
+                            ),
+                        )
+                return st, m
+
+            ticks_per_step = segs * seg_ticks
+        else:
+            def step(st, nt, k, p):
+                return sd.delta_run(st, nt, k, p, delta_ticks)
+
+            ticks_per_step = delta_ticks
     else:
         params = sim.SwimParams(loss=0.01)
         state = sim.init_state(n)
@@ -646,7 +703,12 @@ def main() -> None:
             result["note"] = (
                 "large-n CPU rung: shortened measurement (20-tick batch, "
                 "1 repeat); real-time parity is a TPU claim, this records "
-                "scale reached on the fallback host"
+                "scale reached on the fallback host.  r06: the TPU ladder "
+                "banks its 8192->32768 rungs as +stream layouts (4 "
+                "back-to-back segment dispatches) before the monolithic "
+                "65536 program, whose compiled footprint re-pinned at "
+                "575688560 peak bytes (-36.2% vs the round-5 "
+                "worker-killer's 902967088)"
             )
             result["error"] = "; ".join(errors)
             _emit(result)

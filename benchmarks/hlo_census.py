@@ -18,7 +18,8 @@ rough element count.  Two backends:
   the only remaining [N]-class sorts are the flat sender orderings.
 
 Usage: python -m benchmarks.hlo_census [--backend dense|delta]
-       [--recv-merge sorted|scatter|pallas] [--temps [--min-elems E]]
+       [--recv-merge sorted|scatter|pallas]
+       [--temps [--min-elems E] [--sort bytes|count|elems] [--top K]]
        [--collectives [--mesh D]] [n] [capacity]
 
 ``--temps`` switches to the temporary-tensor census (the trace-contract
@@ -239,6 +240,40 @@ def temp_rows(
     return temp_census(closed, dims=dims, min_elems=floor, entry=entry)
 
 
+def annotate_packed(rows: list[dict]) -> list[dict]:
+    """Add the packed-dtype column to temp-census rows: what each
+    temporary would cost as a bit-packed plane (``ops/bitpack.py``
+    layout — bool at 1 bit/element in uint32 words; other dtypes are
+    already at their packed width).  A before/after footprint diff is
+    then one command: rows whose ``bytes_each`` exceeds their
+    ``packed_bytes_each`` are the remaining packing entitlement."""
+    for row in rows:
+        if row["dtype"] == "bool":
+            words = -(-row["elems_each"] // 32)
+            row["packed_dtype"] = "uint32[bits]"
+            row["packed_bytes_each"] = words * 4
+        else:
+            row["packed_dtype"] = row["dtype"]
+            row["packed_bytes_each"] = row["bytes_each"]
+    return rows
+
+
+_TEMP_SORTS = {
+    "bytes": lambda r: (-r["bytes_each"] * r["count"], r["primitive"]),
+    "count": lambda r: (-r["count"], -r["bytes_each"], r["primitive"]),
+    "elems": lambda r: (-r["elems_each"] * r["count"], r["primitive"]),
+}
+
+
+def sort_temp_rows(
+    rows: list[dict], sort: str = "bytes", top: int | None = None
+) -> list[dict]:
+    """Order temp-census rows by ``sort`` (see _TEMP_SORTS) and keep
+    the first ``top`` (None = all)."""
+    rows = sorted(rows, key=_TEMP_SORTS[sort])
+    return rows if top is None else rows[:top]
+
+
 def collective_rows(n: int, mesh: int) -> list[dict]:
     """Collective-census rows of the mesh-sharded dense step at the
     given mesh size, via the partitioning auditor's walker.  Needs
@@ -288,6 +323,20 @@ def main():
              "N*N on dense)",
     )
     ap.add_argument(
+        "--sort",
+        choices=tuple(_TEMP_SORTS),
+        default="bytes",
+        help="--temps row order (default bytes: total footprint "
+             "descending)",
+    )
+    ap.add_argument(
+        "--top",
+        type=int,
+        default=None,
+        metavar="K",
+        help="--temps: emit only the first K rows after sorting",
+    )
+    ap.add_argument(
         "--collectives",
         action="store_true",
         help="emit the collective census of the mesh-sharded dense "
@@ -317,8 +366,11 @@ def main():
         n = args.n if args.n is not None else (
             65536 if args.backend == "delta" else 8192
         )
-        for row in temp_rows(
+        rows = temp_rows(
             args.backend, n, args.capacity, args.recv_merge, args.min_elems
+        )
+        for row in sort_temp_rows(
+            annotate_packed(rows), sort=args.sort, top=args.top
         ):
             print(json.dumps(row), flush=True)
         return

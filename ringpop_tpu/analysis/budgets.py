@@ -42,29 +42,39 @@ from collections import Counter
 # audit of the seed fixtures (n is immaterial; the multiset is
 # shape-independent).  The dense carry is view_key int32[N, N] + the
 # int8 lattice planes + the scan-threaded net bits; the delta carry is
-# the windowed claim state (int32 slots + uint32 hash row);
-# run_scenario adds the net carry (up/responsive bool, gid/period
-# int32); run_scenario+traffic is carry-identical to run_scenario (the
-# serving plane stacks ys, it carries nothing); recv_merge_pallas's
-# two int32 scans are the searchsorted lowering inside the wrapper.
+# the windowed claim state (int32 slots + uint32 hash row + the
+# bit-packed base plane, uint32 words since r06); run_scenario adds
+# the net carry (up/responsive packed to uint32 words, gid int32,
+# period int16 — the r06 narrowings: the bool[N] planes ride the scan
+# as ceil(N/32) uint32 words, the period row fits int16 after a loud
+# host-side range check); run_scenario+traffic is carry-identical to
+# run_scenario (the serving plane stacks ys, it carries nothing);
+# recv_merge_pallas's two int32 scans are the searchsorted lowering
+# inside the wrapper.  ZERO bool leaves is now the pin: a bool
+# reappearing in any scan carry means a plane escaped the packing.
 CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
     ("swim_run", "dense"): {"int32": 2, "int8": 2},
-    ("delta_run", "delta"): {"bool": 1, "int32": 7, "int8": 2, "uint32": 1},
-    ("run_scenario", "dense"): {"bool": 2, "int32": 3, "int8": 2},
-    ("run_scenario", "delta"): {"bool": 3, "int32": 8, "int8": 2,
-                                "uint32": 1},
-    ("run_scenario+traffic", "dense"): {"bool": 2, "int32": 3, "int8": 2},
-    ("run_scenario+traffic", "delta"): {"bool": 3, "int32": 8, "int8": 2,
-                                        "uint32": 1},
+    ("delta_run", "delta"): {"int32": 7, "int8": 2, "uint32": 2},
+    ("run_scenario", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
+    ("run_scenario", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
+    ("run_scenario+traffic", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
+    ("run_scenario+traffic", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
     # the incident shape adds the overload feedback carry on top of
-    # run_scenario+traffic — ov_gray (bool[N]), ov_cnt (int32[N]) —
-    # plus the period row the overload fixture always materializes
-    ("run_scenario+incident", "dense"): {"bool": 3, "int32": 5, "int8": 2},
-    ("run_scenario+incident", "delta"): {"bool": 4, "int32": 10, "int8": 2,
-                                         "uint32": 1},
-    ("run_sweep", "dense"): {"bool": 2, "int32": 3, "int8": 2},
-    ("run_sweep", "delta"): {"bool": 3, "int32": 8, "int8": 2, "uint32": 1},
+    # run_scenario+traffic — ov_gray (packed uint32 words), ov_cnt
+    # (int32[N], left wide: unbounded accumulation) — plus the period
+    # row the overload fixture always materializes (int16 since r06)
+    ("run_scenario+incident", "dense"): {"int16": 1, "int32": 4, "int8": 2,
+                                         "uint32": 3},
+    ("run_scenario+incident", "delta"): {"int16": 1, "int32": 9, "int8": 2,
+                                         "uint32": 5},
+    ("run_sweep", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
+    ("run_sweep", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
     ("recv_merge_pallas", "dense"): {"int32": 2},
+    # the fused delta insert-merge kernel is scan-free: its merge
+    # inversion is pure VPU arithmetic (compare-reduces + lane rolls),
+    # no lax.scan anywhere in the lowering — the empty multiset IS the
+    # pin
+    ("delta_merge_pallas", "delta"): {},
     # the sharded step has no tick scan: its "carries" are the int32
     # loop state of the step's 22 inner sort/fori kernels (primary at
     # this program's top level); the sharded sweep's carry is
@@ -73,9 +83,8 @@ CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
     # it lives
     ("sharded_step", "dense"): {"int32": 44},
     ("sharded_step@4", "dense"): {"int32": 44},
-    ("run_sweep+shard", "dense"): {"bool": 2, "int32": 3, "int8": 2},
-    ("run_sweep+shard", "delta"): {"bool": 3, "int32": 8, "int8": 2,
-                                   "uint32": 1},
+    ("run_sweep+shard", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
+    ("run_sweep+shard", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
 }
 
 
@@ -139,25 +148,35 @@ def collective_budget(entry: str, backend: str, mesh: int) -> dict | None:
 # in the slow lane.
 BYTE_BUDGETS: dict[tuple[str, str, int], dict[str, int]] = {
     # the fast gate: dense pays ~890 MB peak at n=4096 (the [N, N]
-    # planes) vs delta's ~56 MB — the 16x gap IS the reason delta is
+    # planes) vs delta's ~36 MB — the 25x gap IS the reason delta is
     # the scale flagship
     ("run_scenario", "dense", 4096): {
         "ticks": 4, "argument_bytes": 100687936,
-        "output_bytes": 100688256, "temp_bytes": 789048440,
-        "peak_bytes": 889736756,
+        "output_bytes": 100688256, "temp_bytes": 789049144,
+        "peak_bytes": 889737460,
     },
+    # r06 re-pin: peak 56446768 -> 35991920 (-36.2%) from the
+    # two-key-sort claim-row rewrite + gather-based insert merge +
+    # bit-packed planes (was {"ticks": 4, "argument_bytes": 2715716,
+    # "output_bytes": 2716116, "temp_bytes": 53730592,
+    # "peak_bytes": 56446768})
     ("run_scenario", "delta", 4096): {
-        "ticks": 4, "argument_bytes": 2715716, "output_bytes": 2716116,
-        "temp_bytes": 53730592, "peak_bytes": 56446768,
+        "ticks": 4, "argument_bytes": 2712132, "output_bytes": 2712532,
+        "temp_bytes": 33279328, "peak_bytes": 35991920,
     },
     # the flagship ledger (slow lane): the n=65,536 delta program that
-    # killed the round-5 TPU worker pins at ~903 MB derived peak on
-    # the CPU analysis — ROADMAP item 2a's ">=30% reduction" target is
-    # peak_bytes <= ~632 MB on this exact row
+    # killed the round-5 TPU worker pinned at ~903 MB derived peak on
+    # the CPU analysis through r05; the r06 pass (killed [N, C+K+1]
+    # concat-sort temps, gather merges, packed planes, narrowed
+    # carries) re-pins it at ~576 MB — ROADMAP item 2a's ">=30% peak
+    # reduction / <= ~632 MB" target, met at -36.2%.  Pre-r06 row for
+    # the record: {"ticks": 4, "argument_bytes": 43450436,
+    # "output_bytes": 43450836, "temp_bytes": 859516192,
+    # "peak_bytes": 902967088}
     ("run_scenario", "delta", 65536): {
-        "ticks": 4, "argument_bytes": 43450436,
-        "output_bytes": 43450836, "temp_bytes": 859516192,
-        "peak_bytes": 902967088,
+        "ticks": 4, "argument_bytes": 43393092,
+        "output_bytes": 43393492, "temp_bytes": 532295008,
+        "peak_bytes": 575688560,
     },
 }
 

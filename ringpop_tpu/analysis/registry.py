@@ -372,6 +372,38 @@ def _build_recv_merge(backend: str, *, n: int, **_ignored) -> Built:
     )
 
 
+def _build_delta_merge(backend: str, *, n: int, capacity: int = 64,
+                       **_ignored) -> Built:
+    """The delta insert-merge Pallas kernel's jit wrapper (interpret
+    mode — same contract as recv_merge_pallas: jaxpr invariants are
+    lowering-independent, the Mosaic compile needs a TPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.ops import delta_merge_pallas as dmp
+
+    ki = 17  # claim_grid=16 + the self column, the audit-fixture shape
+    d_subj = jnp.full((n, capacity), dmp.SENTINEL, jnp.int32)
+    d_key = jnp.zeros((n, capacity), jnp.int32)
+    d_pb = jnp.full((n, capacity), -1, jnp.int8)
+    d_sl = jnp.full((n, capacity), -1, jnp.int8)
+    ins_subj = jnp.full((n, ki), dmp.SENTINEL, jnp.int32)
+    ins_key = jnp.zeros((n, ki), jnp.int32)
+    args = (d_subj, d_key, d_pb, d_sl, ins_subj, ins_key)
+    return Built(
+        name="delta_merge_pallas",
+        backend=backend,
+        jitted=dmp.merge_insert_pallas,
+        args=args,
+        statics=dict(sl_start=10, suspect=2, interpret=True),
+        key_roots={},
+        donates=False,
+        min_aliased=0,
+        census_min_elems=n * capacity,
+        dims=dict(N=n, C=capacity),
+    )
+
+
 def _require_devices(mesh: int, entry: str) -> None:
     import jax
 
@@ -503,6 +535,10 @@ ENTRY_POINTS: dict[str, EntrySpec] = {
         "recv_merge_pallas", ("dense",), _build_recv_merge,
         "the Pallas receiver-merge kernel wrapper "
         "(ops/recv_merge_pallas.py, interpret lowering)"),
+    "delta_merge_pallas": EntrySpec(
+        "delta_merge_pallas", ("delta",), _build_delta_merge,
+        "the fused insert-merge kernel for the delta tables "
+        "(ops/delta_merge_pallas.py, interpret lowering)"),
     "sharded_step": EntrySpec(
         "sharded_step", ("dense",),
         lambda backend, **kw: _build_sharded_step(backend, mesh=2, **kw),

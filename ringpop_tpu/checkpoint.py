@@ -152,6 +152,20 @@ def load(path: str, device: Any | None = None) -> SimCluster:
 
         state_cls = DeltaState if backend == "delta" else ClusterState
         cluster.state = load_tuple(state_cls, "state")
+        if backend == "delta":
+            # The boolean lattice planes are bit-packed at rest (PR 16,
+            # ops/bitpack.py); checkpoints written before the packing
+            # store them as bool tensors under the same names — detect
+            # by dtype and pack once at load (still format v5: the .npz
+            # is self-describing, the names did not change)
+            from ringpop_tpu.ops import bitpack
+
+            st = cluster.state
+            if st.bp_mask.dtype == np.bool_:
+                st = st._replace(bp_mask=bitpack.pack_bits(st.bp_mask))
+            if st.d_bpmask is not None and st.d_bpmask.dtype == np.bool_:
+                st = st._replace(d_bpmask=bitpack.pack_bits(st.d_bpmask))
+            cluster.state = st
         if backend == "delta" and cluster.state.digest is None:
             # checkpoints predating the carried derivatives (optional
             # fields absent): backfill from the oracles once at load
@@ -165,9 +179,12 @@ def load(path: str, device: Any | None = None) -> SimCluster:
             # digest already carried; the operator asked for the
             # slot-base carry this process — populate just that
             from ringpop_tpu.models.swim_delta import compute_slot_base
+            from ringpop_tpu.ops import bitpack
 
             bpm, bpr = compute_slot_base(cluster.state)
-            cluster.state = cluster.state._replace(d_bpmask=bpm, d_bprank=bpr)
+            cluster.state = cluster.state._replace(
+                d_bpmask=bitpack.pack_bits(bpm), d_bprank=bpr
+            )
         cluster.net = load_tuple(NetState, "net")
         cluster.key = jax.numpy.asarray(data["key"])
         # telemetry (v4); older checkpoints backfill empty — same

@@ -104,6 +104,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.obs import annotate
+from ringpop_tpu.ops import bitpack
 from ringpop_tpu.models.swim_sim import (
     ALIVE,
     FAULTY,
@@ -163,7 +164,11 @@ class DeltaState(NamedTuple):
     """
 
     base_key: jax.Array  # int32[N] | int32[G, N] (sided)
-    bp_mask: jax.Array  # bool[N] | [G, N]  base-pingable (alive|suspect)
+    # base-pingable (alive|suspect), BIT-PACKED at rest (ops/bitpack.py
+    # layout: bit j of word i = member i*32+j, zero pad bits) — point
+    # queries go through bp_mask_at (word gather + shift), totals
+    # through popcount; nothing ever unpacks the whole plane
+    bp_mask: jax.Array  # uint32[ceil(N/32)] | [G, ceil(N/32)]
     bp_rank: jax.Array  # int32[N] | [G, N] exclusive prefix count of bp_mask
     bp_list: jax.Array  # int32[N] | [G, N] base-pingable subjects ascending
     d_subj: jax.Array  # int32[N, C]
@@ -195,8 +200,10 @@ class DeltaState(NamedTuple):
     # base rebuild) — never on value updates — so the step maintains
     # them with [N, K]-sized gathers under the insert cond instead of
     # [N, C] gathers every tick.  SENTINEL slots hold (False, 0).
-    # compute_slot_base() is the from-scratch oracle.
-    d_bpmask: jax.Array | None = None  # bool[N, C]
+    # compute_slot_base() is the from-scratch oracle (bool [N, C]);
+    # the CARRIED form is bit-packed along the slot axis (bitpack
+    # layout), unpacked only at the few consuming sites.
+    d_bpmask: jax.Array | None = None  # uint32[N, ceil(C/32)] packed bits
     d_bprank: jax.Array | None = None  # int32[N, C]
     # Latency extension (None = disabled, zero cost): the delta
     # backend's in-flight claim representation for per-link delay
@@ -254,9 +261,9 @@ class DeltaState(NamedTuple):
     def bp_mask_at(self, q: jax.Array) -> jax.Array:
         qc = jnp.clip(q, 0, self.n - 1)
         if self.side is None:
-            return self.bp_mask[qc]
+            return bitpack.bit_gather(self.bp_mask, qc)
         s = self.side if q.ndim == 1 else self.side[:, None]
-        return self.bp_mask[s, qc]
+        return bitpack.bit_gather(self.bp_mask, qc, s)
 
     def bp_rank_at(self, q: jax.Array) -> jax.Array:
         qc = jnp.clip(q, 0, self.n - 1)
@@ -288,7 +295,7 @@ def _base_rank_structs(
         jnp.arange(n, dtype=jnp.int32), base_key.shape
     )
     bp_list = jnp.sort(jnp.where(bp_mask, ids, n), axis=-1)
-    return bp_mask, bp_rank, bp_list
+    return bitpack.pack_bits(bp_mask), bp_rank, bp_list
 
 
 def init_delta(
@@ -440,6 +447,17 @@ _WIDE_QUERY = 4
 _WIDE_METHOD = os.environ.get("RINGPOP_WIDE_METHOD", "scan_unrolled")
 if _WIDE_METHOD not in ("sort", "scan", "scan_unrolled", "compare_all", "pallas"):
     raise ValueError(f"RINGPOP_WIDE_METHOD={_WIDE_METHOD!r} is not a lowering")
+
+# ``_MERGE_METHOD`` selects the insert-merge lowering inside
+# ``_merge_claims``: "sorted" (default) is the searchsorted + gather
+# inversion below; "pallas" streams row blocks through the fused VMEM
+# kernel (ops/delta_merge_pallas.py — the delta backend's first Pallas
+# kernel, interpret mode off-TPU).  Bit-parity across both is pinned by
+# tests/test_swim_delta.py's merge-method grid.  Like
+# RINGPOP_WIDE_METHOD, the env override is read at trace time.
+_MERGE_METHOD = os.environ.get("RINGPOP_DELTA_MERGE", "sorted")
+if _MERGE_METHOD not in ("sorted", "pallas"):
+    raise ValueError(f"RINGPOP_DELTA_MERGE={_MERGE_METHOD!r} is not a lowering")
 
 
 def _row_searchsorted(a: jax.Array, v: jax.Array, side: str = "left") -> jax.Array:
@@ -644,7 +662,7 @@ def refresh_carried(state: DeltaState) -> DeltaState:
         or state.d_bpmask is not None
     ):
         bpm, bpr = compute_slot_base(state)
-        return state._replace(d_bpmask=bpm, d_bprank=bpr)
+        return state._replace(d_bpmask=bitpack.pack_bits(bpm), d_bprank=bpr)
     return state._replace(d_bpmask=None, d_bprank=None)
 
 
@@ -658,7 +676,7 @@ def _refresh_in_step(state: DeltaState) -> DeltaState:
     state = state._replace(digest=compute_digest(state))
     if state.d_bpmask is not None:
         bpm, bpr = compute_slot_base(state)
-        return state._replace(d_bpmask=bpm, d_bprank=bpr)
+        return state._replace(d_bpmask=bitpack.pack_bits(bpm), d_bprank=bpr)
     return state
 
 
@@ -670,7 +688,7 @@ def _phase0_stats(state: DeltaState) -> _Stats:
     d_status = state.d_key & 7
     ping_now = live & ((d_status == ALIVE) | (d_status == SUSPECT))
     ping_base = (
-        state.d_bpmask
+        bitpack.unpack_bits(state.d_bpmask, state.capacity)
         if state.d_bpmask is not None
         else live & state.bp_mask_at(subj_safe)
     )
@@ -679,9 +697,9 @@ def _phase0_stats(state: DeltaState) -> _Stats:
     # pingability, included for the ring-ish server count); per base
     # row in sided mode ([G] totals gathered by each viewer's side)
     if state.side is None:
-        p_total = jnp.sum(state.bp_mask, dtype=jnp.int32)
+        p_total = bitpack.popcount_bits(state.bp_mask)
     else:
-        p_total = jnp.sum(state.bp_mask, axis=1, dtype=jnp.int32)[state.side]
+        p_total = bitpack.popcount_bits(state.bp_mask, axis=1)[state.side]
     corr = jnp.sum(ping_now.astype(jnp.int32) - ping_base.astype(jnp.int32), axis=1)
     own_pos, own_found = _lookup_pos(state.d_subj, ids)
     own_key = jnp.where(
@@ -1043,72 +1061,10 @@ def _merge_claims(
 
     free = cap - jnp.sum(stats_live.astype(jnp.int32), axis=1)
 
-    def do_insert(st: DeltaState) -> tuple[DeltaState, jax.Array]:
-        # drop insertions beyond each row's free slots (claims lost =
-        # packet loss semantics; counted).  Order: self first, then
-        # subject order — deterministic.
-        order_rank = jnp.cumsum(ins.astype(jnp.int32), axis=1) - ins.astype(jnp.int32)
-        order_rank = order_rank + self_ins.astype(jnp.int32)[:, None]
-        keep = ins & (order_rank < free[:, None])
-        keep_self = self_ins & (free > 0)
-        dropped = jnp.sum(ins & ~keep, dtype=jnp.int32) + jnp.sum(
-            self_ins & ~keep_self, dtype=jnp.int32
-        )
-
-        ins_key = jnp.where(keep, c_key, 0)
-        ins_status = ins_key & 7
-        ins_pb = jnp.where(keep, jnp.int8(0), jnp.int8(-1))
-        ins_sl = jnp.where(
-            keep & (ins_status == SUSPECT), jnp.int8(sl_start), jnp.int8(-1)
-        )
-        ins_subj = jnp.where(keep, c_subj, SENTINEL)
-
-        # self insertion rides as one extra column
-        ins_subj = jnp.concatenate(
-            [ins_subj, jnp.where(keep_self, ids, SENTINEL)[:, None]], axis=1
-        )
-        ins_key = jnp.concatenate(
-            [ins_key, jnp.where(keep_self, new_self_key, 0)[:, None]], axis=1
-        )
-        ins_pb = jnp.concatenate(
-            [ins_pb, jnp.where(keep_self, jnp.int8(0), jnp.int8(-1))[:, None]], axis=1
-        )
-        ins_sl = jnp.concatenate(
-            [ins_sl, jnp.full((n, 1), -1, jnp.int8)], axis=1
-        )
-
-        # sorted merge: concat + argsort by subject (stable keeps
-        # existing-before-inserted for equal subjects, which cannot
-        # happen for live slots anyway), slice back to capacity —
-        # the tail is all SENTINEL because insertions fit in ``free``.
-        m_subj = jnp.concatenate([st.d_subj, ins_subj], axis=1)
-        m_key = jnp.concatenate([st.d_key, ins_key], axis=1)
-        m_pb = jnp.concatenate([st.d_pb, ins_pb], axis=1)
-        m_sl = jnp.concatenate([st.d_sl, ins_sl], axis=1)
-        order = jnp.argsort(m_subj, axis=1)
-        m_subj = jnp.take_along_axis(m_subj, order, axis=1)[:, :cap]
-        m_key = jnp.take_along_axis(m_key, order, axis=1)[:, :cap]
-        m_pb = jnp.take_along_axis(m_pb, order, axis=1)[:, :cap]
-        m_sl = jnp.take_along_axis(m_sl, order, axis=1)[:, :cap]
-        if st.d_bpmask is not None:
-            # carried base-pingability snapshots: gather at the KEPT
-            # inserted subjects only ([N, K+1]-sized, inside this cond)
-            # and ride the same reorder as the tables
-            bpm_new = jnp.where(keep, state.bp_mask_at(subj_q), False)
-            bpr_new = jnp.where(keep, state.bp_rank_at(subj_q), 0)
-            bpm_self = keep_self & state.bp_mask_at(ids)
-            bpr_self = jnp.where(keep_self, state.bp_rank_at(ids), 0)
-            m_bpm = jnp.concatenate(
-                [st.d_bpmask, bpm_new, bpm_self[:, None]], axis=1
-            )
-            m_bpr = jnp.concatenate(
-                [st.d_bprank, bpr_new, bpr_self[:, None]], axis=1
-            )
-            m_bpm = jnp.take_along_axis(m_bpm, order, axis=1)[:, :cap]
-            m_bpr = jnp.take_along_axis(m_bpr, order, axis=1)[:, :cap]
-        else:
-            m_bpm = None
-            m_bpr = None
+    def _insert_tail(st, m_subj, m_key, m_pb, m_sl, m_bpm, m_bpr,
+                     keep, keep_self, dropped):
+        """Digest update + state replace shared by both insert-merge
+        lowerings (the digest reads pre-merge quantities only)."""
         if st.digest is not None:
             # KEPT insertions only (dropped claims never reach the
             # table); the old view value at a not-found subject is its
@@ -1141,6 +1097,123 @@ def _merge_claims(
             ),
             dropped,
         )
+
+    def do_insert(st: DeltaState) -> tuple[DeltaState, jax.Array]:
+        # drop insertions beyond each row's free slots (claims lost =
+        # packet loss semantics; counted).  Order: self first, then
+        # subject order — deterministic.
+        order_rank = jnp.cumsum(ins.astype(jnp.int32), axis=1) - ins.astype(jnp.int32)
+        order_rank = order_rank + self_ins.astype(jnp.int32)[:, None]
+        keep = ins & (order_rank < free[:, None])
+        keep_self = self_ins & (free > 0)
+        dropped = jnp.sum(ins & ~keep, dtype=jnp.int32) + jnp.sum(
+            self_ins & ~keep_self, dtype=jnp.int32
+        )
+
+        ins_key = jnp.where(keep, c_key, 0)
+        ins_subj = jnp.where(keep, c_subj, SENTINEL)
+
+        # self insertion rides as one extra column (pb/sl are
+        # recomputed at the merged output below, so only subj/key ride)
+        ins_subj = jnp.concatenate(
+            [ins_subj, jnp.where(keep_self, ids, SENTINEL)[:, None]], axis=1
+        )
+        ins_key = jnp.concatenate(
+            [ins_key, jnp.where(keep_self, new_self_key, 0)[:, None]], axis=1
+        )
+
+        # sorted merge WITHOUT the [N, C+K+1] concat + argsort the r05
+        # census blamed for the flagship's biggest temp class: sort only
+        # the [N, K+1] insert list, locate each insert's merged position
+        # by binary search, and invert the merge per output slot with
+        # two [N, C]-wide gathers.  Existing-vs-inserted subject ties
+        # cannot happen (``ins`` requires ~found, ``self_ins`` requires
+        # ~has_self_slot), and insertions fit in ``free``, so the
+        # interleave is a plain two-sorted-sequence merge; SENTINEL
+        # pads of both sequences carry identical payloads, so tie order
+        # among pads is irrelevant.
+        s_ins_subj, s_ins_key = jax.lax.sort((ins_subj, ins_key), num_keys=1)
+        ki = s_ins_subj.shape[1]  # K + 1
+        if _MERGE_METHOD == "pallas" and st.d_bpmask is None:
+            # fused VMEM merge (the carried-slot-base planes keep the
+            # sorted lowering: their payloads need state-level lookups
+            # the standalone kernel deliberately does not know about)
+            from ringpop_tpu.ops.delta_merge_pallas import merge_insert_pallas
+
+            m_subj, m_key, m_pb, m_sl = merge_insert_pallas(
+                st.d_subj, st.d_key, st.d_pb, st.d_sl,
+                s_ins_subj, s_ins_key,
+                sl_start=int(sl_start), suspect=SUSPECT,
+                interpret=jax.default_backend() != "tpu",
+            )
+            m_bpm = None
+            m_bpr = None
+            return _insert_tail(st, m_subj, m_key, m_pb, m_sl,
+                                m_bpm, m_bpr, keep, keep_self, dropped)
+        # merged position of insert k: k existing-inserts before it plus
+        # the existing live slots with a smaller subject.  SENTINEL tail
+        # entries land at live_count + k >= every occupied output slot,
+        # and the sequence stays strictly increasing, so the position
+        # search below never selects them for an occupied j.
+        pos_ins = _row_searchsorted(st.d_subj, s_ins_subj) + jnp.arange(
+            ki, dtype=jnp.int32
+        )
+        out_j = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32), (n, cap))
+        e = _row_searchsorted(pos_ins, out_j)  # inserts before slot j
+        e_c = jnp.minimum(e, ki - 1)
+        is_ins = jnp.take_along_axis(pos_ins, e_c, axis=1) == out_j
+        x = jnp.minimum(out_j - e, cap - 1)  # existing slot feeding j
+        m_subj = jnp.where(
+            is_ins,
+            jnp.take_along_axis(s_ins_subj, e_c, axis=1),
+            jnp.take_along_axis(st.d_subj, x, axis=1),
+        )
+        m_key = jnp.where(
+            is_ins,
+            jnp.take_along_axis(s_ins_key, e_c, axis=1),
+            jnp.take_along_axis(st.d_key, x, axis=1),
+        )
+        # inserted pb/sl are pure functions of validity + key (pb 0,
+        # sl only for fresh suspects; the self column's key is ALIVE),
+        # so they are recomputed at the output instead of sorted along
+        ins_at_j = is_ins & (m_subj < SENTINEL)
+        m_pb = jnp.where(
+            is_ins,
+            jnp.where(ins_at_j, jnp.int8(0), jnp.int8(-1)),
+            jnp.take_along_axis(st.d_pb, x, axis=1),
+        )
+        m_sl = jnp.where(
+            is_ins,
+            jnp.where(
+                ins_at_j & ((m_key & 7) == SUSPECT),
+                jnp.int8(sl_start),
+                jnp.int8(-1),
+            ),
+            jnp.take_along_axis(st.d_sl, x, axis=1),
+        )
+        if st.d_bpmask is not None:
+            # carried base-pingability snapshots: recomputed at the
+            # inserted subjects (base structs are merge-invariant),
+            # gathered through the same merge inversion for the rest
+            m_subj_safe = jnp.where(ins_at_j, m_subj, 0)
+            m_bpm = jnp.where(
+                is_ins,
+                ins_at_j & state.bp_mask_at(m_subj_safe),
+                jnp.take_along_axis(
+                    bitpack.unpack_bits(st.d_bpmask, cap), x, axis=1
+                ),
+            )
+            m_bpm = bitpack.pack_bits(m_bpm)
+            m_bpr = jnp.where(
+                is_ins,
+                jnp.where(ins_at_j, state.bp_rank_at(m_subj_safe), 0),
+                jnp.take_along_axis(st.d_bprank, x, axis=1),
+            )
+        else:
+            m_bpm = None
+            m_bpr = None
+        return _insert_tail(st, m_subj, m_key, m_pb, m_sl, m_bpm, m_bpr,
+                            keep, keep_self, dropped)
 
     def no_insert(st: DeltaState) -> tuple[DeltaState, jax.Array]:
         return st, jnp.int32(0)
@@ -2192,27 +2265,21 @@ def _sort_claim_rows(
     mixed sources — ack + full-sync lists — may repeat a subject)."""
     subj = jnp.where(valid, subj, SENTINEL)
     key = jnp.where(valid, key, 0)
-    order = jnp.argsort(subj, axis=1)
-    subj = jnp.take_along_axis(subj, order, axis=1)
-    key = jnp.take_along_axis(key, order, axis=1)
+    # Two-key sort (subject asc, key DESC via negation — view keys are
+    # non-negative) puts each subject run's lattice max in its first
+    # slot, so the dedup is one elementwise compare against the left
+    # neighbor instead of the former argsort + gathers + log2(kk)
+    # shift-combine passes (each materializing two padded [N, kk]
+    # temporaries — a top flagship temp in the r05 census).
     kk = subj.shape[1]
-    shift = 1
-    while shift < kk:
-        nxt_subj = jnp.pad(subj, ((0, 0), (0, shift)), constant_values=SENTINEL)[
-            :, shift:
-        ]
-        nxt_key = jnp.pad(key, ((0, 0), (0, shift)), constant_values=0)[:, shift:]
-        key = jnp.where(nxt_subj == subj, jnp.maximum(key, nxt_key), key)
-        shift *= 2
+    subj, neg_key = jax.lax.sort((subj, -key), num_keys=2)
     first = jnp.pad(subj, ((0, 0), (1, 0)), constant_values=-1)[:, :kk] != subj
     valid = first & (subj < SENTINEL)
     subj = jnp.where(valid, subj, SENTINEL)
-    key = jnp.where(valid, key, 0)
+    key = jnp.where(valid, -neg_key, 0)
     # Re-pack (see _route_claims): dedup holes break the sortedness that
     # _merge_claims' binary search relies on.
-    order = jnp.argsort(subj, axis=1)
-    subj = jnp.take_along_axis(subj, order, axis=1)
-    key = jnp.take_along_axis(key, order, axis=1)
+    subj, key = jax.lax.sort((subj, key), num_keys=1)
     return subj, key, subj < SENTINEL
 
 
@@ -2362,8 +2429,16 @@ def compact(state: DeltaState) -> DeltaState:
         # the carried slot-base snapshots just ride the reorder
         d_bpmask=None
         if state.d_bpmask is None
-        else jnp.take_along_axis(
-            jnp.where(needed, state.d_bpmask, False), order, axis=1
+        else bitpack.pack_bits(
+            jnp.take_along_axis(
+                jnp.where(
+                    needed,
+                    bitpack.unpack_bits(state.d_bpmask, state.capacity),
+                    False,
+                ),
+                order,
+                axis=1,
+            )
         ),
         d_bprank=None
         if state.d_bprank is None

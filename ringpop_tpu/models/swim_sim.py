@@ -282,7 +282,7 @@ class NetState(NamedTuple):
     link_p: jax.Array | None = None  # float32[K]
     link_d: jax.Array | None = None  # int32[K]
     link_j: jax.Array | None = None  # int32[K]
-    period: jax.Array | None = None  # int32[N]
+    period: jax.Array | None = None  # int16[N] | int32[N] (scan carries int16)
     # Load-coupled gray degradation (scenarios/faults.OverloadConfig;
     # None unless an ``overload`` scenario ran/is running): the
     # per-node overload pressure counter accumulated from serve-plane

@@ -1,0 +1,96 @@
+"""Bit-packed boolean planes for the delta backend's at-rest masks.
+
+The delta ``DeltaState`` carries several boolean lattice planes —
+``bp_mask`` (base-protocol liveness, ``bool[N]`` per base row) and the
+optional carried slot-base snapshot ``d_bpmask`` (``bool[N, C]``) — at
+one byte per element in HBM, the scan carry, and checkpoint v5
+tensors.  This module packs them 32 bits to a ``uint32`` word at rest
+(an 8x footprint cut per plane) and provides the three access shapes
+the consuming sites actually need, so unpacking stays lazy and local:
+
+* ``unpack_bits``   — full-plane expansion where a site genuinely
+  consumes the whole mask (phase-0 ``ping_base``, insert reorders);
+* ``bit_gather``    — point lookups ``mask[idx]`` without expanding
+  anything (``bp_mask_at``: one word gather + shift per query);
+* ``popcount_bits`` — set-bit totals (phase-0 ``p_total``) straight
+  off the words via ``lax.population_count``.
+
+Layout convention (pinned by tests/test_bitpack.py): the plane is
+packed along its LAST axis, bit ``j`` of word ``i`` holds element
+``i * 32 + j`` (little-endian within the word), and a ragged tail
+(``length % 32 != 0``) pads with zero bits — so ``popcount_bits``
+needs no tail masking and packed planes compare equal iff the
+underlying masks do.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Bits per packed word.  uint32 (not uint8) keeps the packed planes in
+# the 4-byte lane granularity TPUs natively tile, and one word covers a
+# whole claim-capacity row (C = 64 -> 2 words).
+WORD_BITS = 32
+
+
+def packed_width(length: int) -> int:
+    """Number of uint32 words covering ``length`` bits."""
+    return -(-length // WORD_BITS)
+
+
+def pack_bits(mask: jax.Array) -> jax.Array:
+    """bool[..., L] -> uint32[..., ceil(L/32)] along the last axis.
+
+    Pad bits (beyond L in the final word) are zero.
+    """
+    length = mask.shape[-1]
+    words = packed_width(length)
+    pad = words * WORD_BITS - length
+    bits = mask.astype(jnp.uint32)
+    if pad:
+        bits = jnp.pad(
+            bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)]
+        )
+    bits = bits.reshape(*mask.shape[:-1], words, WORD_BITS)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    return jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(packed: jax.Array, length: int) -> jax.Array:
+    """uint32[..., W] -> bool[..., length] (inverse of pack_bits)."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (packed[..., None] >> shifts) & jnp.uint32(1)
+    bits = bits.reshape(*packed.shape[:-1], packed.shape[-1] * WORD_BITS)
+    return bits[..., :length].astype(bool)
+
+
+def bit_gather(
+    packed: jax.Array, idx: jax.Array, row: jax.Array | None = None
+) -> jax.Array:
+    """Point lookups ``mask[idx]`` / ``mask[row, idx]`` on a packed plane.
+
+    ``packed`` is uint32[W] (or uint32[G, W] with ``row`` an int array
+    broadcastable against ``idx`` selecting the leading axis — the
+    sided-plane form); ``idx`` int[...] indexes the unpacked last axis:
+
+        bit_gather(p, q)        ==  mask[q]        (p = pack_bits(mask))
+        bit_gather(p, q, s)     ==  mask[s, q]     (sided planes)
+
+    ``idx`` may be any shape; out-of-range indices follow jnp's gather
+    clamping (callers pass pre-clamped "safe" indices, same contract as
+    the unpacked ``mask[q]`` form).
+    """
+    if row is None:
+        word = packed[idx >> 5]
+    else:
+        word = packed[row, idx >> 5]
+    bit = (word >> (idx & 31).astype(jnp.uint32)) & jnp.uint32(1)
+    return bit.astype(bool)
+
+
+def popcount_bits(packed: jax.Array, axis=None, dtype=jnp.int32) -> jax.Array:
+    """Total set bits of a packed plane (pad bits are zero by layout)."""
+    return jnp.sum(
+        jax.lax.population_count(packed).astype(dtype), axis=axis, dtype=dtype
+    )

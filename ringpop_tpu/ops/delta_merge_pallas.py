@@ -1,0 +1,175 @@
+"""Pallas TPU kernel: fused sorted-insert merge for the delta tables.
+
+The delta backend's hottest structural op is ``_merge_claims``'s
+insert step: each viewer row folds its sorted [K+1] insert list into
+its sorted [C] divergence table.  The XLA lowerings each
+over-materialize at n=65,536:
+
+* concat + argsort (pre-r06): a [N, C+K+1] two-key sort — the biggest
+  temp class the r05 census blamed for the flagship's derived peak;
+* searchsorted + gathers (the r06 default, ``sorted``): no concat, but
+  still ~6 [N, C]-wide gather temps between HBM round trips.
+
+This kernel streams row blocks through VMEM once (the PR 1
+``recv_merge_pallas`` shape): each grid step loads a [RB, C] tile of
+the four table channels plus the row's [RB, K+1] insert list, computes
+the merge inversion entirely in registers/VMEM, and writes each output
+channel exactly once.  The merge math is the gather path's, re-expressed
+gather-free so Mosaic can lower it:
+
+* insert k's merged position ``pos_k = k + |{j: d_subj[j] < ins[k]}|``
+  (a compare-reduce per k — K+1 VPU passes over the tile);
+* ``e[j] = |{k: pos_k < j}|`` accumulates over the same loop;
+* the insert-side payload at slot j is a masked select over k
+  (``pos_k == j`` fires for at most one k);
+* the existing-side payload is ``channel[j - e[j]]``, a select over the
+  static shift distance ``s = e[j] <= K+1`` of lane-rolled tiles —
+  rolls replace the data-dependent gather (wrapped lanes land only at
+  ``j < s``, which ``e <= j`` proves unselectable).
+
+Inserted pb/sl are pure functions of the merged key (pb 0; sl only for
+fresh suspects), recomputed in-kernel, so only subj/key ride the insert
+list.  Bit-parity with the ``sorted`` path is pinned by
+tests/test_swim_delta.py's merge-method grid (plain and streamed);
+``interpret=True`` runs the same program on every non-TPU backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ringpop_tpu.obs import annotate
+
+# int32 lattice-key pad for empty slots (swim_delta.SENTINEL — kept
+# numerically identical here so the kernel stays import-cycle-free)
+SENTINEL = jnp.iinfo(jnp.int32).max
+
+# Rows per grid step.  VMEM cost ~ RB * (4C + 2(K+1)) int32 in + 4C
+# out; at RB=256, C=256, K=64 that is ~1.4 MB — well inside one core.
+ROW_BLOCK = 256
+
+
+def _pick_row_block(n: int) -> int:
+    """Largest power-of-two divisor of n up to ROW_BLOCK (no pad copy;
+    delta fixtures are power-of-two-heavy, odd n degrades to 1 block)."""
+    rb = ROW_BLOCK
+    while rb > 1 and n % rb:
+        rb //= 2
+    return rb
+
+
+def _kernel(ki, cap, sl_start, suspect,
+            dsub_ref, dkey_ref, dpb_ref, dsl_ref, isub_ref, ikey_ref,
+            osub_ref, okey_ref, opb_ref, osl_ref):
+    dsub = dsub_ref[...]
+    dkey = dkey_ref[...]
+    dpb = dpb_ref[...].astype(jnp.int32)
+    dsl = dsl_ref[...].astype(jnp.int32)
+    isub = isub_ref[...]
+    ikey = ikey_ref[...]
+
+    out_j = jax.lax.broadcasted_iota(jnp.int32, dsub.shape, 1)
+    # pass 1: merged insert positions; e[j] = inserts landing before j
+    e = jnp.zeros(dsub.shape, jnp.int32)
+    pos = []
+    for k in range(ki):
+        pos_k = jnp.sum(
+            (dsub < isub[:, k:k + 1]).astype(jnp.int32),
+            axis=1, keepdims=True,
+        ) + k
+        pos.append(pos_k)
+        e = e + (pos_k < out_j).astype(jnp.int32)
+    # pass 2: insert-side payload — pos_k == j fires for at most one k
+    # (positions are strictly increasing in k)
+    is_ins = jnp.zeros(dsub.shape, bool)
+    m_isub = jnp.zeros(dsub.shape, jnp.int32)
+    m_ikey = jnp.zeros(dsub.shape, jnp.int32)
+    for k in range(ki):
+        sel = pos[k] == out_j
+        is_ins = is_ins | sel
+        m_isub = jnp.where(sel, isub[:, k:k + 1], m_isub)
+        m_ikey = jnp.where(sel, ikey[:, k:k + 1], m_ikey)
+    # pass 3: existing-side payload channel[j - e] via static lane
+    # rolls selected on the shift distance (e <= min(j, ki))
+    m_dsub = dsub
+    m_dkey = dkey
+    m_dpb = dpb
+    m_dsl = dsl
+    for s in range(1, min(ki, cap - 1) + 1):
+        sel = e == s
+        m_dsub = jnp.where(sel, jnp.roll(dsub, s, axis=1), m_dsub)
+        m_dkey = jnp.where(sel, jnp.roll(dkey, s, axis=1), m_dkey)
+        m_dpb = jnp.where(sel, jnp.roll(dpb, s, axis=1), m_dpb)
+        m_dsl = jnp.where(sel, jnp.roll(dsl, s, axis=1), m_dsl)
+
+    m_subj = jnp.where(is_ins, m_isub, m_dsub)
+    m_key = jnp.where(is_ins, m_ikey, m_dkey)
+    ins_at_j = is_ins & (m_subj < SENTINEL)
+    m_pb = jnp.where(
+        is_ins, jnp.where(ins_at_j, 0, -1), m_dpb
+    )
+    m_sl = jnp.where(
+        is_ins,
+        jnp.where(ins_at_j & ((m_key & 7) == suspect), sl_start, -1),
+        m_dsl,
+    )
+    osub_ref[...] = m_subj
+    okey_ref[...] = m_key
+    opb_ref[...] = m_pb.astype(jnp.int8)
+    osl_ref[...] = m_sl.astype(jnp.int8)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sl_start", "suspect", "interpret")
+)
+@annotate.scoped("delta.merge_insert_pallas")
+def merge_insert_pallas(
+    d_subj: jax.Array,
+    d_key: jax.Array,
+    d_pb: jax.Array,
+    d_sl: jax.Array,
+    ins_subj: jax.Array,
+    ins_key: jax.Array,
+    *,
+    sl_start: int,
+    suspect: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Merged (subj, key, pb, sl) [N, C] tables: each row's sorted
+    insert list (SENTINEL-padded, subjects disjoint from the row's
+    live slots) folded into its sorted table — bit-identical to
+    ``swim_delta._merge_claims``'s sorted lowering."""
+    n, cap = d_subj.shape
+    ki = ins_subj.shape[1]
+    rb = _pick_row_block(n)
+    row = lambda i: (i, 0)  # noqa: E731 — one-line index map
+    out = pl.pallas_call(
+        functools.partial(_kernel, ki, cap, sl_start, suspect),
+        grid=(n // rb,),
+        in_specs=[
+            pl.BlockSpec((rb, cap), row),
+            pl.BlockSpec((rb, cap), row),
+            pl.BlockSpec((rb, cap), row),
+            pl.BlockSpec((rb, cap), row),
+            pl.BlockSpec((rb, ki), row),
+            pl.BlockSpec((rb, ki), row),
+        ],
+        out_specs=[
+            pl.BlockSpec((rb, cap), row),
+            pl.BlockSpec((rb, cap), row),
+            pl.BlockSpec((rb, cap), row),
+            pl.BlockSpec((rb, cap), row),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, cap), jnp.int32),
+            jax.ShapeDtypeStruct((n, cap), jnp.int32),
+            jax.ShapeDtypeStruct((n, cap), jnp.int8),
+            jax.ShapeDtypeStruct((n, cap), jnp.int8),
+        ],
+        interpret=interpret,
+    )(d_subj, d_key, d_pb, d_sl, ins_subj, ins_key)
+    return tuple(out)

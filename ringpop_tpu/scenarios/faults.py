@@ -75,7 +75,7 @@ class FaultTensors(NamedTuple):
     lr_d: jax.Array | None  # int32[K] | None (no delay rules)
     lr_j: jax.Array | None  # int32[K] | None
     pe_tick: jax.Array  # int32[G] period-switch ticks
-    pe_row: jax.Array  # int32[G, N] per-node period rows
+    pe_row: jax.Array  # int16[G, N] per-node period rows (narrowed carry)
 
 
 class OverloadConfig(NamedTuple):
@@ -282,12 +282,26 @@ def compile_faults(spec: ScenarioSpec, n: int) -> FaultTensors | None:
         pe_tick=jnp.asarray(
             np.array([t for t, _ in switches], dtype=np.int32)
         ),
-        pe_row=jnp.asarray(
-            np.stack([row for _, row in switches])
-            if switches
-            else np.zeros((0, n), np.int32)
-        ),
+        pe_row=jnp.asarray(_narrow_period_rows(switches, n)),
     )
+
+
+def _narrow_period_rows(switches, n: int) -> np.ndarray:
+    """Period-switch rows in the scan carry's int16 form (periods are
+    small tick multipliers; the range check is host-side and loud —
+    runner.prepare_faults applies the same narrowing to the standing
+    row)."""
+    rows = (
+        np.stack([row for _, row in switches])
+        if switches
+        else np.zeros((0, n), np.int32)
+    )
+    if rows.size and rows.max() > np.iinfo(np.int16).max:
+        raise ValueError(
+            f"set_period row value {rows.max()} exceeds the int16 "
+            "carry range"
+        )
+    return rows.astype(np.int16)
 
 
 class HostPlan:

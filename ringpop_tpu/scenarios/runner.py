@@ -31,6 +31,7 @@ import numpy as np
 
 from ringpop_tpu.models import swim_delta as sdelta
 from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.ops import bitpack
 from ringpop_tpu.models.swim_delta import DeltaParams, DeltaState
 from ringpop_tpu.obs.ledger import default_ledger
 from ringpop_tpu.models.swim_sim import NetState, SwimParams
@@ -288,7 +289,13 @@ def _scenario_scan_impl(
     oob = jnp.int32(n)  # masked events scatter out of bounds -> dropped
 
     def body(carry, xs):
-        st, u, r, gid, per, ovc = carry
+        # node-bit planes ride the carry bit-packed (uint32 words, 1
+        # bit/node); all in-tick work runs on the unpacked bool form
+        st, pu, pr, gid, per, ovc = carry
+        u = bitpack.unpack_bits(pu, n)
+        r = bitpack.unpack_bits(pr, n)
+        if overload is not None:
+            ovc = (ovc[0], bitpack.unpack_bits(ovc[1], n))
         t, key, loss_t = xs
         if ev_tick.shape[0]:
             m = ev_tick == t
@@ -331,11 +338,14 @@ def _scenario_scan_impl(
         # THIS tick — for its protocol step and its serve duty phase
         # alike — so retry pressure causes gray and gray attracts the
         # retries the latency plane's duty timeouts generate
-        per_eff = per
+        # the carry holds the period row int16 (periods are small tick
+        # multipliers; prepare_faults validates the range) — consumers
+        # see the historical int32 form
+        per_eff = None if per is None else per.astype(jnp.int32)
         if overload is not None:
             ov_cnt, ov_fl = ovc
             per_eff = jnp.where(
-                ov_fl, jnp.maximum(per, jnp.int32(overload.factor)), per
+                ov_fl, jnp.maximum(per_eff, jnp.int32(overload.factor)), per_eff
             )
         net = NetState(up=u, responsive=r, adj=gid, period=per_eff, **link_kw)
         if is_delta:
@@ -388,16 +398,27 @@ def _scenario_scan_impl(
             )
             y["ov_gray_nodes"] = jnp.sum(ov_fl, dtype=jnp.int32)
             y["ov_pressure_max"] = jnp.max(ov_cnt)
-            ovc = (ov_cnt, ov_fl)
-        return (st, u, r, gid, per, ovc), y
+            ovc = (ov_cnt, bitpack.pack_bits(ov_fl))
+        return (st, bitpack.pack_bits(u), bitpack.pack_bits(r), gid, per,
+                ovc), y
 
     t_idx = jnp.arange(ticks, dtype=jnp.int32)
     if tick0 is not None:
         t_idx = t_idx + tick0
     xs = (t_idx, keys, loss)
-    (state, up, responsive, adj, period, ov), ys = jax.lax.scan(
-        body, (state, up, responsive, adj, period, ov), xs
+    ov_c = None if ov is None else (ov[0], bitpack.pack_bits(ov[1]))
+    (state, pu, pr, adj, period, ov_c), ys = jax.lax.scan(
+        body,
+        (state, bitpack.pack_bits(up), bitpack.pack_bits(responsive), adj,
+         period, ov_c),
+        xs,
     )
+    up = bitpack.unpack_bits(pu, n)
+    responsive = bitpack.unpack_bits(pr, n)
+    ov = None if ov_c is None else (ov_c[0], bitpack.unpack_bits(ov_c[1], n))
+    # period stays int16 on exit: the streamed runner threads this
+    # return straight into the next segment's dispatch, so widening
+    # here would retrace the one compiled executable
     return state, up, responsive, adj, period, ov, ys
 
 
@@ -514,7 +535,17 @@ def prepare_faults(
             )
     period = net.period
     if (compiled.has_gray or compiled.overload is not None) and period is None:
-        period = jnp.ones((compiled.n,), jnp.int32)
+        period = jnp.ones((compiled.n,), jnp.int16)
+    elif period is not None and period.dtype != jnp.int16:
+        # the scan carries the period row int16 (a narrowed slot in
+        # CARRY_BUDGETS); rows are concrete host data here, so the
+        # range check is free and loud instead of a silent wrap
+        pmax = int(np.asarray(period).max()) if period.size else 0
+        if pmax > np.iinfo(np.int16).max:
+            raise ValueError(
+                f"per-node period {pmax} exceeds the int16 carry range"
+            )
+        period = jnp.asarray(period, jnp.int16)
     ov = None
     if compiled.overload is not None:
         if net.ov_cnt is not None:

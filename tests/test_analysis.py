@@ -478,14 +478,18 @@ def test_byte_budget_pins_match_at_4096():
 
 @pytest.mark.slow
 def test_flagship_byte_budget_65536_delta():
-    # the n=65,536 delta program (the round-5 worker-killer) pins at
-    # ~903 MB derived peak; this is item 2a's progress ledger — a PR
-    # that shrinks it re-pins DOWN, a PR that grows it fails here
+    # the n=65,536 delta program (the round-5 worker-killer) pinned at
+    # ~903 MB derived peak through r05; the r06 pass re-pinned it at
+    # ~576 MB (-36.2%).  This is item 2a's progress ledger — a PR that
+    # shrinks it re-pins DOWN, a PR that grows it fails here — and the
+    # pin itself may never crawl back above the item 2a target
+    # (<= ~632 MB, i.e. >= 30% below the pre-r06 902,967,088)
     report = audit_entry("run_scenario", "delta", n=65536, ticks=4)
     bad = [f for f in report.findings
            if f.severity in ("warning", "error")]
     assert bad == [], [str(f) for f in bad]
     pinned = budgets.BYTE_BUDGETS[("run_scenario", "delta", 65536)]
+    assert pinned["peak_bytes"] <= int(902_967_088 * 0.70)
     assert report.mem_bytes["peak_bytes"] <= pinned["peak_bytes"] * (
         1 + budgets.BYTE_TOLERANCE
     )

@@ -1,0 +1,67 @@
+"""Parent-side bench.py contracts, testable without any backend.
+
+bench.py's parent process never imports jax, so these pins run in
+milliseconds: the worker-crash signature that stops the TPU climb
+(ADVICE round 5 — anchored to the runtime's own error text, not bare
+substring matches), the ``+stream`` segment-dispatch plan of the
+banked rungs, and the attempt-string protocol between parent and
+child.
+"""
+
+from __future__ import annotations
+
+import bench
+
+
+def test_worker_crash_signature_positive():
+    # the round-5 failure text, verbatim and embedded mid-stderr
+    assert bench._is_worker_crash(
+        "UNAVAILABLE: TPU worker process crashed or restarted"
+    )
+    assert bench._is_worker_crash(
+        "blah\n... UNAVAILABLE: TPU worker exited ...\ntail"
+    )
+    assert bench._is_worker_crash("the worker process crashed hard")
+
+
+def test_worker_crash_signature_rejects_lookalikes():
+    # an unrelated UNAVAILABLE RPC or a log line with "crashed" must
+    # NOT abandon the delta climb and the dense safety net
+    assert not bench._is_worker_crash("UNAVAILABLE: connection reset by peer")
+    assert not bench._is_worker_crash("the child crashed with rc=1")
+    assert not bench._is_worker_crash("worker restarted cleanly")
+    assert not bench._is_worker_crash("")
+    assert not bench._is_worker_crash(None)
+
+
+def test_stream_plan_shapes():
+    # TPU batch: 100 ticks -> 4 x 25-tick segment programs
+    assert bench._stream_plan(100) == (4, 25)
+    # large-n CPU fallback batch: 20 ticks -> 4 x 5
+    assert bench._stream_plan(20) == (4, 5)
+    # degenerate batches never produce a zero-tick segment
+    assert bench._stream_plan(3) == (3, 1)
+    assert bench._stream_plan(1) == (1, 1)
+
+
+def test_tpu_ladder_banked_rungs_are_streamed():
+    rungs = list(bench.TPU_DELTA_LADDER)
+    # ascending sizes: the climb banks as it goes
+    sizes = [n for _, n in rungs]
+    assert sizes == sorted(sizes)
+    for layout, n in rungs:
+        if n < 65536:
+            # banked rungs dispatch segment-sized programs
+            assert layout.endswith("+stream"), (layout, n)
+        else:
+            # 65,536+ measure the exact program budgets.py pins
+            assert not layout.endswith("+stream"), (layout, n)
+
+
+def test_parse_attempt_streamed_layout():
+    assert bench._parse_attempt("delta@64+stream:8192") == (
+        "delta@64+stream",
+        8192,
+    )
+    assert bench._parse_attempt("delta@64:65536") == ("delta@64", 65536)
+    assert bench._parse_attempt("2048") == ("dense", 2048)

@@ -1,0 +1,110 @@
+"""Property tests for the packed-plane layout (ops/bitpack.py).
+
+The layout convention these tests pin — last-axis packing, bit j of
+word i = element i*32+j, zero pad bits on ragged tails — is what
+checkpoint v5 tensors and the pinned carry-dtype budgets rely on; a
+layout change is a format break, not a refactor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.ops import bitpack
+
+# Ragged tails (L % 32 != 0) alongside exact multiples — claim capacity
+# C=64 is the at-rest shape, the rest probe the pad-bit convention.
+LENGTHS = (1, 31, 32, 33, 64, 100, 256)
+
+
+def _cases(length: int, rng: np.random.Generator):
+    yield np.zeros((3, length), dtype=bool)
+    yield np.ones((3, length), dtype=bool)
+    yield rng.random((3, length)) < 0.5
+    yield rng.random((5, 3, length)) < 0.1  # 3-D: pend-style planes
+
+
+@pytest.mark.parametrize("length", LENGTHS)
+def test_roundtrip(length):
+    rng = np.random.default_rng(length)
+    for mask in _cases(length, rng):
+        packed = bitpack.pack_bits(jnp.asarray(mask))
+        assert packed.dtype == jnp.uint32
+        assert packed.shape == (
+            *mask.shape[:-1], bitpack.packed_width(length)
+        )
+        out = bitpack.unpack_bits(packed, length)
+        assert out.dtype == bool
+        np.testing.assert_array_equal(np.asarray(out), mask)
+
+
+@pytest.mark.parametrize("length", LENGTHS)
+def test_pad_bits_zero(length):
+    """Ragged-tail pad bits are zero: packed planes of equal masks are
+    bitwise equal, and popcount needs no tail masking."""
+    rng = np.random.default_rng(1000 + length)
+    mask = rng.random((4, length)) < 0.5
+    packed = np.asarray(bitpack.pack_bits(jnp.asarray(mask)))
+    tail = length % 32
+    if tail:
+        assert not np.any(packed[..., -1] >> tail)
+    # all-ones plane: every pad bit still zero
+    ones = np.asarray(bitpack.pack_bits(jnp.ones(length, dtype=bool)))
+    total = int(ones.astype(np.uint64).sum())
+    expect = sum(int(w) for w in _expected_ones_words(length))
+    assert total == expect
+
+
+def _expected_ones_words(length: int):
+    words = bitpack.packed_width(length)
+    for i in range(words):
+        bits = min(32, length - i * 32)
+        yield (1 << bits) - 1 if bits < 32 else 0xFFFFFFFF
+
+
+def test_bit_layout_little_endian():
+    """Bit j of word i holds element i*32 + j."""
+    mask = np.zeros(70, dtype=bool)
+    mask[0] = True     # word 0, bit 0
+    mask[33] = True    # word 1, bit 1
+    mask[69] = True    # word 2, bit 5
+    packed = np.asarray(bitpack.pack_bits(jnp.asarray(mask)))
+    assert packed.tolist() == [1, 2, 32]
+
+
+@pytest.mark.parametrize("length", (33, 64, 100))
+def test_bit_gather_matches_fancy_index(length):
+    rng = np.random.default_rng(7 * length)
+    mask = rng.random(length) < 0.5
+    packed = bitpack.pack_bits(jnp.asarray(mask))
+    idx = rng.integers(0, length, size=(6, 9))
+    got = bitpack.bit_gather(packed, jnp.asarray(idx, dtype=jnp.int32))
+    assert got.dtype == bool
+    np.testing.assert_array_equal(np.asarray(got), mask[idx])
+
+
+def test_bit_gather_sided():
+    rng = np.random.default_rng(11)
+    mask = rng.random((3, 40)) < 0.5
+    packed = bitpack.pack_bits(jnp.asarray(mask))
+    idx = rng.integers(0, 40, size=(5, 4))
+    row = rng.integers(0, 3, size=(5, 4))
+    got = bitpack.bit_gather(
+        packed, jnp.asarray(idx, dtype=jnp.int32),
+        jnp.asarray(row, dtype=jnp.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(got), mask[row, idx])
+
+
+@pytest.mark.parametrize("length", LENGTHS)
+def test_popcount(length):
+    rng = np.random.default_rng(13 * length + 1)
+    mask = rng.random((4, length)) < 0.3
+    packed = bitpack.pack_bits(jnp.asarray(mask))
+    assert int(bitpack.popcount_bits(packed)) == int(mask.sum())
+    per_row = bitpack.popcount_bits(packed, axis=-1)
+    np.testing.assert_array_equal(
+        np.asarray(per_row), mask.sum(axis=-1).astype(np.int32)
+    )

@@ -195,3 +195,64 @@ def test_load_backfills_predigest_delta_checkpoint(tmp_path):
     c.tick(4)
     c2.tick(4)
     assert c.checksums() == c2.checksums()
+
+
+def test_packed_plane_roundtrip_and_unpacked_backfill(tmp_path):
+    """v5 checkpoints store the bit-packed lattice planes (uint32 word
+    tensors under the historical names); a checkpoint written by the
+    unpacked format (bool tensors, same keys) must load with the planes
+    re-packed at load time — the .npz is self-describing by dtype, so
+    FORMAT_VERSION stays 5."""
+    from ringpop_tpu.models import swim_delta as sd
+    from ringpop_tpu.ops import bitpack
+
+    n = 16
+    c = SimCluster(
+        n, sim.SwimParams(loss=0.05), seed=5, backend="delta", capacity=8,
+        wire_cap=4, claim_grid=16,
+    )
+    c.tick(5)
+    path = tmp_path / "packed.npz"
+    checkpoint.save(c, str(path))
+
+    # the on-disk plane is the packed word tensor
+    data = dict(np.load(str(path), allow_pickle=False))
+    assert data["state.bp_mask"].dtype == np.uint32
+    assert data["state.bp_mask"].shape == (bitpack.packed_width(n),)
+
+    # packed round trip
+    c2 = checkpoint.load(str(path))
+    assert c2.state.bp_mask.dtype == np.uint32
+    np.testing.assert_array_equal(
+        np.asarray(c2.state.bp_mask), np.asarray(c.state.bp_mask)
+    )
+
+    # old unpacked checkpoint: same keys, bool tensors -> packed on load
+    unpacked = dict(data)
+    unpacked["state.bp_mask"] = np.asarray(
+        bitpack.unpack_bits(data["state.bp_mask"], n)
+    )
+    if "state.d_bpmask" in data and data["state.d_bpmask"].dtype == np.uint32:
+        unpacked["state.d_bpmask"] = np.asarray(
+            bitpack.unpack_bits(
+                data["state.d_bpmask"], c.state.capacity
+            )
+        )
+    old_path = tmp_path / "unpacked.npz"
+    np.savez_compressed(str(old_path), **unpacked)
+    c3 = checkpoint.load(str(old_path))
+    assert c3.state.bp_mask.dtype == np.uint32
+    np.testing.assert_array_equal(
+        np.asarray(c3.state.bp_mask), np.asarray(c.state.bp_mask)
+    )
+    if c3.state.d_bpmask is not None:
+        assert c3.state.d_bpmask.dtype == np.uint32
+
+    # both resumes stay bit-deterministic with the original
+    c.tick(4)
+    c2.tick(4)
+    c3.tick(4)
+    assert c.checksums() == c2.checksums() == c3.checksums()
+    np.testing.assert_array_equal(
+        np.asarray(c.state.digest), np.asarray(sd.compute_digest(c.state))
+    )

@@ -75,3 +75,32 @@ def test_delta_census_still_lowers():
     tallies, elems = hc.census_text(hc.lower_delta(1024, 64))
     assert any(k.startswith("sort") for k in tallies)
     assert sum(elems.values()) > 0
+
+
+def test_temp_rows_sort_top_and_packed_column():
+    """Fast pin of the --sort/--top/packed-dtype temp-census flags
+    (tiny fixture: a jaxpr trace, no lowering or compile)."""
+    rows = hc.annotate_packed(
+        hc.temp_rows("delta", 64, 16, min_elems=64 * 16)
+    )
+    assert rows, "tiny delta trace produced no [N, C]-class temps"
+    for row in rows:
+        assert "packed_dtype" in row and "packed_bytes_each" in row
+        if row["dtype"] == "bool":
+            assert row["packed_dtype"] == "uint32[bits]"
+            # 1 bit/element in whole uint32 words: an 8x-class cut
+            assert row["packed_bytes_each"] == -(-row["elems_each"] // 32) * 4
+            assert row["packed_bytes_each"] < row["bytes_each"]
+        else:
+            assert row["packed_bytes_each"] == row["bytes_each"]
+
+    by_bytes = hc.sort_temp_rows(rows, sort="bytes")
+    totals = [r["bytes_each"] * r["count"] for r in by_bytes]
+    assert totals == sorted(totals, reverse=True)
+
+    by_count = hc.sort_temp_rows(rows, sort="count")
+    counts = [r["count"] for r in by_count]
+    assert counts == sorted(counts, reverse=True)
+
+    k = min(3, len(rows))
+    assert hc.sort_temp_rows(rows, sort="bytes", top=k) == by_bytes[:k]

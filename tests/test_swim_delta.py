@@ -22,6 +22,7 @@ import pytest
 
 from ringpop_tpu.models import swim_delta as sd
 from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.ops import bitpack
 
 # jit without donation: tests keep references across steps
 _dense_step = jax.jit(sim.swim_step_impl, static_argnames=("params",))
@@ -741,7 +742,8 @@ def _assert_carried_fresh(st, where):
     assert (got == want).all(), f"digest drift at {where}"
     if st.d_bpmask is not None:
         bpm, bpr = sd.compute_slot_base(st)
-        assert (np.asarray(st.d_bpmask) == np.asarray(bpm)).all(), where
+        got_bpm = bitpack.unpack_bits(st.d_bpmask, st.capacity)
+        assert (np.asarray(got_bpm) == np.asarray(bpm)).all(), where
         assert (np.asarray(st.d_bprank) == np.asarray(bpr)).all(), where
 
 
@@ -787,7 +789,7 @@ def test_rolling_digest_invariant_sided_flips():
     # force the slot-base carry on regardless of env: the step must key
     # the in-cond refresh on the state (review round-5 finding)
     bpm, bpr = sd.compute_slot_base(st)
-    st = st._replace(d_bpmask=bpm, d_bprank=bpr)
+    st = st._replace(d_bpmask=bitpack.pack_bits(bpm), d_bprank=bpr)
     net = sim.make_net(n)
     key = jax.random.PRNGKey(5)
     gid = (np.arange(n) >= n // 2).astype(np.int32)
@@ -804,3 +806,110 @@ def test_rolling_digest_invariant_sided_flips():
         key, sub = jax.random.split(key)
         st, _ = sd.delta_step(st, net, sub, params)
         _assert_carried_fresh(st, f"heal tick {t}")
+
+
+# -- r06: insert-merge lowering grid + packed-plane pins ---------------------
+
+
+def _delta_trajectory(method, monkeypatch, n=24, ticks=12):
+    monkeypatch.setattr(sd, "_MERGE_METHOD", method)
+    jax.clear_caches()
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.05, suspicion_ticks=10),
+        wire_cap=8,
+        claim_grid=16,
+    )
+    st = sd.init_delta(n, capacity=24)
+    net = sim.make_net(n)._replace(up=jnp.ones(n, bool).at[5].set(False))
+    key = jax.random.PRNGKey(3)
+    out = []
+    for _ in range(ticks):
+        key, sub = jax.random.split(key)
+        st, _ = sd.delta_step(st, net, sub, params)
+        out.append(jax.tree_util.tree_map(np.asarray, st))
+    return out
+
+
+def test_merge_lowerings_bit_identical(monkeypatch):
+    """RINGPOP_DELTA_MERGE="pallas" (the fused VMEM insert-merge,
+    ops/delta_merge_pallas.py in interpret mode off-TPU) must trace the
+    exact trajectory of the default searchsorted+gather lowering —
+    every state leaf, every tick, under loss and a kill."""
+    ref = _delta_trajectory("sorted", monkeypatch)
+    got = _delta_trajectory("pallas", monkeypatch)
+    for t, (a, b) in enumerate(zip(ref, got)):
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            np.testing.assert_array_equal(la, lb, err_msg=f"tick {t}")
+
+
+def test_merge_pallas_streamed_bit_identical(monkeypatch):
+    """The merge-method grid crossed with the streamed runner: a whole
+    ``run_scenario`` under the sorted lowering == the same scenario
+    streamed in segments under the pallas lowering (final checksums +
+    trace)."""
+    from ringpop_tpu.models.cluster import SimCluster
+
+    n, ticks = 8, 8
+    spec = {"ticks": ticks, "events": [{"at": 2, "op": "kill", "node": 7}]}
+
+    def run(method, segment_ticks=None):
+        monkeypatch.setattr(sd, "_MERGE_METHOD", method)
+        jax.clear_caches()
+        c = SimCluster(
+            n, sim.SwimParams(suspicion_ticks=5), seed=3, backend="delta",
+            capacity=n, wire_cap=n, claim_grid=2 * n,
+        )
+        kw = {} if segment_ticks is None else {"segment_ticks": segment_ticks}
+        trace = c.run_scenario(spec, **kw)
+        return c, trace
+
+    a, ta = run("sorted")
+    b, tb = run("pallas", segment_ticks=4)
+    assert a.checksums() == b.checksums()
+    np.testing.assert_array_equal(ta.converged, tb.converged)
+    np.testing.assert_array_equal(ta.live, tb.live)
+    for k in ta.metrics:
+        np.testing.assert_array_equal(ta.metrics[k], tb.metrics[k], err_msg=k)
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state), jax.tree_util.tree_leaves(b.state)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_packed_scan_carry_matches_stepwise():
+    """The bit-packed lattice planes ride delta_run's lax.scan carry:
+    the scanned trajectory must equal the per-tick host loop from the
+    same key split (the packed-vs-unpacked at-rest representation can
+    not diverge through the scan boundary), and the packed base plane
+    must stay a lossless encoding of the bool oracle."""
+    n, ticks = 32, 8
+    params = sd.DeltaParams(
+        swim=sim.SwimParams(loss=0.05, suspicion_ticks=6),
+        wire_cap=8,
+        claim_grid=16,
+    )
+    st0 = sd.init_delta(n, capacity=16)
+    net = sim.make_net(n)._replace(up=jnp.ones(n, bool).at[3].set(False))
+    key = jax.random.PRNGKey(11)
+
+    scanned, _ = sd.delta_run(st0, net, key, params, ticks)
+
+    # delta_run donates its state argument — rebuild the (deterministic)
+    # initial state for the host loop
+    st = sd.init_delta(n, capacity=16)
+    for sub in jax.random.split(key, ticks):
+        st, _ = _delta_step(st, net, sub, params)
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(scanned), jax.tree_util.tree_leaves(st)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    # packed plane == bool oracle, and the packed word count is the pin
+    assert scanned.bp_mask.dtype == jnp.uint32
+    assert scanned.bp_mask.shape == (bitpack.packed_width(n),)
+    status = np.asarray(scanned.base_key) & 7
+    want = (status == sd.ALIVE) | (status == sd.SUSPECT)
+    got = np.asarray(bitpack.unpack_bits(scanned.bp_mask, n))
+    np.testing.assert_array_equal(got, want)

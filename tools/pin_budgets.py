@@ -48,13 +48,34 @@ def _carry_multiset(report) -> dict[str, int]:
     return dict(sorted(ms.items()))
 
 
+def _delta_comment(before: dict | None, after: dict) -> str:
+    """One-line before→after column for a row that is being RE-pinned
+    (absent for brand-new rows) — the machine-readable trajectory of a
+    perf PR's claim, emitted as a trailing comment so the paste itself
+    stays a valid table row."""
+    if before is None:
+        return ""
+    parts = []
+    for k in sorted(set(before) | set(after), key=str):
+        b, v = before.get(k, 0), after.get(k, 0)
+        if b == v or k == "ticks":
+            continue
+        pct = f" ({(v - b) / b:+.1%})" if b else ""
+        parts.append(f"{k} {b}->{v}{pct}")
+    return "  # was: " + "; ".join(parts) if parts else "  # unchanged"
+
+
 def pin_carry(n: int, ticks: int) -> None:
+    from ringpop_tpu.analysis import budgets
     from ringpop_tpu.analysis.contracts import audit_all
 
     print("# CARRY_BUDGETS rows (audit fixtures; shape-independent):")
     reports, _ = audit_all(n=n, ticks=ticks, compile_programs=False)
     for r in reports:
-        print(f'    ("{r.entry}", "{r.backend}"): {_carry_multiset(r)},')
+        ms = _carry_multiset(r)
+        before = budgets.CARRY_BUDGETS.get((r.entry, r.backend))
+        print(f'    ("{r.entry}", "{r.backend}"): {ms},'
+              f"{_delta_comment(before, ms)}")
 
 
 def pin_collectives(n: int, ticks: int) -> None:
@@ -73,6 +94,7 @@ def pin_collectives(n: int, ticks: int) -> None:
 
 
 def pin_bytes(n: int, ticks: int, flagship: bool) -> None:
+    from ringpop_tpu.analysis import budgets
     from ringpop_tpu.analysis.contracts import audit_entry
 
     shapes = [("run_scenario", "dense", n), ("run_scenario", "delta", n)]
@@ -82,11 +104,12 @@ def pin_bytes(n: int, ticks: int, flagship: bool) -> None:
     for entry, backend, nn in shapes:
         r = audit_entry(entry, backend, n=nn, ticks=ticks,
                         force_compile=True)
-        fields = ", ".join(
-            f'"{f}": {int(r.mem_bytes[f])}' for f in BYTE_FIELDS
-        )
+        row = {f: int(r.mem_bytes[f]) for f in BYTE_FIELDS}
+        fields = ", ".join(f'"{f}": {v}' for f, v in row.items())
+        before = budgets.BYTE_BUDGETS.get((entry, backend, nn))
         print(f'    ("{entry}", "{backend}", {nn}): '
-              f'{{"ticks": {ticks}, {fields}}},')
+              f'{{"ticks": {ticks}, {fields}}},'
+              f"{_delta_comment(before, row)}")
 
 
 def main() -> None:

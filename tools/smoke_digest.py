@@ -23,6 +23,7 @@ import numpy as np
 
 from ringpop_tpu.models import swim_delta as sd
 from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.ops import bitpack
 
 
 def check(st, where):
@@ -34,7 +35,8 @@ def check(st, where):
     )
     if st.d_bpmask is not None:  # RINGPOP_CARRY_SLOTBASE=1 states
         bpm_want, bpr_want = sd.compute_slot_base(st)
-        assert (np.asarray(st.d_bpmask) == np.asarray(bpm_want)).all(), (
+        got_bpm = bitpack.unpack_bits(st.d_bpmask, st.capacity)
+        assert (np.asarray(got_bpm) == np.asarray(bpm_want)).all(), (
             f"d_bpmask drift at {where}"
         )
         assert (np.asarray(st.d_bprank) == np.asarray(bpr_want)).all(), (
