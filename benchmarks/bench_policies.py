@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""The remediation-policy A/B tables (BASELINE.md round 9).
+
+Two measurements over the ``cascading_overload`` incident family and
+the remediation policy plane (``ringpop_tpu/policies``), all exact
+ints off ``incident_summary``:
+
+* ``--headline`` — the round-8 configuration (n=64, T=120, 512
+  keys/tick zipf, streamed segments of 32, seed 3) under every policy
+  at its default operating point, against the no-fault control arm
+  (overload feedback stripped) and the unremediated feedback arm.
+  This is the acceptance table: the winning policy must put goodput
+  within ~5% of the control's and amplification under 1.5.
+* ``--scorecards`` — every golden incident (n=16 pinned
+  configuration) under every policy: the no-regression grid proving a
+  policy does not win cascading_overload by tanking a different
+  outage (detect/heal/goodput/amplification deltas vs the bare run).
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_policies.py --headline
+    JAX_PLATFORMS=cpu python benchmarks/bench_policies.py --scorecards
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import SwimParams
+from ringpop_tpu.policies import core as pol
+from ringpop_tpu.scenarios import library as lib
+
+HEADLINE_N = 64
+HEADLINE_SEED = 3
+SEGMENT = 32
+
+
+def _delta_kw(n: int) -> dict:
+    return dict(capacity=n, wire_cap=n, claim_grid=3 * n * n)
+
+
+def _run(n, seed, backend, spec, wl, policy):
+    kw = {} if backend == "dense" else _delta_kw(n)
+    c = SimCluster(n, SwimParams(), seed=seed, backend=backend, **kw)
+    trace = c.run_scenario(
+        spec, traffic=wl, segment_ticks=min(SEGMENT, spec.ticks),
+        policy=policy,
+    )
+    return lib.incident_summary(trace)
+
+
+def _row(s):
+    goodput = s["delivered"] / max(s["lookups"], 1)
+    amp = s["sends"] / max(s["delivered"], 1)
+    return goodput, amp
+
+
+def headline() -> None:
+    spec, wl = lib.build_incident("cascading_overload", HEADLINE_N)
+    spec_ctl, _ = lib.build_incident(
+        "cascading_overload", HEADLINE_N, overload=False
+    )
+    arms = [("control", "dense", spec_ctl, None),
+            ("feedback", "dense", spec, None)]
+    for p in pol.list_policies():
+        arms.append((p, "dense", spec, p))
+    arms += [("feedback", "delta", spec, None),
+             ("combined", "delta", spec, "combined")]
+    print("| backend | arm | goodput | amplification | lat p99 ms "
+          "| gray timeouts | failed | peak gray | shed | peak quar "
+          "| cap min |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|")
+    for name, backend, sp, policy in arms:
+        t0 = time.time()
+        s = _run(HEADLINE_N, HEADLINE_SEED, backend, sp, wl, policy)
+        goodput, amp = _row(s)
+        gray = s.get("ov_gray_peak", 0)
+        shed = s.get("policy_shed", "—")
+        quar = s.get("policy_quar_peak", "—")
+        capm = s.get("policy_retry_cap_min", "—")
+        print(f"| {backend} | {name} | {goodput:.3f} | {amp:.2f} "
+              f"| {s['lat_p99_ms']} | {s['gray_timeouts']} "
+              f"| {s['proxy_failed']} | {gray}/{HEADLINE_N} | {shed} "
+              f"| {quar} | {capm} |   ({time.time() - t0:.0f}s)")
+
+
+def scorecards() -> None:
+    policies = pol.list_policies()
+    print("| incident | arm | detect | heal | goodput | amplification "
+          "| gray timeouts |")
+    print("|---|---|---|---|---|---|---|")
+    for name in lib.incident_names():
+        for policy in [None] + policies:
+            if policy is not None and "dense" not in lib.INCIDENTS[name].backends:
+                continue
+            s = lib.run_golden(name, "dense", policy=policy)
+            goodput, amp = _row(s)
+            print(f"| {name} | {policy or 'bare'} | {s['detect_tick']} "
+                  f"| {s['heal_tick']} | {goodput:.3f} | {amp:.2f} "
+                  f"| {s['gray_timeouts']} |")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--headline", action="store_true")
+    ap.add_argument("--scorecards", action="store_true")
+    args = ap.parse_args()
+    if args.headline:
+        headline()
+    if args.scorecards:
+        scorecards()
+    if not (args.headline or args.scorecards):
+        ap.error("pick --headline and/or --scorecards")
+
+
+if __name__ == "__main__":
+    main()

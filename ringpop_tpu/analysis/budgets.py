@@ -67,6 +67,14 @@ CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
                                          "uint32": 3},
     ("run_scenario+incident", "delta"): {"int16": 1, "int32": 9, "int8": 2,
                                          "uint32": 5},
+    # the policy shape adds the remediation carry on top of the
+    # incident rows: pressure + amp windows + retry cap (4 x int32)
+    # and the bit-packed shed/quarantine planes (2 x uint32) —
+    # bools never ride the carry unpacked (the PR 16 packing rule)
+    ("run_scenario+policy", "dense"): {"int16": 1, "int32": 8, "int8": 2,
+                                       "uint32": 5},
+    ("run_scenario+policy", "delta"): {"int16": 1, "int32": 13, "int8": 2,
+                                       "uint32": 7},
     ("run_sweep", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
     ("run_sweep", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
     ("recv_merge_pallas", "dense"): {"int32": 2},

@@ -291,6 +291,102 @@ def _build_incident_scenario(backend: str, *, n: int, ticks: int,
     )
 
 
+def _build_policy_scenario(backend: str, *, n: int, ticks: int,
+                           capacity: int, latency_buckets: int = 8) -> Built:
+    """run_scenario's jitted scan in its POLICY shape: the incident
+    fixture plus the remediation policy carry (pressure meter, packed
+    shed/quarantine planes, amp windows, retry cap) and its traced
+    knob scalars — the widest carry the scan ships, audited so a knob
+    can never silently become a compile-time static again."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.models import checksum as cksum
+    from ringpop_tpu.policies import core as pol
+    from ringpop_tpu.scenarios import runner
+    from ringpop_tpu.scenarios.compile import compile_spec
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
+    from ringpop_tpu.traffic.workloads import compile_traffic
+
+    import jax
+
+    if backend == "delta":
+        state, net, params = _delta_fixture(n, capacity)
+        base_loss = params.swim.loss
+    else:
+        state, net, params = _dense_fixture(n)
+        base_loss = params.loss
+    t_kill = min(max(ticks // 4, 1), ticks - 1)
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": ticks,
+            "events": [
+                {"at": t_kill, "op": "kill", "node": 0},
+                {"at": 0, "op": "overload", "until": ticks, "capacity": 2,
+                 "threshold": 8, "recover": 2, "factor": 4},
+            ],
+        }
+    )
+    compiled = compile_spec(spec, n, base_loss=base_loss)
+    keys = jax.random.split(jax.random.PRNGKey(0), ticks)
+    m = min(4 * n, 128)
+    ct = compile_traffic(
+        {"kind": "zipf", "keys_per_tick": m, "pool": 4 * m,
+         "latency_buckets": latency_buckets},
+        n,
+        cksum.default_addresses(n),
+    )
+    cp = pol.compile_policy("combined", n=n, m=m)
+    ct = runner.overload_traffic(ct, compiled)
+    ct = runner.policy_traffic(ct, cp)
+    _, period, ov = runner.prepare_faults(state, net, compiled, params)
+    po = runner.prepare_policy(cp, net, n, ct.static.max_retries)
+    args = (
+        state,
+        net.up,
+        net.responsive,
+        jnp.zeros((n,), jnp.int32),
+        period,
+        compiled.ev_tick,
+        compiled.ev_kind,
+        compiled.ev_node,
+        compiled.p_tick,
+        compiled.p_gid,
+        compiled.loss,
+        jnp.asarray(keys),
+        ct.tensors,
+        None,  # tick0
+        compiled.faults,
+        ov,
+        po,
+        pol.knob_arrays(cp),
+    )
+    dims = dict(N=n, M=ct.static.m, B=latency_buckets,
+                W=cp.config.amp_window)
+    if backend == "delta":
+        dims["C"] = capacity
+    return Built(
+        name="run_scenario+policy",
+        backend=backend,
+        jitted=runner._scenario_scan,
+        args=args,
+        statics=dict(
+            params=params,
+            has_revive=compiled.has_revive,
+            traffic=ct.static,
+            overload=compiled.overload,
+            policy=cp.config,
+        ),
+        key_roots={
+            "protocol": tree_flat_index_of(args, args[11]),
+            "workload": tree_flat_index_of(args, ct.tensors.key),
+        },
+        donates=True,
+        min_aliased=1,
+        census_min_elems=n * (capacity if backend == "delta" else n),
+        dims=dims,
+    )
+
+
 def _build_sweep(backend: str, *, n: int, ticks: int, capacity: int,
                  replicas: int) -> Built:
     """run_sweep's jitted vmapped scan (sweep._sweep_scan)."""
@@ -528,6 +624,12 @@ ENTRY_POINTS: dict[str, EntrySpec] = {
         "the scenario scan in its incident shape: traffic + SLO "
         "latency + the load-coupled overload feedback carry "
         "(scenarios/library.py cascading_overload)"),
+    "run_scenario+policy": EntrySpec(
+        "run_scenario+policy", ("dense", "delta"),
+        _build_policy_scenario,
+        "the scenario scan in its policy shape: the incident fixture "
+        "plus the remediation policy carry and traced knob scalars "
+        "(ringpop_tpu/policies)"),
     "run_sweep": EntrySpec(
         "run_sweep", ("dense", "delta"), _build_sweep,
         "the vmapped R-replica sweep scan (scenarios/sweep.py)"),

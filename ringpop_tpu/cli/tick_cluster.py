@@ -443,6 +443,17 @@ class TpuSimCluster(ClusterDriver):
             capacity=capacity,
             stats_emitter=self.stats_emitter,
         )
+        # an identically-seeded sibling cluster: the --policy control
+        # arm replays the same incident (same key stream) without the
+        # policy, so the before/after line is a true A/B
+        self._mk_cluster = lambda: SimCluster(
+            size,
+            sim.SwimParams(loss=loss, sparse_cap=sparse_cap, probe=probe),
+            seed=seed,
+            damping=damping,
+            backend=layout,
+            capacity=capacity,
+        )
         self._suspended: list[int] = []
         self._killed: list[int] = []
 
@@ -547,6 +558,7 @@ class TpuSimCluster(ClusterDriver):
         checkpoint_every: int = 1,
         segment_store: str | None = None,
         incident: str | None = None,
+        policy: str | None = None,
     ) -> None:
         """Run a JSON scenario spec as ONE jitted call (scenarios/);
         with ``sweep=R`` run R replicas in one vmapped dispatch; with
@@ -563,7 +575,13 @@ class TpuSimCluster(ClusterDriver):
         of a spec file: the incident supplies both the fault timeline
         and its latency-coupled workload, the run streams by default
         (segments of 32), and the detect/heal/serve summary prints at
-        the end — the same summary the golden regression lane pins."""
+        the end — the same summary the golden regression lane pins.
+
+        ``policy=NAME[:k=v,...]`` arms a remediation policy
+        (ringpop_tpu/policies); with ``incident`` a no-policy CONTROL
+        arm replays first on an identically-seeded sibling cluster, and
+        the before/after goodput + amplification line prints under the
+        summary."""
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
         incident_name = incident
@@ -593,8 +611,17 @@ class TpuSimCluster(ClusterDriver):
                 spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter,
                 flap_jitter=sweep_flap_jitter, traffic=traffic,
                 segment_ticks=segment_ticks, segment_store=segment_store,
+                policy=policy,
             )
             return
+        control = None
+        if policy is not None and incident_name is not None:
+            from ringpop_tpu.scenarios import library as ilib
+
+            ctrl_trace = self._mk_cluster().run_scenario(
+                spec, traffic=traffic, segment_ticks=segment_ticks
+            )
+            control = ilib.incident_summary(ctrl_trace)
         t0 = time.perf_counter()
         if segment_ticks:
             trace = self.cluster.run_scenario(
@@ -604,9 +631,12 @@ class TpuSimCluster(ClusterDriver):
                 checkpoint_path=checkpoint,
                 checkpoint_every=checkpoint_every,
                 store=segment_store,
+                policy=policy,
             )
         else:
-            trace = self.cluster.run_scenario(spec, traffic=traffic)
+            trace = self.cluster.run_scenario(
+                spec, traffic=traffic, policy=policy
+            )
         wall_ms = (time.perf_counter() - t0) * 1000
         state = (
             "CONVERGED" if trace.converged[-1]
@@ -676,9 +706,18 @@ class TpuSimCluster(ClusterDriver):
         if incident_name is not None:
             from ringpop_tpu.scenarios import library as ilib
 
-            print(ilib.format_summary(
-                incident_name, ilib.incident_summary(trace)
-            ))
+            summary = ilib.incident_summary(trace)
+            print(ilib.format_summary(incident_name, summary))
+            if control is not None and control.get("lookups"):
+                g0 = 100.0 * control["delivered"] / control["lookups"]
+                g1 = 100.0 * summary["delivered"] / max(summary["lookups"], 1)
+                a0 = control["sends"] / max(control["delivered"], 1)
+                a1 = summary["sends"] / max(summary["delivered"], 1)
+                print(
+                    f"policy {policy}: goodput {g0:.1f}% -> {g1:.1f}%, "
+                    f"amplification {a0:.2f} -> {a1:.2f} "
+                    f"(control arm vs policy arm, same seed)"
+                )
         if trace_out:
             trace.save(trace_out)
             print(f"trace ({trace.ticks} ticks x "
@@ -686,13 +725,14 @@ class TpuSimCluster(ClusterDriver):
 
     def _run_sweep(self, spec, trace_out, replicas, loss_scales, kill_jitter,
                    flap_jitter=None, traffic=None, segment_ticks=None,
-                   segment_store=None):
+                   segment_store=None, policy=None):
         t0 = time.perf_counter()
         strace = self.cluster.run_sweep(
             spec, replicas,
             loss_scales=loss_scales, kill_jitter=kill_jitter,
             flap_jitter=flap_jitter, traffic=traffic,
             segment_ticks=segment_ticks, store=segment_store,
+            policy=policy,
         )
         wall_ms = (time.perf_counter() - t0) * 1000
         summary = strace.summary()
@@ -850,6 +890,21 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                              "golden-lane summary); see --list-incidents")
     parser.add_argument("--list-incidents", action="store_true",
                         help="print the incident catalog and exit")
+    parser.add_argument("--policy", default=None, metavar="NAME[:k=v,...]",
+                        help="tpu-sim: arm a remediation policy "
+                             "(ringpop_tpu/policies; docs/incidents.md) in "
+                             "the compiled scenario scan — admission "
+                             "load-shedding, adaptive retry budgets, "
+                             "serve-side quarantine, or all three "
+                             "(combined), with optional integer knob "
+                             "overrides.  Needs a serve workload "
+                             "(--incident or --traffic); with --incident a "
+                             "no-policy control arm replays first and the "
+                             "before/after goodput + amplification line "
+                             "prints; see --list-policies")
+    parser.add_argument("--list-policies", action="store_true",
+                        help="print the policy catalog (with concrete "
+                             "default knobs at this --size) and exit")
     parser.add_argument("--trace-out", default=None, metavar="FILE",
                         help="with --scenario: write the per-tick telemetry "
                              "trace (.npz) here")
@@ -948,6 +1003,14 @@ def main(argv: list[str] | None = None) -> None:
         print(format_catalog())
         return
 
+    if args.list_policies:
+        from ringpop_tpu.policies import format_catalog as policy_catalog
+
+        # the incident workloads serve 8n keys/tick, so show the
+        # defaults a --incident run at this --size would compile
+        print(policy_catalog(args.size, 8 * args.size))
+        return
+
     if args.script_to_scenario:
         if not args.script:
             parser.error("--script-to-scenario needs --script")
@@ -1007,6 +1070,17 @@ def main(argv: list[str] | None = None) -> None:
     if args.traffic and not args.scenario:
         parser.error("--traffic needs --scenario (the workload co-runs "
                      "inside the compiled scenario scan)")
+    if args.policy:
+        if not (args.incident or args.traffic):
+            parser.error("--policy meters the serve plane (per-node sends "
+                         "+ delivered): pair it with --incident or "
+                         "--scenario + --traffic")
+        from ringpop_tpu.policies import parse_policy_arg
+
+        try:
+            parse_policy_arg(args.policy)
+        except ValueError as e:
+            parser.error(str(e))
     if args.latency_buckets and not args.traffic:
         parser.error("--latency-buckets needs --traffic (it extends the "
                      "serving workload with the SLO latency plane)")
@@ -1076,6 +1150,7 @@ def main(argv: list[str] | None = None) -> None:
                     checkpoint_every=args.checkpoint_every,
                     segment_store=args.segment_store,
                     incident=args.incident,
+                    policy=args.policy,
                 )
             elif args.script:
                 run_script(driver, args.script)

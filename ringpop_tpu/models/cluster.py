@@ -215,6 +215,7 @@ class SimCluster:
         checkpoint_every: int = 1,
         assemble: bool = True,
         pipeline: bool = True,
+        policy: Any | None = None,
     ) -> Any:
         """Run a declarative fault timeline as ONE jitted call.
 
@@ -246,6 +247,13 @@ class SimCluster:
         call, but host trace memory is O(segment) (``assemble=False``
         returns the ``SegmentStore`` instead of a whole-run ``Trace``)
         and a killed soak resumes via ``scenarios.stream.resume``.
+
+        ``policy`` arms a remediation policy (``ringpop_tpu.policies``):
+        a name string (``"admission"``, optionally with ``:knob=v``
+        overrides), a cursor dict, or a pre-compiled ``CompiledPolicy``.
+        Requires ``traffic``; the policy's per-tick fold rides the same
+        scan carry as the overload feedback loop, and its final state
+        persists on ``self.net.po_*`` (``clear_policy()`` drops it).
         """
         from ringpop_tpu.scenarios import compile as scompile
         from ringpop_tpu.scenarios import runner as srunner
@@ -265,6 +273,7 @@ class SimCluster:
                 checkpoint_every=checkpoint_every,
                 assemble=assemble,
                 pipeline=pipeline,
+                policy=policy,
             )
         if store is not None or checkpoint_path is not None or not assemble:
             raise ValueError(
@@ -288,11 +297,18 @@ class SimCluster:
         # mask-form host sync runs once per run, not again per dispatch
         adj = srunner.precheck(self.state, self.net, compiled, params)
         srunner.precheck_overload(compiled, traffic, self.net)
+        if policy is not None and traffic is not None:
+            from ringpop_tpu.policies import core as pol
+
+            policy = pol.compile_policy(
+                policy, n=self.n, m=traffic.static.m
+            )
+        srunner.precheck_policy(policy, traffic, self.net)
         keys = scompile.key_schedule(self._split, compiled)
         start_tick = int(self.state.tick)
         self.state, self.net, ys = srunner.run_compiled(
             self.state, self.net, keys, compiled, params, traffic=traffic,
-            adj=adj,
+            adj=adj, policy=policy,
         )
         self.set_loss(float(compiled.loss[-1]))  # host mirror of the schedule
         stacks = {k: np.asarray(v) for k, v in ys.items()}
@@ -301,6 +317,10 @@ class SimCluster:
             # provenance rides along in the trace (ScenarioSpec.from_dict
             # ignores unknown keys, so the npz round trip is unaffected)
             spec_dict["traffic"] = traffic.spec.to_dict()
+        if policy is not None:
+            from ringpop_tpu.policies import core as pol
+
+            spec_dict["policy"] = pol.to_dict(policy)
         trace = Trace(
             metrics={
                 k: v
@@ -354,6 +374,8 @@ class SimCluster:
         store: str | None = None,
         assemble: bool = True,
         pipeline: bool = True,
+        policy: Any | None = None,
+        policy_axes: dict[str, Any] | None = None,
     ) -> Any:
         """Run R replicas of a scenario as ONE vmapped jitted call.
 
@@ -390,6 +412,13 @@ class SimCluster:
         sweep).  ``flap_jitter`` shifts replica r's flap windows by
         ``flap_jitter[r]`` ticks (per-replica storm phases in one
         compiled program).
+
+        ``policy`` arms a remediation policy in every replica, and
+        ``policy_axes`` sweeps its knobs: ``{"admit_capacity": [2, 4,
+        8, 16]}`` gives replica r the r-th value — knobs are traced
+        batch axes, so the whole knob grid shares one compiled program,
+        and replica r stays bit-identical to a standalone
+        ``run_scenario(policy=sweep.replica_policy(...))``.
         """
         from ringpop_tpu.scenarios import runner as srunner
         from ringpop_tpu.scenarios import sweep as ssweep
@@ -411,6 +440,8 @@ class SimCluster:
                 assemble=assemble,
                 pipeline=pipeline,
                 shard=shard,
+                policy=policy,
+                policy_axes=policy_axes,
             )
         if store is not None or not assemble:
             raise ValueError(
@@ -437,13 +468,20 @@ class SimCluster:
         # static rejections BEFORE drawing keys (run_scenario contract)
         srunner.precheck(self.state, self.net, cs.base, params)
         srunner.precheck_overload(cs.base, traffic, self.net)
+        if policy is not None and traffic is not None:
+            from ringpop_tpu.policies import core as pol
+
+            policy = pol.compile_policy(
+                policy, n=self.n, m=traffic.static.m
+            )
+        srunner.precheck_policy(policy, traffic, self.net)
         if shard:
             ssweep.precheck_shard(replicas)
         replica_keys = [self._split() for _ in range(replicas)]
         keys = ssweep.sweep_key_schedule(replica_keys, cs)
         states, nets, ys = ssweep.run_sweep_compiled(
             self.state, self.net, keys, cs, params, shard=shard,
-            traffic=traffic,
+            traffic=traffic, policy=policy, policy_axes=policy_axes,
         )
         stacks = {k: np.asarray(v) for k, v in ys.items()}
         trace = ssweep.SweepTrace(
@@ -844,6 +882,17 @@ class SimCluster:
         pressure would otherwise silently seed the new run; resume
         keeps it on purpose)."""
         self.net = self.net._replace(ov_cnt=None, ov_gray=None)
+
+    def clear_policy(self) -> None:
+        """Drop remediation policy state a finished ``policy=`` run
+        left on the net (``NetState.po_*``) — required before a FRESH
+        policy-armed run on the same cluster (leftover pressure /
+        hysteresis flags / amp windows would silently seed the new
+        run's meters; resume keeps them on purpose)."""
+        self.net = self.net._replace(
+            po_press=None, po_shed=None, po_quar=None,
+            po_sends_w=None, po_deliv_w=None, po_retry_cap=None,
+        )
 
     def set_period(self, period) -> None:
         """Per-node protocol periods (int[N]; the gray-failure model):

@@ -293,6 +293,20 @@ class NetState(NamedTuple):
     # the final net round-trip the feedback state (stream resume).
     ov_cnt: jax.Array | None = None  # int32[N]
     ov_gray: jax.Array | None = None  # bool[N]
+    # Remediation policy plane (ringpop_tpu/policies; None unless a
+    # policy-armed run ran/is running): the per-node pressure meter,
+    # the admission (shed) and ring-quarantine hysteresis flags, the
+    # trailing amplification window rings (total sends / delivered per
+    # tick, [amp_window] slots), and the adaptive retry cap.  Same
+    # contract as ov_*: the scan carries them, checkpoints and the
+    # final net round-trip them bit-exactly (stream resume), and the
+    # None default keeps checkpoint format v5 backward-compatible.
+    po_press: jax.Array | None = None  # int32[N]
+    po_shed: jax.Array | None = None  # bool[N]
+    po_quar: jax.Array | None = None  # bool[N]
+    po_sends_w: jax.Array | None = None  # int32[W]
+    po_deliv_w: jax.Array | None = None  # int32[W]
+    po_retry_cap: jax.Array | None = None  # int32 scalar
 
 
 def make_net(n: int, *, partitioned: bool = False) -> NetState:

@@ -1,0 +1,27 @@
+"""Remediation policy plane: compiled operator actions riding the scan.
+
+The subsystem makes remediation a first-class, sweepable plane next to
+the overload feedback loop (ROADMAP item 3): admission control /
+load-shedding at hot holders, adaptive retry budgets keyed on observed
+amplification, and serve-side quarantine that steers rings away from
+pressured nodes before suspicion fires.  One int-exact per-tick update
+(`core.policy_update`) is shared verbatim between the jitted scenario
+scan and the host oracle the tests replay.
+"""
+
+from ringpop_tpu.policies.core import (  # noqa: F401
+    INF,
+    CompiledPolicy,
+    PolicyConfig,
+    PolicyKnobs,
+    POLICIES,
+    compile_policy,
+    format_catalog,
+    from_dict,
+    init_policy_state,
+    knob_arrays,
+    list_policies,
+    parse_policy_arg,
+    policy_update,
+    to_dict,
+)

@@ -1,0 +1,281 @@
+"""The remediation policy plane: one int-exact update, two executors.
+
+A policy is a per-tick fold over the same load signal the overload
+feedback loop reads (``node_sends``, the per-holder landed-send count):
+
+* a **pressure meter** per node — the leaky bucket
+  ``press' = max(0, press + sends - admit_capacity)``, the exact shape
+  of ``faults.overload_update``'s counter so the two planes are
+  comparable tick-for-tick;
+* an **admission (shedding) flag** per node with hysteresis — requests
+  whose first resolved holder is shedding are dropped at arrival (one
+  landed send, zero retries) instead of burning duty-phase timeouts;
+* a **quarantine flag** per node with hysteresis — served rings are
+  steered away from pressured nodes via the PR 7 ``damped``-mask
+  mechanism (membership truth untouched; misroutes-vs-truth inflate by
+  design while a node is steered around);
+* an **adaptive retry budget** — a trailing ``amp_window``-tick ring of
+  (total sends, delivered) whose ratio is the observed amplification in
+  x16 fixed point; when it crosses ``amp_threshold_x16`` the per-origin
+  retry cap collapses to ``retry_floor`` until the storm quenches.
+
+Everything is int32 arithmetic with no data-dependent shapes, so the
+SAME ``policy_update`` body executes under ``lax.scan`` (jnp arrays)
+and in the host oracle (np arrays) — the bit-parity tests call this
+one function twice.
+
+Mechanism enablement is **not** a compile-time static: a disabled
+mechanism gets an ``INF`` threshold (never fires) so every named
+policy shares one compiled program per ``amp_window``, and every knob
+is a traced scalar that `run_sweep` can batch per replica without a
+recompile (pre-paying ROADMAP item 4's frozen-knob refactor).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import numpy as np
+
+# A threshold no int32 meter can reach: the OFF position for any
+# mechanism (press < INF always, so the flag can never latch).
+INF = 2**31 - 1
+
+
+class PolicyConfig(NamedTuple):
+    """The jit-static part of a policy (hashable; shapes only)."""
+
+    amp_window: int = 8  # trailing window (ticks) for the amp ratio
+
+
+class PolicyKnobs(NamedTuple):
+    """The traced part: int32 scalars on device, [R] axes in a sweep.
+
+    Every field is an operating point, not a shape — changing one
+    never recompiles, and `run_sweep` batches them per replica.
+    """
+
+    admit_capacity: Any  # sends/tick a holder absorbs before pressure
+    shed_hi: Any  # press >= shed_hi latches the shedding flag
+    shed_lo: Any  # hysteresis: shed holds while press > shed_lo
+    quar_hi: Any  # press >= quar_hi latches ring quarantine
+    quar_lo: Any  # hysteresis: quarantine holds while press > quar_lo
+    amp_threshold_x16: Any  # amp (x16 fixed point) that cuts retries
+    retry_floor: Any  # the cut retry cap (0 = no retries at all)
+
+
+class CompiledPolicy(NamedTuple):
+    """A named operating point: static config + concrete int knobs."""
+
+    name: str
+    config: PolicyConfig
+    knobs: PolicyKnobs  # plain python ints (device-ified per executor)
+
+
+def policy_update(cfg, knobs, press, shed, quar, sends_w, deliv_w,
+                  node_sends, tick_sends, tick_delivered, t, max_retries):
+    """One policy tick. Works on jnp arrays (scan) and np arrays (host).
+
+    Reads tick ``t``'s serve outputs, returns the plane the serve at
+    ``t+1`` must consult — the same post-serve causality as
+    ``overload_update``.  Returns
+    ``(press, shed, quar, sends_w, deliv_w, retry_cap, amp_x16)``.
+    """
+    if isinstance(press, np.ndarray):
+        np_like = np
+    else:
+        import jax.numpy as jnp
+
+        np_like = jnp
+    i32 = np_like.int32
+    press = np_like.maximum(
+        press + node_sends - knobs.admit_capacity, 0
+    ).astype(i32)
+    shed = (press >= knobs.shed_hi) | (shed & (press > knobs.shed_lo))
+    quar = (press >= knobs.quar_hi) | (quar & (press > knobs.quar_lo))
+    lanes = np_like.arange(cfg.amp_window)
+    slot = t % cfg.amp_window
+    sends_w = np_like.where(lanes == slot, tick_sends, sends_w).astype(i32)
+    deliv_w = np_like.where(lanes == slot, tick_delivered, deliv_w).astype(i32)
+    ssum = np_like.sum(sends_w)
+    dsum = np_like.sum(deliv_w)
+    amp_x16 = ((16 * ssum) // np_like.maximum(dsum, 1)).astype(i32)
+    cut = amp_x16 >= knobs.amp_threshold_x16
+    retry_cap = np_like.where(
+        cut, knobs.retry_floor, max_retries
+    ).astype(i32)
+    return press, shed, quar, sends_w, deliv_w, retry_cap, amp_x16
+
+
+def init_policy_state(n: int, cfg: PolicyConfig, max_retries: int,
+                      net=None):
+    """Fresh (or NetState-resumed) policy carry, unpacked form:
+    ``(press i32[N], shed bool[N], quar bool[N], sends_w i32[W],
+    deliv_w i32[W], retry_cap i32 scalar)``."""
+    import jax.numpy as jnp
+
+    if net is not None and getattr(net, "po_press", None) is not None:
+        return (
+            jnp.asarray(net.po_press, jnp.int32),
+            jnp.asarray(net.po_shed, bool),
+            jnp.asarray(net.po_quar, bool),
+            jnp.asarray(net.po_sends_w, jnp.int32),
+            jnp.asarray(net.po_deliv_w, jnp.int32),
+            jnp.asarray(net.po_retry_cap, jnp.int32),
+        )
+    w = cfg.amp_window
+    return (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), bool),
+        jnp.zeros((n,), bool),
+        jnp.zeros((w,), jnp.int32),
+        jnp.zeros((w,), jnp.int32),
+        jnp.asarray(max_retries, jnp.int32),
+    )
+
+
+def knob_arrays(cp: CompiledPolicy) -> PolicyKnobs:
+    """The knobs as int32 device scalars (the traced scan arguments)."""
+    import jax.numpy as jnp
+
+    return PolicyKnobs(*(jnp.asarray(v, jnp.int32) for v in cp.knobs))
+
+
+# name -> (doc line, enabled mechanisms)
+POLICIES: dict[str, tuple[str, tuple[str, ...]]] = {
+    "admission": (
+        "load-shedding at hot holders: drop excess arrivals at the "
+        "pressured owner before a duty-phase timeout burns retries",
+        ("admission",),
+    ),
+    "retry_budget": (
+        "adaptive retry budgets: collapse RETRY_SCHEDULE consumption "
+        "to retry_floor while trailing amplification >= threshold",
+        ("retry_budget",),
+    ),
+    "quarantine": (
+        "serve-side quarantine: steer served rings away from "
+        "pressured nodes before suspicion fires (damped-mask reuse)",
+        ("quarantine",),
+    ),
+    "combined": (
+        "all three mechanisms at their default operating points",
+        ("admission", "retry_budget", "quarantine"),
+    ),
+}
+
+
+def default_knobs(name: str, n: int, m: int) -> dict[str, int]:
+    """Scale-aware defaults: ``base`` mirrors the incident builder's
+    per-holder capacity ``max(3, 3m/2n)`` so a policy engages at the
+    same pressure scale the cascading_overload meter does."""
+    base = max(3, (3 * m) // (2 * n))
+    knobs = dict(
+        admit_capacity=base,
+        shed_hi=INF, shed_lo=INF,
+        quar_hi=INF, quar_lo=INF,
+        amp_threshold_x16=INF, retry_floor=0,
+    )
+    _, mechs = POLICIES[name]
+    if "admission" in mechs:
+        knobs.update(shed_hi=2 * base, shed_lo=max(1, base // 2))
+    if "quarantine" in mechs:
+        # engage well below the incident's gray threshold (6x base):
+        # steer the ring before the overload meter grays the node
+        knobs.update(quar_hi=base, quar_lo=max(1, base // 4))
+    if "retry_budget" in mechs:
+        # 1.5x sends/delivered (x16 fixed point) — the acceptance bar
+        knobs.update(amp_threshold_x16=24, retry_floor=0)
+    return knobs
+
+
+def parse_policy_arg(arg: str) -> tuple[str, dict[str, int]]:
+    """``NAME[:k=v,...]`` -> (name, integer overrides)."""
+    name, _, rest = arg.partition(":")
+    name = name.strip()
+    if name not in POLICIES:
+        raise ValueError(
+            f"unknown policy {name!r} (have {', '.join(sorted(POLICIES))})"
+        )
+    overrides: dict[str, int] = {}
+    if rest.strip():
+        for item in rest.split(","):
+            key, eq, val = item.partition("=")
+            key = key.strip()
+            if not eq or key not in set(PolicyKnobs._fields) | {"amp_window"}:
+                raise ValueError(
+                    f"bad policy knob {item!r} (knobs: "
+                    f"{', '.join(PolicyKnobs._fields)}, amp_window)"
+                )
+            overrides[key] = int(val)
+    return name, overrides
+
+
+def compile_policy(policy, *, n: int, m: int,
+                   **overrides: int) -> CompiledPolicy:
+    """Resolve a policy argument (name string with optional ``:k=v``
+    knobs, dict from a stream cursor, or an already-compiled policy)
+    into a concrete ``CompiledPolicy`` at cluster scale (n, m)."""
+    if isinstance(policy, CompiledPolicy):
+        return policy
+    if isinstance(policy, dict):
+        return from_dict(policy)
+    name, parsed = parse_policy_arg(str(policy))
+    parsed.update(overrides)
+    amp_window = int(parsed.pop("amp_window", PolicyConfig().amp_window))
+    if amp_window < 1:
+        raise ValueError("amp_window must be >= 1")
+    knobs = default_knobs(name, n, m)
+    for key, val in parsed.items():
+        knobs[key] = int(val)
+    return CompiledPolicy(
+        name=name,
+        config=PolicyConfig(amp_window=amp_window),
+        knobs=PolicyKnobs(**knobs),
+    )
+
+
+def to_dict(cp: CompiledPolicy) -> dict:
+    """JSON-able form for stream cursors and golden metadata; round
+    trips bit-exactly through ``from_dict`` (no scale rederivation)."""
+    return {
+        "name": cp.name,
+        "amp_window": cp.config.amp_window,
+        "knobs": {k: int(v) for k, v in cp.knobs._asdict().items()},
+    }
+
+
+def from_dict(d: dict) -> CompiledPolicy:
+    return CompiledPolicy(
+        name=str(d["name"]),
+        config=PolicyConfig(amp_window=int(d["amp_window"])),
+        knobs=PolicyKnobs(**{k: int(v) for k, v in d["knobs"].items()}),
+    )
+
+
+def format_catalog(n: int | None = None, m: int | None = None) -> str:
+    """The ``--list-policies`` text: catalog + knob table (with the
+    concrete defaults when a cluster scale is given)."""
+    lines = ["policies (tick-cluster --policy NAME[:k=v,...]):", ""]
+    for name, (doc, mechs) in POLICIES.items():
+        lines.append(f"  {name:<14} {doc}")
+        lines.append(f"  {'':<14} mechanisms: {', '.join(mechs)}")
+        if n is not None and m is not None:
+            knobs = default_knobs(name, n, m)
+            shown = ", ".join(
+                f"{k}={v}" for k, v in knobs.items() if v != INF
+            )
+            lines.append(f"  {'':<14} defaults @ n={n}, m={m}: {shown}")
+        lines.append("")
+    lines.append(
+        "knobs: admit_capacity (pressure leak/tick), shed_hi/shed_lo "
+        "(admission hysteresis), quar_hi/quar_lo (quarantine "
+        "hysteresis), amp_threshold_x16 (x16 fixed-point amplification "
+        "that cuts retries), retry_floor (the cut cap), amp_window "
+        "(trailing ticks, compile-time)."
+    )
+    return "\n".join(lines)
+
+
+def list_policies() -> list[str]:
+    return sorted(POLICIES)

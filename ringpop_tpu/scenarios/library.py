@@ -449,6 +449,15 @@ def incident_summary(trace: Any) -> dict[str, int]:
     if "ov_gray_nodes" in m:
         out["ov_gray_peak"] = int(m["ov_gray_nodes"].max())
         out["ov_pressure_peak"] = int(m["ov_pressure_max"].max())
+    if "policy_shed" in m:
+        # the remediation plane ran: its sheds are already inside
+        # ``sends`` (total_sends counts them — amplification stays
+        # honest), and the peaks pin how hard each mechanism engaged
+        out["policy_shed"] = int(m["policy_shed"].sum())
+        out["policy_quar_peak"] = int(m["policy_quarantined"].max())
+        out["policy_shed_peak"] = int(m["policy_shed_nodes"].max())
+        out["policy_retry_cap_min"] = int(m["policy_retry_cap"].min())
+        out["policy_amp_peak_x16"] = int(m["policy_amp_x16"].max())
     return out
 
 
@@ -473,6 +482,9 @@ def format_summary(name: str, summary: dict[str, int]) -> str:
         parts.append(f"{s['gray_timeouts']} gray timeouts")
     if "ov_gray_peak" in s:
         parts.append(f"peak overload-gray {s['ov_gray_peak']}")
+    if "policy_shed" in s:
+        parts.append(f"shed {s['policy_shed']}")
+        parts.append(f"peak quarantine {s['policy_quar_peak']}")
     return ", ".join(parts)
 
 
@@ -506,20 +518,51 @@ def golden_cluster(backend: str = "dense"):
     )
 
 
-def run_golden(name: str, backend: str = "dense") -> dict[str, int]:
+def run_golden(
+    name: str, backend: str = "dense", policy: str | None = None
+) -> dict[str, int]:
     """One incident at the golden configuration, streamed (the CLI's
     default segmenting — bit-identical to the one-dispatch run), down
-    to its summary dict."""
+    to its summary dict.  ``policy`` arms a remediation policy at its
+    default operating point (``ringpop_tpu.policies``) — the
+    policy-armed goldens pinned next to the bare incident pins."""
     spec, wl = build_incident(name, GOLDEN_N, backend=backend)
     cluster = golden_cluster(backend)
     trace = cluster.run_scenario(
-        spec, traffic=wl, segment_ticks=min(GOLDEN_SEGMENT, spec.ticks)
+        spec, traffic=wl, segment_ticks=min(GOLDEN_SEGMENT, spec.ticks),
+        policy=policy,
     )
     return incident_summary(trace)
 
 
-def golden_path(name: str, backend: str, directory: str) -> str:
-    return os.path.join(directory, f"{name}.{backend}.json")
+def golden_path(
+    name: str, backend: str, directory: str, policy: str | None = None
+) -> str:
+    stem = f"{name}+{policy}" if policy else name
+    return os.path.join(directory, f"{stem}.{backend}.json")
+
+
+# The winning operating point (BASELINE.md round 9) and the pinned
+# policy-armed grid: cascading_overload under EVERY policy on both
+# backends (the incident the plane exists to beat), plus every other
+# incident under the winner (the no-regression scorecard — a policy
+# must not win cascading_overload by tanking a different outage).
+GOLDEN_POLICY = "combined"
+
+
+def policy_golden_grid() -> list[tuple[str, str, str]]:
+    """(incident, policy, backend) triples pinned under
+    ``tests/golden/incidents/`` (``tools/pin_incidents.py --policies``)."""
+    grid: list[tuple[str, str, str]] = []
+    from ringpop_tpu.policies import core as pol
+
+    for p in pol.list_policies():
+        for b in ("dense", "delta"):
+            grid.append(("cascading_overload", p, b))
+    for name, inc in INCIDENTS.items():
+        if name != "cascading_overload":
+            grid.append((name, GOLDEN_POLICY, "dense"))
+    return grid
 
 
 # ---------------------------------------------------------------------------

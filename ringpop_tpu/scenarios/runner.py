@@ -43,6 +43,7 @@ from ringpop_tpu.scenarios.compile import (
     CompiledScenario,
     expand_events,
 )
+from ringpop_tpu.policies import core as pol
 from ringpop_tpu.scenarios import faults as sfaults
 from ringpop_tpu.scenarios.spec import ScenarioSpec
 from ringpop_tpu.traffic import engine as traffic_engine
@@ -218,6 +219,78 @@ def overload_traffic(traffic: Any | None, compiled: CompiledScenario) -> Any:
     return traffic._replace(static=traffic.static._replace(track_load=1))
 
 
+def precheck_policy(
+    policy: Any | None,
+    traffic: Any | None,
+    net: NetState,
+    *,
+    standing_ok: bool = False,
+) -> None:
+    """Static rejections of the remediation policy plane, callable
+    before any PRNG key is drawn (the ``precheck`` contract).  A policy
+    meters serve-plane sends and delivered counts, so it needs a
+    traffic workload in the same scan; and a net carrying leftover
+    policy state from a previous run would silently seed the new run's
+    pressure/windows — reject unless resuming (``standing_ok``), whose
+    net carries this very run's own mid-window state."""
+    if policy is None:
+        return
+    if traffic is None:
+        raise ValueError(
+            "policies meter the serve plane (per-node sends + delivered): "
+            "pass a traffic workload (run_scenario(spec, traffic=..., "
+            "policy=...))"
+        )
+    if not standing_ok and net.po_press is not None:
+        leftover = (
+            np.asarray(net.po_press).any()
+            or np.asarray(net.po_shed).any()
+            or np.asarray(net.po_quar).any()
+            or np.asarray(net.po_sends_w).any()
+            or np.asarray(net.po_deliv_w).any()
+        )
+        if bool(leftover):
+            raise ValueError(
+                "the cluster carries policy state from a previous run "
+                "(net.po_*): clear_policy() first, or resume the run "
+                "that wrote it"
+            )
+
+
+def policy_traffic(traffic: Any | None, policy: Any | None) -> Any:
+    """The traffic statics a policy-armed scenario compiles: the policy
+    fold needs per-node send accounting (``track_load``) and the serve
+    chains need the policy hooks + the ``policy_shed`` counter
+    (``track_policy``)."""
+    if traffic is None or policy is None:
+        return traffic
+    st = traffic.static
+    if st.track_load and st.track_policy:
+        return traffic
+    return traffic._replace(
+        static=st._replace(track_load=1, track_policy=1)
+    )
+
+
+def prepare_policy(
+    policy: Any | None, net: NetState, n: int, max_retries: int
+) -> tuple | None:
+    """The initial policy carry (unpacked form) — zeros for a fresh
+    run, or the net's checkpointed mid-window state on resume."""
+    if policy is None:
+        return None
+    cfg = policy.config
+    if net.po_sends_w is not None and (
+        net.po_sends_w.shape[-1] != cfg.amp_window
+    ):
+        raise ValueError(
+            f"the cluster carries a policy amp window of "
+            f"{net.po_sends_w.shape[-1]} ticks but this policy uses "
+            f"{cfg.amp_window}; clear_policy() or match amp_window"
+        )
+    return pol.init_policy_state(n, cfg, max_retries, net=net)
+
+
 def _apply_revives(state, up, resp, m, ev_kind, ev_node):
     """Dense-backend in-scan revive: the scan twin of
     ``SimCluster.revive(i)`` — fresh incarnation past the cluster
@@ -270,11 +343,14 @@ def _scenario_scan_impl(
     tick0=None,
     faults=None,
     ov=None,
+    po=None,
+    po_knobs=None,
     *,
     params,
     has_revive: bool,
     traffic=None,
     overload=None,
+    policy=None,
 ):
     # ``tick0`` (traced int32 scalar, or None for 0) offsets the tick
     # counter the event/partition/traffic comparisons see: a streamed
@@ -291,11 +367,20 @@ def _scenario_scan_impl(
     def body(carry, xs):
         # node-bit planes ride the carry bit-packed (uint32 words, 1
         # bit/node); all in-tick work runs on the unpacked bool form
-        st, pu, pr, gid, per, ovc = carry
+        st, pu, pr, gid, per, ovc, poc = carry
         u = bitpack.unpack_bits(pu, n)
         r = bitpack.unpack_bits(pr, n)
         if overload is not None:
             ovc = (ovc[0], bitpack.unpack_bits(ovc[1], n))
+        if policy is not None:
+            # the remediation plane from LAST tick's fold (causal, like
+            # the overload gray bit): shed/quarantine flags ride the
+            # carry bit-packed next to the node-bit planes
+            po_press, po_sends_w, po_deliv_w, po_cap = (
+                poc[0], poc[3], poc[4], poc[5]
+            )
+            po_shed = bitpack.unpack_bits(poc[1], n)
+            po_quar = bitpack.unpack_bits(poc[2], n)
         t, key, loss_t = xs
         if ev_tick.shape[0]:
             m = ev_tick == t
@@ -384,14 +469,20 @@ def _scenario_scan_impl(
                     # rules and the EFFECTIVE period row (overload-
                     # degraded; ignored when the plane is off)
                     net=net, period=per_eff,
+                    policy=(po_shed, po_quar, po_cap)
+                    if policy is not None else None,
                 )
             )
-        if overload is not None:
-            # this tick's send load closes the loop: pressure and the
-            # hysteresis gray bit update AFTER serving (the flag the
-            # serve/step above read is last tick's — causal), and the
-            # per-node vector is consumed here, never stacked
+        # this tick's send load closes the feedback loops: both the
+        # overload meter and the policy fold consume the SAME per-node
+        # vector, which is popped once and never stacked
+        sends = None
+        if overload is not None or policy is not None:
             sends = y.pop("node_sends")
+        if overload is not None:
+            # pressure and the hysteresis gray bit update AFTER serving
+            # (the flag the serve/step above read is last tick's —
+            # causal)
             in_win = (t >= overload.start) & (t < overload.end)
             ov_cnt, ov_fl = sfaults.overload_update(
                 overload, in_win, ov_cnt, ov_fl, sends
@@ -399,32 +490,58 @@ def _scenario_scan_impl(
             y["ov_gray_nodes"] = jnp.sum(ov_fl, dtype=jnp.int32)
             y["ov_pressure_max"] = jnp.max(ov_cnt)
             ovc = (ov_cnt, bitpack.pack_bits(ov_fl))
+        if policy is not None:
+            # the policy fold runs POST-serve with the same causality:
+            # the planes serve_tick consulted above were last tick's
+            (po_press, po_shed, po_quar, po_sends_w, po_deliv_w,
+             po_cap, amp_x16) = pol.policy_update(
+                policy, po_knobs, po_press, po_shed, po_quar,
+                po_sends_w, po_deliv_w, sends,
+                jnp.sum(sends, dtype=jnp.int32), y["delivered"], t,
+                traffic.max_retries,
+            )
+            y["policy_shed_nodes"] = jnp.sum(po_shed, dtype=jnp.int32)
+            y["policy_quarantined"] = jnp.sum(po_quar, dtype=jnp.int32)
+            y["policy_pressure_max"] = jnp.max(po_press)
+            y["policy_retry_cap"] = po_cap
+            y["policy_amp_x16"] = amp_x16
+            poc = (po_press, bitpack.pack_bits(po_shed),
+                   bitpack.pack_bits(po_quar), po_sends_w, po_deliv_w,
+                   po_cap)
         return (st, bitpack.pack_bits(u), bitpack.pack_bits(r), gid, per,
-                ovc), y
+                ovc, poc), y
 
     t_idx = jnp.arange(ticks, dtype=jnp.int32)
     if tick0 is not None:
         t_idx = t_idx + tick0
     xs = (t_idx, keys, loss)
     ov_c = None if ov is None else (ov[0], bitpack.pack_bits(ov[1]))
-    (state, pu, pr, adj, period, ov_c), ys = jax.lax.scan(
+    po_c = None if po is None else (
+        po[0], bitpack.pack_bits(po[1]), bitpack.pack_bits(po[2]),
+        po[3], po[4], po[5],
+    )
+    (state, pu, pr, adj, period, ov_c, po_c), ys = jax.lax.scan(
         body,
         (state, bitpack.pack_bits(up), bitpack.pack_bits(responsive), adj,
-         period, ov_c),
+         period, ov_c, po_c),
         xs,
     )
     up = bitpack.unpack_bits(pu, n)
     responsive = bitpack.unpack_bits(pr, n)
     ov = None if ov_c is None else (ov_c[0], bitpack.unpack_bits(ov_c[1], n))
+    po = None if po_c is None else (
+        po_c[0], bitpack.unpack_bits(po_c[1], n),
+        bitpack.unpack_bits(po_c[2], n), po_c[3], po_c[4], po_c[5],
+    )
     # period stays int16 on exit: the streamed runner threads this
     # return straight into the next segment's dispatch, so widening
     # here would retrace the one compiled executable
-    return state, up, responsive, adj, period, ov, ys
+    return state, up, responsive, adj, period, ov, po, ys
 
 
 _scenario_scan = jax.jit(
     _scenario_scan_impl,
-    static_argnames=("params", "has_revive", "traffic", "overload"),
+    static_argnames=("params", "has_revive", "traffic", "overload", "policy"),
     donate_argnums=(0, 1, 2, 3),
 )
 
@@ -437,6 +554,7 @@ def run_compiled(
     params: SwimParams | DeltaParams,
     traffic: Any | None = None,
     adj: jax.Array | None = None,
+    policy: Any | None = None,
 ) -> tuple[Any, NetState, dict[str, jax.Array]]:
     """One jitted call: (state, net, per-tick telemetry stacks [ticks]).
 
@@ -454,6 +572,10 @@ def run_compiled(
     ``adj`` is the normalized group-id adjacency a caller that already
     ran ``precheck`` passes back in, skipping the repeat host sync of
     the mask-form check.
+
+    ``policy`` (a ``policies.CompiledPolicy``) arms the remediation
+    plane: its knobs ride as traced scalars, its state rides the scan
+    carry, and the post-run net round-trips it (``net.po_*``).
     """
     global _dispatches
     if keys.shape[0] != compiled.ticks:
@@ -463,8 +585,16 @@ def run_compiled(
     if adj is None:
         adj = precheck(state, net, compiled, params)
         precheck_overload(compiled, traffic, net)
+        precheck_policy(policy, traffic, net)
     traffic = overload_traffic(traffic, compiled)
+    traffic = policy_traffic(traffic, policy)
     state, period, ov = prepare_faults(state, net, compiled, params)
+    po = None
+    knobs = None
+    if policy is not None:
+        po = prepare_policy(policy, net, compiled.n,
+                            traffic.static.max_retries)
+        knobs = pol.knob_arrays(policy)
     _dispatches += 1
     meta = {
         "backend": "delta" if isinstance(state, DeltaState) else "dense",
@@ -474,10 +604,12 @@ def run_compiled(
     }
     if traffic is not None:
         meta["traffic_m"] = traffic.static.m
+    if policy is not None:
+        meta["policy"] = policy.name
     # ledger-off (the default): dispatch() is a plain call-through; on,
     # the dispatch is recorded with its compile/execute split and AOT
     # memory footprint (obs/ledger.py)
-    state, up, resp, adj, period, ov, ys = default_ledger().dispatch(
+    state, up, resp, adj, period, ov, po, ys = default_ledger().dispatch(
         "run_scenario",
         _scenario_scan,
         state,
@@ -496,13 +628,16 @@ def run_compiled(
         None,
         compiled.faults,
         ov,
+        po,
+        knobs,
         params=params,
         has_revive=compiled.has_revive,
         traffic=traffic.static if traffic is not None else None,
         overload=compiled.overload,
+        policy=policy.config if policy is not None else None,
         _meta=meta,
     )
-    return state, final_net(up, resp, adj, period, compiled, ov=ov), ys
+    return state, final_net(up, resp, adj, period, compiled, ov=ov, po=po), ys
 
 
 def prepare_faults(
@@ -568,6 +703,7 @@ def final_net(
     period: jax.Array | None,
     compiled: CompiledScenario,
     ov: tuple[jax.Array, jax.Array] | None = None,
+    po: tuple | None = None,
 ) -> NetState:
     """The post-run NetState, link rules mirrored to their state at the
     final tick — exactly what the host loop's last ``faultcfg`` apply
@@ -592,6 +728,12 @@ def final_net(
         # the feedback carry persists on the net so checkpoints (and a
         # stream resume) continue the pressure/hysteresis state exactly
         kw.update(ov_cnt=ov[0], ov_gray=ov[1])
+    if po is not None:
+        # same contract for the policy carry (unpacked form)
+        kw.update(
+            po_press=po[0], po_shed=po[1], po_quar=po[2],
+            po_sends_w=po[3], po_deliv_w=po[4], po_retry_cap=po[5],
+        )
     return NetState(up=up, responsive=resp, adj=adj, period=period, **kw)
 
 

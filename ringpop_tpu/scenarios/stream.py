@@ -71,6 +71,7 @@ import numpy as np
 from ringpop_tpu.models.swim_sim import NetState
 from ringpop_tpu.obs import bridge as obs_bridge
 from ringpop_tpu.obs.ledger import default_ledger
+from ringpop_tpu.policies import core as pol
 from ringpop_tpu.scenarios import compile as scompile
 from ringpop_tpu.scenarios import runner as srunner
 from ringpop_tpu.scenarios import sweep as ssweep
@@ -295,6 +296,7 @@ def run_streamed(
     assemble: bool = True,
     pipeline: bool = True,
     interrupt_after: int | None = None,
+    policy: Any | None = None,
 ) -> Any:
     """Run a scenario as pipelined S-tick segment dispatches.
 
@@ -335,6 +337,9 @@ def run_streamed(
     )
     adj = srunner.precheck(cluster.state, cluster.net, compiled, params_pre)
     srunner.precheck_overload(compiled, traffic, cluster.net)
+    if policy is not None and traffic is not None:
+        policy = pol.compile_policy(policy, n=cluster.n, m=traffic.static.m)
+    srunner.precheck_policy(policy, traffic, cluster.net)
     if checkpoint_path and store is None:
         # resume must be able to reassemble the full trace, so a
         # checkpointed run always persists its slabs
@@ -358,6 +363,7 @@ def run_streamed(
         "run_id": uuid.uuid4().hex[:12],
         "spec": spec.to_dict(),
         "traffic": traffic.spec.to_dict() if traffic is not None else None,
+        "policy": pol.to_dict(policy) if policy is not None else None,
         "segment_ticks": int(segment_ticks),
         "ticks": compiled.ticks,
         "ticks_done": 0,
@@ -398,6 +404,7 @@ def run_streamed(
         assemble=assemble,
         pipeline=pipeline,
         interrupt_after=interrupt_after,
+        policy=policy,
     )
 
 
@@ -456,6 +463,14 @@ def resume(
     # net's ov_cnt/ov_gray ARE this run's mid-window state, and
     # prepare_faults resumes the pressure from them
     srunner.precheck_overload(compiled, traffic, cluster.net, standing_ok=True)
+    # ... and for the policy carry: the cursor's exact knob set (never
+    # rederived from scale) resumes the net's po_* mid-window state
+    policy = (
+        pol.from_dict(cur["policy"])
+        if cur.get("policy") is not None
+        else None
+    )
+    srunner.precheck_policy(policy, traffic, cluster.net, standing_ok=True)
     # cluster.key already holds the post-schedule key (the schedule was
     # fully drawn before the first segment); derive the schedule again
     # from the recorded start key without touching it
@@ -474,6 +489,7 @@ def resume(
         assemble=assemble,
         pipeline=pipeline,
         interrupt_after=interrupt_after,
+        policy=policy,
     )
     return cluster, result
 
@@ -492,6 +508,7 @@ def _drive(
     assemble: bool,
     pipeline: bool,
     interrupt_after: int | None,
+    policy: Any | None = None,
 ) -> Any:
     """The segment loop shared by fresh runs and resumes."""
     S = int(cursor["segment_ticks"])
@@ -507,14 +524,20 @@ def _drive(
     is_delta = cluster.backend == "delta"
     params = cluster.dparams if is_delta else cluster.params
     traffic = srunner.overload_traffic(traffic, compiled)
+    traffic = srunner.policy_traffic(traffic, policy)
     tr_tensors = traffic.tensors if traffic is not None else None
     static_traffic = traffic.static if traffic is not None else None
     sink = cluster.stats_sink
     f_state, period0, ov0 = srunner.prepare_faults(
         cluster.state, cluster.net, compiled, params
     )
+    po0 = srunner.prepare_policy(
+        policy, cluster.net, cluster.n,
+        static_traffic.max_retries if static_traffic is not None else 0,
+    )
+    knobs = pol.knob_arrays(policy) if policy is not None else None
     carry = (f_state, cluster.net.up, cluster.net.responsive, adj, period0,
-             ov0)
+             ov0, po0)
     pending: tuple | None = None
     slabs: list[Trace] = []  # only populated when there is no store
     state = {"prev_live": cursor.get("prev_live"), "last_slab": None,
@@ -534,6 +557,8 @@ def _drive(
         }
         if static_traffic is not None:
             meta["traffic_m"] = static_traffic.m
+        if policy is not None:
+            meta["policy"] = policy.name
         args = (
             *carry[:5],
             compiled.ev_tick,
@@ -547,12 +572,15 @@ def _drive(
             jnp.int32(a),
             compiled.faults,
             carry[5],  # the overload feedback carry (or None)
+            carry[6],  # the remediation policy carry (or None)
+            knobs,
         )
         statics = dict(
             params=params,
             has_revive=compiled.has_revive,
             traffic=static_traffic,
             overload=compiled.overload,
+            policy=policy.config if policy is not None else None,
         )
         srunner._dispatches += 1
         t0 = time.perf_counter()
@@ -637,6 +665,17 @@ def _drive(
             # bubble durability costs; drain + checkpoint write below
             # still overlap this segment's compute)
             ov_snap = carry[5]
+            po_snap = carry[6]
+            po_kw = {}
+            if po_snap is not None:
+                po_kw = dict(
+                    po_press=np.asarray(po_snap[0]),
+                    po_shed=np.asarray(po_snap[1]),
+                    po_quar=np.asarray(po_snap[2]),
+                    po_sends_w=np.asarray(po_snap[3]),
+                    po_deliv_w=np.asarray(po_snap[4]),
+                    po_retry_cap=np.asarray(po_snap[5]),
+                )
             snap = (
                 _to_host(carry[0]),
                 NetState(
@@ -652,10 +691,11 @@ def _drive(
                     ov_gray=(
                         np.asarray(ov_snap[1]) if ov_snap is not None else None
                     ),
+                    **po_kw,
                 ),
             )
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:6], out[6]
+        carry, ys = out[:7], out[7]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -678,10 +718,10 @@ def _drive(
         _drain(pending, overlapped=False)
 
     # the run is whole again: hand the final carry back to the cluster
-    f_state, f_up, f_resp, f_adj, f_per, f_ov = carry
+    f_state, f_up, f_resp, f_adj, f_per, f_ov, f_po = carry
     cluster.state = f_state
     cluster.net = srunner.final_net(
-        f_up, f_resp, f_adj, f_per, compiled, ov=f_ov
+        f_up, f_resp, f_adj, f_per, compiled, ov=f_ov, po=f_po
     )
     cluster.set_loss(float(compiled.loss[-1]))  # host mirror (run_scenario)
     if checkpoint_path is not None:
@@ -742,6 +782,8 @@ def run_sweep_streamed(
     assemble: bool = True,
     pipeline: bool = True,
     shard: bool = False,
+    policy: Any | None = None,
+    policy_axes: dict[str, Any] | None = None,
 ) -> Any:
     """R replicas of a scenario, streamed segment by segment.
 
@@ -780,7 +822,11 @@ def run_sweep_streamed(
     params = cluster.dparams if cluster.backend == "delta" else cluster.params
     adj = srunner.precheck(cluster.state, cluster.net, cs.base, params)
     srunner.precheck_overload(cs.base, traffic, cluster.net)
+    if policy is not None and traffic is not None:
+        policy = pol.compile_policy(policy, n=cluster.n, m=traffic.static.m)
+    srunner.precheck_policy(policy, traffic, cluster.net)
     traffic = srunner.overload_traffic(traffic, cs.base)
+    traffic = srunner.policy_traffic(traffic, policy)
     tr_tensors = traffic.tensors if traffic is not None else None
     static_traffic = traffic.static if traffic is not None else None
     # raising validation/IO precedes the replica-key draws: a failed
@@ -797,6 +843,11 @@ def run_sweep_streamed(
     f_state, period0, ov0 = srunner.prepare_faults(
         cluster.state, cluster.net, cs.base, params
     )
+    po0 = srunner.prepare_policy(
+        policy, cluster.net, cluster.n,
+        static_traffic.max_retries if static_traffic is not None else 0,
+    )
+    knobs = ssweep.policy_knob_axes(policy, policy_axes, r)
     carry = (
         ssweep._broadcast_replicas(f_state, r),
         ssweep._broadcast_replicas(cluster.net.up, r),
@@ -804,6 +855,7 @@ def run_sweep_streamed(
         ssweep._broadcast_replicas(adj, r),
         ssweep._broadcast_replicas(period0, r),
         ssweep._broadcast_replicas(ov0, r),
+        ssweep._broadcast_replicas(po0, r),
     )
     sharding = ssweep._replica_sharding() if shard else None
     if sharding is not None:
@@ -812,6 +864,9 @@ def run_sweep_streamed(
         carry = tuple(
             jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), t)
             for t in carry
+        )
+        knobs = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), knobs
         )
     store_obj = None
     if store is not None:
@@ -848,6 +903,8 @@ def run_sweep_streamed(
             "segment_ticks": S,
             "total_ticks": T,
         }
+        if policy is not None:
+            meta["policy"] = policy.name
         args = (
             *carry[:5],
             cs.ev_tick,
@@ -861,12 +918,15 @@ def run_sweep_streamed(
             cs.base.faults,
             tr_tensors,
             carry[5],  # the overload feedback carry (or None)
+            carry[6],  # the remediation policy carry (or None)
+            knobs,
         )
         statics = dict(
             params=params,
             has_revive=cs.base.has_revive,
             traffic=static_traffic,
             overload=cs.base.overload,
+            policy=policy.config if policy is not None else None,
         )
         ssweep._dispatches += 1
         t0 = time.perf_counter()
@@ -915,7 +975,7 @@ def run_sweep_streamed(
 
     for seg, (a, b) in enumerate(bounds):
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:6], out[6]
+        carry, ys = out[:7], out[7]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -929,10 +989,16 @@ def run_sweep_streamed(
     if pending is not None:
         _drain(pending, overlapped=False)
 
-    states, up, resp, adj_out, per_out, ov_out = carry
+    states, up, resp, adj_out, per_out, ov_out, po_out = carry
     net_kw = {}
     if ov_out is not None:
         net_kw = dict(ov_cnt=ov_out[0], ov_gray=ov_out[1])
+    if po_out is not None:
+        net_kw.update(
+            po_press=po_out[0], po_shed=po_out[1], po_quar=po_out[2],
+            po_sends_w=po_out[3], po_deliv_w=po_out[4],
+            po_retry_cap=po_out[5],
+        )
     nets = NetState(up=up, responsive=resp, adj=adj_out, period=per_out,
                     **net_kw)
     if not assemble:

@@ -351,36 +351,43 @@ def _sweep_scan_impl(
     faults=None,
     tr_tensors=None,
     ov=None,
+    po=None,
+    po_knobs=None,
     *,
     params,
     has_revive: bool,
     traffic=None,
     overload=None,
+    policy=None,
 ):
     # ``tick0`` (traced int32 scalar shared by every replica, or None
     # for 0) is the segment offset of the streamed sweep
     # (scenarios/stream.py): closed over rather than batched, so the
     # vmapped body sees the same global tick numbering per segment.
     def one(state, up, responsive, adj, period, ev_tick, ev_kind, ev_node,
-            p_tick, p_gid, loss, keys, faults, tr_tensors, ov):
+            p_tick, p_gid, loss, keys, faults, tr_tensors, ov, po,
+            po_knobs):
         return runner._scenario_scan_impl(
             state, up, responsive, adj, period,
             ev_tick, ev_kind, ev_node, p_tick, p_gid, loss, keys,
-            tr_tensors, tick0, faults, ov,
+            tr_tensors, tick0, faults, ov, po, po_knobs,
             params=params, has_revive=has_revive, traffic=traffic,
-            overload=overload,
+            overload=overload, policy=policy,
         )
 
     return jax.vmap(
         one,
-        # batched: state/net (leading replica axis, period + overload
-        # carries included), node events (jitter reorders rows), loss
-        # (scaled), keys.  Shared: partition rows, failure-model
-        # tensors, and the traffic workload (one key stream — every
-        # replica serves the identical key batches against its own
-        # trajectory, exactly what a standalone run_scenario with this
-        # workload would serve).
-        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0, 0, None, None, 0),
+        # batched: state/net (leading replica axis, period + overload +
+        # policy carries included), node events (jitter reorders rows),
+        # loss (scaled), keys, and the POLICY KNOBS — traced [R] axes,
+        # so a knob sweep is one compile (ROADMAP item 4's frozen-knob
+        # refactor, pre-paid for the policy plane).  Shared: partition
+        # rows, failure-model tensors, and the traffic workload (one
+        # key stream — every replica serves the identical key batches
+        # against its own trajectory, exactly what a standalone
+        # run_scenario with this workload would serve).
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0, 0, None, None, 0,
+                 0, 0),
     )(
         state,
         up,
@@ -397,6 +404,8 @@ def _sweep_scan_impl(
         faults,
         tr_tensors,
         ov,
+        po,
+        po_knobs,
     )
 
 
@@ -405,7 +414,7 @@ def _sweep_scan_impl(
 # benchmarks/mem_census.py.
 _sweep_scan = jax.jit(
     _sweep_scan_impl,
-    static_argnames=("params", "has_revive", "traffic", "overload"),
+    static_argnames=("params", "has_revive", "traffic", "overload", "policy"),
     donate_argnums=(0, 1, 2, 3),
 )
 
@@ -443,6 +452,57 @@ def precheck_shard(replicas: int) -> None:
         )
 
 
+def policy_knob_axes(
+    policy: Any, policy_axes: dict[str, Sequence[int]] | None, replicas: int
+):
+    """The [R]-batched knob arrays the vmapped scan takes: swept knobs
+    come from ``policy_axes`` (one int per replica), everything else
+    broadcasts the compiled policy's operating point — knobs are traced
+    batch axes, never compile-time statics."""
+    from ringpop_tpu.policies import core as pol
+
+    if policy is None:
+        if policy_axes:
+            raise ValueError("policy_axes requires policy=")
+        return None
+    axes = dict(policy_axes or {})
+    vals = {}
+    for field in pol.PolicyKnobs._fields:
+        if field in axes:
+            v = np.asarray(axes.pop(field), np.int32)
+            if v.shape != (replicas,):
+                raise ValueError(
+                    f"policy axis {field!r} must have one value per "
+                    f"replica (got shape {v.shape} for {replicas})"
+                )
+            vals[field] = jnp.asarray(v)
+        else:
+            vals[field] = jnp.full(
+                (replicas,), int(getattr(policy.knobs, field)), jnp.int32
+            )
+    if axes:
+        raise ValueError(
+            f"unknown policy axes {sorted(axes)} "
+            f"(knobs: {', '.join(pol.PolicyKnobs._fields)})"
+        )
+    return pol.PolicyKnobs(**vals)
+
+
+def replica_policy(
+    policy: Any, policy_axes: dict[str, Sequence[int]] | None, r: int
+):
+    """Replica r's effective policy — the spec a standalone
+    ``run_scenario(policy=...)`` must be given to reproduce replica r
+    bit-for-bit (the ``replica_spec`` contract, extended to the policy
+    plane)."""
+    if policy is None:
+        return None
+    knobs = policy.knobs._asdict()
+    for key, vals in (policy_axes or {}).items():
+        knobs[key] = int(vals[r])
+    return policy._replace(knobs=type(policy.knobs)(**knobs))
+
+
 def run_sweep_compiled(
     state: Any,
     net: Any,
@@ -452,6 +512,8 @@ def run_sweep_compiled(
     *,
     shard: bool = False,
     traffic: Any | None = None,
+    policy: Any | None = None,
+    policy_axes: dict[str, Sequence[int]] | None = None,
 ) -> tuple[Any, Any, dict[str, jax.Array]]:
     """One jitted call: R replicas of the compiled scenario.
 
@@ -480,9 +542,17 @@ def run_sweep_compiled(
             f"({cs.replicas} replicas, {cs.base.ticks} ticks)"
         )
     adj = runner.precheck(state, net, cs.base, params)
+    runner.precheck_policy(policy, traffic, net)
     traffic = runner.overload_traffic(traffic, cs.base)
+    traffic = runner.policy_traffic(traffic, policy)
     state, period, ov = runner.prepare_faults(state, net, cs.base, params)
     r = cs.replicas
+    po = None
+    knobs = policy_knob_axes(policy, policy_axes, r)
+    if policy is not None:
+        po = runner.prepare_policy(
+            policy, net, cs.base.n, traffic.static.max_retries
+        )
     batched = [
         _broadcast_replicas(state, r),
         _broadcast_replicas(net.up, r),
@@ -491,6 +561,7 @@ def run_sweep_compiled(
         _broadcast_replicas(period, r),
     ]
     ov_b = _broadcast_replicas(ov, r)
+    po_b = _broadcast_replicas(po, r)
     if shard:
         precheck_shard(r)
         sharding = _replica_sharding()
@@ -505,6 +576,12 @@ def run_sweep_compiled(
             ov_b = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, sharding), ov_b
             )
+            po_b = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), po_b
+            )
+            knobs = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), knobs
+            )
     _dispatches += 1
     meta = {
         "backend": "delta" if hasattr(params, "wire_cap") else "dense",
@@ -514,9 +591,11 @@ def run_sweep_compiled(
     }
     if traffic is not None:
         meta["traffic_m"] = traffic.static.m
+    if policy is not None:
+        meta["policy"] = policy.name
     # routed through the dispatch ledger (obs/ledger.py): a call-through
     # when disabled, a recorded compile/execute + footprint row when on
-    states, up, resp, adj, period, ov, ys = default_ledger().dispatch(
+    states, up, resp, adj, period, ov, po, ys = default_ledger().dispatch(
         "run_sweep",
         _sweep_scan,
         *batched,
@@ -531,15 +610,23 @@ def run_sweep_compiled(
         cs.base.faults,
         traffic.tensors if traffic is not None else None,
         ov_b,
+        po_b,
+        knobs,
         params=params,
         has_revive=cs.base.has_revive,
         traffic=traffic.static if traffic is not None else None,
         overload=cs.base.overload,
+        policy=policy.config if policy is not None else None,
         _meta=meta,
     )
     net_kw = {}
     if ov is not None:
         net_kw = dict(ov_cnt=ov[0], ov_gray=ov[1])
+    if po is not None:
+        net_kw.update(
+            po_press=po[0], po_shed=po[1], po_quar=po[2],
+            po_sends_w=po[3], po_deliv_w=po[4], po_retry_cap=po[5],
+        )
     nets = type(net)(up=up, responsive=resp, adj=adj, period=period, **net_kw)
     return states, nets, ys
 
@@ -787,6 +874,14 @@ class SweepTrace:
             if "ov_gray_nodes" in m:
                 row["ov_gray_peak"] = int(m["ov_gray_nodes"].max())
                 row["ov_pressure_peak"] = int(m["ov_pressure_max"].max())
+            if "policy_shed" in m:
+                row["policy_shed"] = int(m["policy_shed"].sum())
+                row["policy_quarantine_peak"] = int(
+                    m["policy_quarantined"].max()
+                )
+                row["policy_retry_cap_min"] = int(
+                    m["policy_retry_cap"].min()
+                )
             if "lat_hist_ms" in self.planes:
                 from ringpop_tpu.traffic.latency import hist_stats
 

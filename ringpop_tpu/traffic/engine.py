@@ -63,6 +63,13 @@ class TrafficStatic(NamedTuple):
     # retries included) — which the scenario scan consumes for the
     # pressure update and never stacks into the trace.
     track_load: int = 0
+    # Remediation policy plane (ringpop_tpu/policies).  0 = off: the
+    # compiled program and the counter schema are unchanged.  1 adds
+    # the ``policy_shed`` counter and threads the per-tick policy
+    # planes (shed mask, quarantine mask, traced retry cap) through
+    # both serve chains; the scenario scan supplies them from the
+    # policy carry.
+    track_policy: int = 0
 
 
 class TrafficTensors(NamedTuple):
@@ -161,11 +168,17 @@ def total_sends(metrics: dict) -> int:
     consumed retries.  One definition shared by the sweep scorecards,
     the incident summaries, and the CLI serving line (host-side trace
     series: sums whole [T] arrays or single-tick rows alike)."""
-    return (
+    sends = (
         int(np.sum(metrics["handled_local"]))
         + int(np.sum(metrics["proxy_sends"]))
         + int(np.sum(metrics["proxy_retries"]))
     )
+    if "policy_shed" in metrics:
+        # a shed request still landed ONE arrival send on its pressured
+        # holder before being rejected — the same unit every other term
+        # counts, so amplification stays honest under admission control
+        sends += int(np.sum(metrics["policy_shed"]))
+    return sends
 
 
 def counter_names(static: TrafficStatic) -> tuple[str, ...]:
@@ -185,6 +198,11 @@ def counter_names(static: TrafficStatic) -> tuple[str, ...]:
         "ring_divergence",
     ]
     names += [f"hops{h}" for h in range(static.max_retries + 2)]
+    if static.track_policy:
+        # requests dropped by admission control at a shedding holder
+        # (policies/core.py); rides only policy-armed programs so a
+        # policy-off trace keeps the exact legacy schema
+        names += ["policy_shed"]
     if static.lookup_n:
         names += ["lookupns", "lookupn_incomplete"]
     if static.latency_buckets:
@@ -205,7 +223,7 @@ def plane_names(static: TrafficStatic) -> tuple[tuple[str, int], ...]:
 
 
 def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
-                net=None, period=None):
+                net=None, period=None, policy=None):
     n = view_rows.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
     rh, ro = tensors.ring_hashes, tensors.ring_owners
@@ -220,6 +238,14 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
         # damped members are quarantined from the viewer's RING, same
         # as the host ring_for (damping extension)
         mask_all = mask_all & ~damped
+    if policy is not None:
+        # the policy plane from LAST tick's fold: shed flags (admission
+        # control), ring quarantine (steered out of every viewer's ring
+        # like damped — liveness truth untouched, so misroutes-vs-truth
+        # inflate while a node is steered around), and the traced retry
+        # cap the amplification governor set
+        po_shed, po_quar, po_cap = policy
+        mask_all = mask_all & ~po_quar[None, :]
     kidx, viewer = sample_tick(tensors, t, static.m)
     khash = tensors.pool[kidx]
 
@@ -235,6 +261,14 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
     resolved = served & found0
     handled_local = resolved & (owner0 == viewer)
     unresolved = served & ~found0
+    shed_req = None
+    if policy is not None:
+        # admission control: a request whose first resolved holder is
+        # shedding is rejected AT ARRIVAL — one landed send on that
+        # holder (the rejection still costs its inbox), zero retries,
+        # never settled — instead of grinding duty-phase timeouts
+        shed_req = resolved & po_shed[jnp.clip(owner0, 0, n - 1)]
+        handled_local = handled_local & ~shed_req
 
     # handle-or-forward chain: a LIVE holder re-resolves through its OWN
     # view, a disagreement forwards again (reroute); a send to a DEAD
@@ -244,13 +278,23 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
     # Trip count max_retries+1: the holder reached by the last allowed
     # retry still gets its settle check.
     active = resolved & ~handled_local
+    if shed_req is not None:
+        active = active & ~shed_req
+    # the retry cap the chains compare against: the static budget, or
+    # (policy-armed) its minimum with the traced adaptive cap — the
+    # fori trip count stays static, only the comparison moves
+    cap = static.max_retries
+    if policy is not None:
+        cap = jnp.minimum(jnp.int32(static.max_retries), po_cap)
     lat_extras: dict[str, jax.Array] = {}
     track = bool(static.track_load)
     # send attempts landing per node (track_load): the arrival viewer
     # absorbs locally handled requests; each forward-chain iteration
     # below adds its attempt at the holder it targets (dead/off-duty
     # holders included — the send still lands on that node's inbox,
-    # which is exactly the load the overload feedback meters)
+    # which is exactly the load the overload feedback meters).  Shed
+    # requests land their ONE rejected arrival on the shedding holder,
+    # so admission keeps feeding the pressure meter it is gated on.
     loads = (
         jnp.zeros((n,), jnp.int32).at[viewer].add(
             handled_local.astype(jnp.int32)
@@ -258,6 +302,10 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
         if track
         else None
     )
+    if track and shed_req is not None:
+        loads = loads.at[jnp.clip(owner0, 0, n - 1)].add(
+            shed_req.astype(jnp.int32)
+        )
     if not static.latency_buckets:
         carry = (
             jnp.where(active, owner0, viewer),  # current holder
@@ -275,7 +323,7 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             hc = jnp.clip(h, 0, n - 1)
             if track:
                 lds = lds.at[hc].add(act.astype(jnp.int32))
-            has_retry = retries < static.max_retries
+            has_retry = retries < cap
             alive_h = gossip[hc]
             retry_dead = act & ~alive_h & has_retry  # failed send, re-sent
             nxt, f = lookup_masked_idx(rh, ro, khash, mask_all[hc], window=w)
@@ -346,7 +394,7 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             hc = jnp.clip(h, 0, n - 1)
             if track:
                 lds = lds.at[hc].add(act.astype(jnp.int32))
-            has_retry = retries < static.max_retries
+            has_retry = retries < cap
             alive_h = gossip[hc]
             # effective tick: the serve tick advanced by the backoff the
             # request has already slept through — a gray holder's duty
@@ -412,13 +460,16 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
     def count(mask):
         return jnp.sum(mask, dtype=jnp.int32)
 
+    failed = served & ~settled & ~unresolved
+    if shed_req is not None:
+        failed = failed & ~shed_req
     out = {
         "lookups": count(served),
         "dropped": jnp.int32(static.m) - count(served),
         "handled_local": count(handled_local),
-        "proxy_sends": count(resolved & ~handled_local),
+        "proxy_sends": count(active),
         "proxy_retries": jnp.sum(retries, dtype=jnp.int32),
-        "proxy_failed": count(served & ~settled & ~unresolved),
+        "proxy_failed": count(failed),
         "delivered": count(settled),
         "misroutes": count(resolved & truth_found & (owner0 != truth_owner)),
         "delivered_misroutes": count(
@@ -431,6 +482,10 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
     }
     for hp in range(static.max_retries + 2):
         out[f"hops{hp}"] = count(settled & (forwards == hp))
+    if static.track_policy:
+        out["policy_shed"] = (
+            count(shed_req) if shed_req is not None else jnp.int32(0)
+        )
     if static.lookup_n:
         # the preference walk builds an [M, W, W] dedup cube, so its
         # window uses lookup_n_idx's n-scaled heuristic rather than the
@@ -474,6 +529,7 @@ def serve_tick(
     damped: jax.Array | None = None,
     net: Any | None = None,
     period: jax.Array | None = None,
+    policy: tuple | None = None,
 ) -> dict[str, jax.Array]:
     """One traffic tick's counters (int32 scalars, ``counter_names``
     schema, plus the ``plane_names`` histogram rows when the latency
@@ -492,19 +548,24 @@ def serve_tick(
     ``net`` (the tick's ``NetState`` with its ACTIVE link rules) and
     ``period`` (the int32[N] per-node period row, or None) feed the SLO
     latency plane only — with ``static.latency_buckets == 0`` they are
-    ignored and the program is exactly the legacy one."""
+    ignored and the program is exactly the legacy one.
+
+    ``policy`` is the remediation plane from the LAST tick's policy
+    fold — ``(shed bool[N], quarantine bool[N], retry_cap i32 scalar)``
+    — or None; with ``static.track_policy == 0`` and ``policy=None``
+    the program and counter schema are exactly the legacy ones."""
     get_rows = view_rows if callable(view_rows) else (lambda: view_rows)
     if static.every == 1:
         return _serve_impl(
             get_rows(), up, responsive, tensors, t, static, damped,
-            net=net, period=period,
+            net=net, period=period, policy=policy,
         )
     zeros = _zero_counters(static, up.shape[0])
     return jax.lax.cond(
         t % static.every == 0,
         lambda _: _serve_impl(
             get_rows(), up, responsive, tensors, t, static, damped,
-            net=net, period=period,
+            net=net, period=period, policy=policy,
         ),
         lambda _: zeros,
         None,
@@ -523,11 +584,12 @@ def serve_once(
     damped: jax.Array | None = None,
     net: Any | None = None,
     period: jax.Array | None = None,
+    policy: tuple | None = None,
 ) -> dict[str, jax.Array]:
     """The standalone jitted entry: ONE dispatch serves one traffic
     tick against a snapshot of membership state (benchmarks, ad-hoc
     serving against a live ``SimCluster``)."""
     return serve_tick(
         view_rows, up, responsive, tensors, t, static=static, damped=damped,
-        net=net, period=period,
+        net=net, period=period, policy=policy,
     )

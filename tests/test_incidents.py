@@ -29,6 +29,8 @@ GOLDEN_PAIRS = [
     for backend in lib.INCIDENTS[name].backends
 ]
 
+POLICY_TRIPLES = lib.policy_golden_grid()
+
 
 # ---------------------------------------------------------------------------
 # fast: host-only
@@ -141,6 +143,34 @@ def test_overload_control_build():
     assert not any(e.op == "overload" for e in spec.events)
 
 
+def test_policy_golden_grid_shape_and_pins_exist():
+    """The policy-armed grid covers cascading_overload under EVERY
+    policy on both backends plus every other incident under the
+    winning policy, each triple valid and its pin checked in (the
+    nightly lane bit-compares; this fast check catches a missing or
+    orphaned pin without compiling anything)."""
+    from ringpop_tpu.policies import core as pol
+
+    triples = lib.policy_golden_grid()
+    casc = [(p, b) for n, p, b in triples if n == "cascading_overload"]
+    assert sorted(casc) == sorted(
+        (p, b) for p in pol.list_policies() for b in ("dense", "delta")
+    )
+    others = [(n, p) for n, p, b in triples if n != "cascading_overload"]
+    assert sorted(n for n, _ in others) == sorted(
+        n for n in lib.incident_names() if n != "cascading_overload"
+    )
+    assert all(p == lib.GOLDEN_POLICY for _, p in others)
+    for name, policy, backend in triples:
+        assert policy in pol.POLICIES
+        assert backend in lib.INCIDENTS[name].backends
+        path = lib.golden_path(name, backend, GOLDEN_DIR, policy=policy)
+        assert os.path.exists(path), (
+            f"missing policy golden {path}; pin with "
+            "tools/pin_incidents.py --policies"
+        )
+
+
 def test_cli_list_incidents(capsys):
     from ringpop_tpu.cli import tick_cluster
 
@@ -189,4 +219,30 @@ def test_golden_incident_grid(name, backend):
     assert got == want, (
         f"{name}.{backend} diverged from its golden summary; if the "
         "change is intentional re-pin with tools/pin_incidents.py"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    golden_skip_reason() is not None, reason=str(golden_skip_reason())
+)
+@pytest.mark.parametrize("name,policy,backend", POLICY_TRIPLES)
+def test_golden_policy_grid(name, policy, backend):
+    """The policy-armed golden grid: every pinned (incident, policy,
+    backend) triple's remediated summary matches its file bit-for-bit
+    — the scorecard that keeps a policy honest across ALL outages, not
+    just the one it was tuned to beat (re-pin after an intentional
+    change with ``tools/pin_incidents.py --policies``)."""
+    path = lib.golden_path(name, backend, GOLDEN_DIR, policy=policy)
+    assert os.path.exists(path), (
+        f"missing policy golden {path}; pin with "
+        "tools/pin_incidents.py --policies"
+    )
+    with open(path) as f:
+        want = json.load(f)
+    got = lib.run_golden(name, backend, policy=policy)
+    assert got == want, (
+        f"{name}+{policy}.{backend} diverged from its golden summary; "
+        "if the change is intentional re-pin with "
+        "tools/pin_incidents.py --policies"
     )

@@ -1,0 +1,647 @@
+"""The remediation policy plane: compiled operator loop vs host oracle.
+
+The acceptance oracle extends ``tests/test_overload.py``'s per-tick
+host walk with the three policy mechanisms, consumed with the same
+one-tick causality as the compiled scan (serve at ``t`` reads the
+planes the fold produced at ``t-1``):
+
+* **admission** — a request whose first resolved holder is shedding is
+  rejected at arrival: one landed send on that holder, zero retries,
+  counted as ``policy_shed`` (never delivered, never proxy_failed);
+* **quarantine** — pressured nodes are steered out of every viewer's
+  served ring (the damped-mask mechanism), so the host rings exclude
+  them at construction;
+* **retry budget** — the origin retry gate compares against
+  ``min(max_retries, po_retry_cap)``, the cap the trailing
+  amplification window set.
+
+The post-serve fold is THE SAME ``policies.core.policy_update``
+function executed on np arrays — parity is equality, not tolerance,
+on both backends.  Fast lane: pure-host units + precheck rejections +
+checkpoint v5 round trip + the dense oracle per policy (one compiled
+program for all four: mechanism enables are knob VALUES, so the
+parametrization recompiles nothing).  Delta twin, streamed/SIGKILL
+resume, and the knob-axis sweep parity ride the slow lane.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import SwimParams
+from ringpop_tpu.ops import ring_ops
+from ringpop_tpu.policies import core as pol
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios import faults as sfaults
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+from ringpop_tpu.traffic import engine as tengine
+from ringpop_tpu.traffic import latency as tlat
+
+N = 10
+LEAN = SwimParams(suspicion_ticks=8, ping_req_size=1)
+B = 10
+# exact-window workload (test_overload.py): host rings and the masked
+# walk agree on every key, so the oracle is equality with no residue
+PO_WL = {"kind": "zipf", "keys_per_tick": 24, "pool": 256, "zipf_s": 1.2,
+         "window": N * ring_ops.DEFAULT_REPLICA_POINTS,
+         "latency_buckets": B}
+
+# the overload incident the policies remediate: gray seeds duty
+# timeouts (retry pressure for the amp governor), the feedback loop
+# grays hot holders, zipf skew concentrates load (shed/quarantine prey)
+PO_SPEC = {
+    "ticks": 12,
+    "events": [
+        {"at": 1, "op": "gray", "nodes": [1, 2], "factor": 4, "until": 10},
+        {"at": 3, "op": "kill", "node": 9},
+        {"at": 1, "op": "overload", "until": 12, "capacity": 1,
+         "threshold": 5, "recover": 1, "factor": 4},
+    ],
+}
+
+SLO_COUNTERS = ("lookups", "dropped", "handled_local", "delivered",
+                "proxy_retries", "proxy_failed", "send_errors",
+                "retry_succeeded", "gray_timeouts", "lat_count",
+                "lat_sum_ms", "lat_max_ms", "policy_shed")
+
+# aggressive operating points so every enabled mechanism demonstrably
+# fires at N=10 within 12 ticks (the defaults are tuned for incident
+# scale; the oracle wants engagement, not recovery)
+ORACLE_KNOBS = {
+    "admission": dict(admit_capacity=2, shed_hi=3, shed_lo=1),
+    "retry_budget": dict(admit_capacity=2, amp_threshold_x16=20),
+    "quarantine": dict(admit_capacity=2, quar_hi=3, quar_lo=1),
+    "combined": dict(admit_capacity=2, shed_hi=3, shed_lo=1,
+                     quar_hi=4, quar_lo=1, amp_threshold_x16=20),
+}
+
+
+def _oracle_policy(name: str) -> pol.CompiledPolicy:
+    return pol.compile_policy(name, n=N, m=PO_WL["keys_per_tick"],
+                              **ORACLE_KNOBS[name])
+
+
+# ---------------------------------------------------------------------------
+# fast: pure-host units
+# ---------------------------------------------------------------------------
+
+
+def test_policy_parse_and_catalog():
+    assert pol.parse_policy_arg("combined") == ("combined", {})
+    name, kv = pol.parse_policy_arg("admission:shed_hi=4, shed_lo=1")
+    assert name == "admission" and kv == {"shed_hi": 4, "shed_lo": 1}
+    with pytest.raises(ValueError, match="unknown policy"):
+        pol.parse_policy_arg("bogus")
+    with pytest.raises(ValueError, match="bad policy knob"):
+        pol.parse_policy_arg("combined:nope=3")
+    with pytest.raises(ValueError, match="bad policy knob"):
+        pol.parse_policy_arg("combined:shed_hi")  # no '='
+    assert pol.list_policies() == sorted(pol.POLICIES)
+    text = pol.format_catalog(16, 128)
+    for name in pol.POLICIES:
+        assert name in text
+
+
+def test_policy_compile_defaults_and_round_trip():
+    m = PO_WL["keys_per_tick"]
+    # a single-mechanism policy keeps the OTHER mechanisms at INF (off)
+    cp = pol.compile_policy("admission", n=N, m=m)
+    assert cp.knobs.quar_hi == pol.INF and cp.knobs.quar_lo == pol.INF
+    assert cp.knobs.amp_threshold_x16 == pol.INF
+    assert cp.knobs.shed_hi < pol.INF
+    cq = pol.compile_policy("quarantine", n=N, m=m)
+    assert cq.knobs.shed_hi == pol.INF and cq.knobs.quar_hi < pol.INF
+    cr = pol.compile_policy("retry_budget", n=N, m=m)
+    assert cr.knobs.shed_hi == pol.INF and cr.knobs.quar_hi == pol.INF
+    assert cr.knobs.amp_threshold_x16 < pol.INF
+    cc = pol.compile_policy("combined", n=N, m=m)
+    assert cc.knobs.shed_hi < pol.INF
+    assert cc.knobs.quar_hi < pol.INF
+    assert cc.knobs.amp_threshold_x16 < pol.INF
+    # knob override + amp_window (compile-time) override
+    co = pol.compile_policy("combined:shed_hi=7", n=N, m=m, amp_window=4)
+    assert co.knobs.shed_hi == 7 and co.config.amp_window == 4
+    with pytest.raises(ValueError, match="amp_window"):
+        pol.compile_policy("combined", n=N, m=m, amp_window=0)
+    # cursor round trip is bit-exact (no scale rederivation)
+    for cand in (cp, cq, cr, cc, co):
+        assert pol.from_dict(pol.to_dict(cand)) == cand
+    # an already-compiled policy passes through compile_policy untouched
+    assert pol.compile_policy(cc, n=99, m=1) == cc
+    assert pol.compile_policy(pol.to_dict(cc), n=99, m=1) == cc
+
+
+def test_policy_update_hysteresis_and_amp_window():
+    cfg = pol.PolicyConfig(amp_window=4)
+    knobs = pol.PolicyKnobs(admit_capacity=2, shed_hi=6, shed_lo=2,
+                            quar_hi=4, quar_lo=1, amp_threshold_x16=24,
+                            retry_floor=0)
+    press = np.zeros(3, np.int32)
+    shed = np.zeros(3, bool)
+    quar = np.zeros(3, bool)
+    sw = np.zeros(4, np.int32)
+    dw = np.zeros(4, np.int32)
+
+    def tick(t, sends, tick_sends, delivered):
+        nonlocal press, shed, quar, sw, dw
+        press, shed, quar, sw, dw, cap, amp = pol.policy_update(
+            cfg, knobs, press, shed, quar, sw, dw,
+            np.asarray(sends, np.int32), np.int32(tick_sends),
+            np.int32(delivered), t, 3)
+        return int(cap), int(amp)
+
+    # node 0 hammered at 5/tick: leaky bucket fills +3/tick
+    cap, amp = tick(0, [5, 2, 0], 7, 7)
+    assert list(press) == [3, 0, 0] and not shed.any() and not quar.any()
+    assert cap == 3 and amp == 16  # sends == delivered: amp = 1.0 x16
+    tick(1, [5, 2, 0], 7, 7)
+    assert list(press) == [6, 0, 0]
+    assert shed[0] and quar[0]  # both latched at their hi marks
+    # drain: shed clears when press stops exceeding shed_lo, quarantine
+    # (lower lo) holds longer — hysteresis, not threshold-crossing
+    tick(2, [0, 0, 0], 0, 7)
+    assert list(press) == [4, 0, 0] and shed[0] and quar[0]
+    tick(3, [0, 0, 0], 0, 7)
+    assert list(press) == [2, 0, 0] and not shed[0] and quar[0]
+    tick(4, [0, 0, 0], 0, 7)
+    assert list(press) == [0, 0, 0] and not quar[0]
+    # amp governor: a storm tick (80 sends / 10 delivered, landing in
+    # a window still holding the quiet ticks above) pushes trailing
+    # amp past the threshold -> cap collapses to the floor; four quiet
+    # ticks roll the storm out of the ring -> restored
+    cap, amp = tick(5, [0, 0, 0], 80, 10)
+    assert amp >= 24 and cap == 0
+    for t in range(6, 10):
+        cap, amp = tick(t, [0, 0, 0], 7, 7)
+    assert amp == 16 and cap == 3
+
+
+def test_policy_requires_traffic_and_clear():
+    c = SimCluster(N, LEAN, seed=2)
+    # a policy with no workload has nothing to meter: rejected before
+    # any PRNG key is drawn
+    with pytest.raises(ValueError, match="serve plane"):
+        c.run_scenario(PO_SPEC, policy="combined")
+    # leftover policy state from a previous run is rejected loudly
+    c.net = c.net._replace(
+        po_press=jnp.ones(N, jnp.int32),
+        po_shed=jnp.zeros(N, bool), po_quar=jnp.zeros(N, bool),
+        po_sends_w=jnp.zeros(8, jnp.int32),
+        po_deliv_w=jnp.zeros(8, jnp.int32),
+        po_retry_cap=jnp.int32(3),
+    )
+    with pytest.raises(ValueError, match="clear_policy"):
+        c.run_scenario(PO_SPEC, traffic=PO_WL, policy="combined")
+    c.clear_policy()
+    assert c.net.po_press is None and c.net.po_retry_cap is None
+    # an amp-window mismatch against checkpointed windows is rejected
+    # (zeros pass the leftover check; the SHAPE is still wrong)
+    c.net = c.net._replace(
+        po_press=jnp.zeros(N, jnp.int32),
+        po_shed=jnp.zeros(N, bool), po_quar=jnp.zeros(N, bool),
+        po_sends_w=jnp.zeros(4, jnp.int32),
+        po_deliv_w=jnp.zeros(4, jnp.int32),
+        po_retry_cap=jnp.int32(3),
+    )
+    with pytest.raises(ValueError, match="amp window"):
+        c.run_scenario(PO_SPEC, traffic=PO_WL, policy="combined")
+
+
+def test_policy_checkpoint_round_trip(tmp_path):
+    """Checkpoint v5 carries the six ``po_*`` tensors bit-exactly, and
+    a policy-less net keeps them None (the optional-field contract —
+    no format bump)."""
+    from ringpop_tpu import checkpoint as ckpt
+
+    c = SimCluster(N, LEAN, seed=4)
+    fields = dict(
+        po_press=np.arange(N, dtype=np.int32) * 3,
+        po_shed=(np.arange(N) % 3 == 0),
+        po_quar=(np.arange(N) % 4 == 1),
+        po_sends_w=np.arange(8, dtype=np.int32) * 7,
+        po_deliv_w=np.arange(8, dtype=np.int32) * 5,
+        po_retry_cap=np.int32(1),
+    )
+    c.net = c.net._replace(
+        **{k: jnp.asarray(v) for k, v in fields.items()}
+    )
+    path = str(tmp_path / "po.npz")
+    ckpt.save(c, path)
+    d = ckpt.load(path)
+    for k, v in fields.items():
+        np.testing.assert_array_equal(np.asarray(getattr(d.net, k)), v, k)
+    c2 = SimCluster(N, LEAN, seed=4)
+    path2 = str(tmp_path / "none.npz")
+    ckpt.save(c2, path2)
+    d2 = ckpt.load(path2)
+    for k in fields:
+        assert getattr(d2.net, k) is None, k
+
+
+# ---------------------------------------------------------------------------
+# the host walk (test_overload.py's oracle + the three policy hooks)
+# ---------------------------------------------------------------------------
+
+
+def _host_policy_tick_loads(cluster, ct, t, shed, quar, cap):
+    """One policy-armed SLO tick on the host.  ``shed``/``quar``/``cap``
+    are LAST tick's policy planes (the causality the scan enforces):
+    quarantined nodes are excluded from every host ring at
+    construction (the ``mask_all &= ~po_quar`` twin), a request whose
+    first resolved holder is shedding lands one send there and is
+    counted as ``policy_shed`` (neither delivered nor failed), and the
+    retry gate compares against ``min(max_retries, cap)``.  Returns
+    (counters, hist int64[B], loads int64[N])."""
+    st = ct.static
+    m = st.m
+    idx, viewers = tengine.sample_tick(ct.tensors, jnp.int32(t), m)
+    idx, viewers = np.asarray(idx), np.asarray(viewers)
+    bo_ms = tlat.backoff_ms_schedule(st.max_retries)
+    bo_ticks = tlat.backoff_tick_offsets(st.max_retries, st.period_ms)
+    cap_eff = min(int(st.max_retries), int(cap))
+
+    net = cluster.net
+    period = (
+        np.asarray(net.period) if net.period is not None
+        else np.ones(cluster.n, np.int32)
+    )
+
+    def duty(h, te):
+        per = max(int(period[h]), 1)
+        return te % per == (h * (0x9E37 | 1)) % per
+
+    live = set(int(i) for i in cluster.live_indices())
+    keys = ct.spec.pool_keys()
+    addr_index = cluster.book.index
+    rings: dict[int, object] = {}
+
+    def ring_of(node):
+        # ring_for + the policy quarantine mask: a quarantined member
+        # is steered out of every viewer's ring exactly like a damped
+        # one (liveness truth untouched — it still serves arrivals)
+        if node not in rings:
+            damped_row = (
+                np.asarray(cluster.state.damped[node])
+                if getattr(cluster.state, "damped", None) is not None
+                else None
+            )
+            servers = [
+                mb["address"]
+                for mb in cluster.members(node)
+                if mb["status"] in ("alive", "suspect")
+                and (damped_row is None
+                     or not damped_row[addr_index[mb["address"]]])
+                and not quar[addr_index[mb["address"]]]
+            ]
+            ring = HashRing()
+            ring.add_remove_servers(servers, [])
+            rings[node] = (ring, bool(servers))
+        return rings[node]
+
+    def masked_lookup(node, key):
+        ring, nonempty = ring_of(node)
+        if not nonempty:
+            return None
+        addr = ring.lookup(key)
+        return None if addr is None else addr_index[addr]
+
+    counts = {k: 0 for k in SLO_COUNTERS}
+    hist = np.zeros(st.latency_buckets, np.int64)
+    loads = np.zeros(cluster.n, np.int64)
+
+    def deliver(lat, retries):
+        counts["delivered"] += 1
+        counts["lat_count"] += 1
+        counts["lat_sum_ms"] += lat
+        counts["lat_max_ms"] = max(counts["lat_max_ms"], lat)
+        if retries > 0:
+            counts["retry_succeeded"] += 1
+        hist[int(tlat.bucket_index(np.int64(lat), st.latency_buckets))] += 1
+
+    for k in range(m):
+        v = int(viewers[k])
+        if v not in live:
+            counts["dropped"] += 1
+            continue
+        counts["lookups"] += 1
+        key = keys[int(idx[k])]
+        owner0 = masked_lookup(v, key)
+        if owner0 is None:
+            continue  # unresolved at arrival: no load, never settled
+        if shed[owner0]:
+            # admission control: rejected AT the pressured holder —
+            # the rejection still costs its inbox one landed send
+            counts["policy_shed"] += 1
+            loads[owner0] += 1
+            continue
+        if owner0 == v:
+            counts["handled_local"] += 1
+            loads[v] += 1
+            deliver(0, 0)
+            continue
+        h, retries = owner0, 0
+        lat = 0  # no delay rules in the oracle spec: zero link legs
+        settled, unres = False, False
+        for _ in range(st.max_retries + 1):
+            loads[h] += 1  # the attempt lands on h's inbox either way
+            te = t + int(bo_ticks[min(retries, st.max_retries)])
+            alive_h = h in live
+            if not alive_h or not duty(h, te):
+                counts["send_errors"] += 1
+                if alive_h:
+                    counts["gray_timeouts"] += 1
+                if retries < cap_eff:
+                    lat += int(bo_ms[retries])
+                    retries += 1
+                    continue
+                break
+            nxt = masked_lookup(h, key)
+            if nxt is None:
+                unres = True
+                break
+            if nxt == h:
+                settled = True
+                break
+            if retries < cap_eff:
+                lat += int(bo_ms[retries])
+                h = nxt
+                retries += 1
+                continue
+            break
+        counts["proxy_retries"] += retries
+        if settled:
+            deliver(lat, retries)
+        elif not unres:
+            counts["proxy_failed"] += 1
+    return counts, hist, loads
+
+
+def _host_policy_walk(backend, spec_obj, wl, seed, cp, **kw):
+    """The policy twin of ``_host_overload_walk``: per-tick protocol
+    step with the effective period row, the policy-armed host serve,
+    then BOTH feedback folds (overload + policy) over the same load
+    vector — the scan's exact tick body on the host."""
+    c = SimCluster(N, LEAN, seed=seed, backend=backend, **kw)
+    ct = c.compile_traffic(wl)
+    cfg = sfaults.overload_config(spec_obj)
+    compiled = scompile.compile_spec(spec_obj, c.n, base_loss=c.params.loss)
+    keys = scompile.key_schedule(c._split, compiled)
+    switches = sfaults.period_switches(spec_obj, c.n)
+    by_tick = defaultdict(list)
+    for at, op, arg in scompile.expand_events(spec_obj, c.params.loss):
+        by_tick[at].append((op, arg))
+    pressure = np.zeros(c.n, np.int32)
+    gray = np.zeros(c.n, bool)
+    max_retries = int(ct.static.max_retries)
+    w = cp.config.amp_window
+    po_press = np.zeros(c.n, np.int32)
+    po_shed = np.zeros(c.n, bool)
+    po_quar = np.zeros(c.n, bool)
+    po_sw = np.zeros(w, np.int32)
+    po_dw = np.zeros(w, np.int32)
+    po_cap = np.int32(max_retries)
+    rows = []
+    for t in range(spec_obj.ticks):
+        ops = sorted(by_tick.get(t, ()), key=lambda x: scompile._OP_RANK[x[0]])
+        for op, arg in ops:
+            if op == "kill":
+                c.kill(arg)
+            elif op == "suspend":
+                c.suspend(arg)
+            elif op == "resume":
+                c.resume(arg)
+            elif op == "loss":
+                c.set_loss(arg)
+        row = np.ones(c.n, np.int32)
+        for at, r in switches:
+            if at <= t:
+                row = r
+        per_eff = np.where(gray, np.maximum(row, cfg.factor), row)
+        c.net = c.net._replace(period=jnp.asarray(per_eff.astype(np.int32)))
+        if backend == "delta":
+            c.state, _ = sdelta.delta_step(
+                c.state, c.net, keys[t], params=c.dparams
+            )
+        else:
+            c.state, _ = sim.swim_step(c.state, c.net, keys[t], params=c.params)
+        counts, hist, loads = _host_policy_tick_loads(
+            c, ct, t, po_shed, po_quar, po_cap
+        )
+        in_win = cfg.start <= t < cfg.end
+        pressure, gray = sfaults.overload_update(
+            cfg, in_win, pressure, gray, loads.astype(np.int32)
+        )
+        (po_press, po_shed, po_quar, po_sw, po_dw, po_cap,
+         amp_x16) = pol.policy_update(
+            cp.config, cp.knobs, po_press, po_shed, po_quar, po_sw,
+            po_dw, loads.astype(np.int32), np.int32(loads.sum()),
+            np.int32(counts["delivered"]), t, max_retries)
+        rows.append((counts, hist, int(gray.sum()), int(pressure.max()),
+                     int(po_shed.sum()), int(po_quar.sum()),
+                     int(po_press.max()), int(po_cap), int(amp_x16)))
+    po_final = (po_press, po_shed, po_quar, po_sw, po_dw, po_cap)
+    return c, pressure, gray, po_final, rows
+
+
+def _assert_policy_parity(backend, name, **kw):
+    cp = _oracle_policy(name)
+    spec_obj = ScenarioSpec.from_dict(PO_SPEC)
+    a = SimCluster(N, LEAN, seed=11, backend=backend, **kw)
+    ct = a.compile_traffic(PO_WL)
+    trace = a.run_scenario(spec_obj, traffic=ct, policy=cp)
+    b, pressure, gray, po_final, rows = _host_policy_walk(
+        backend, spec_obj, PO_WL, seed=11, cp=cp, **kw
+    )
+    for t, (counts, hist, gray_n, p_max, shed_n, quar_n, po_max, cap,
+            amp) in enumerate(rows):
+        for cname, value in counts.items():
+            got = int(trace.metrics[cname][t])
+            assert got == value, (t, cname, got, value)
+        np.testing.assert_array_equal(
+            trace.planes["lat_hist_ms"][t], hist, err_msg=f"tick {t}"
+        )
+        assert int(trace.metrics["ov_gray_nodes"][t]) == gray_n, t
+        assert int(trace.metrics["ov_pressure_max"][t]) == p_max, t
+        assert int(trace.metrics["policy_shed_nodes"][t]) == shed_n, t
+        assert int(trace.metrics["policy_quarantined"][t]) == quar_n, t
+        assert int(trace.metrics["policy_pressure_max"][t]) == po_max, t
+        assert int(trace.metrics["policy_retry_cap"][t]) == cap, t
+        assert int(trace.metrics["policy_amp_x16"][t]) == amp, t
+    # both feedback states round-trip onto the final net
+    np.testing.assert_array_equal(np.asarray(a.net.ov_cnt), pressure)
+    np.testing.assert_array_equal(np.asarray(a.net.ov_gray), gray)
+    for field, want in zip(
+        ("po_press", "po_shed", "po_quar", "po_sends_w", "po_deliv_w",
+         "po_retry_cap"), po_final,
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.net, field)), want, err_msg=field
+        )
+    # state + net + checksum parity (the trajectory the policy steered
+    # is identical)
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state), jax.tree_util.tree_leaves(b.state)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    np.testing.assert_array_equal(np.asarray(a.net.up), np.asarray(b.net.up))
+    np.testing.assert_array_equal(
+        np.asarray(a.net.responsive), np.asarray(b.net.responsive)
+    )
+    assert a.checksums() == b.checksums()
+    # every ENABLED mechanism demonstrably fired, every DISABLED one
+    # stayed silent (INF thresholds are really off — the single-program
+    # guarantee has observable teeth)
+    mechs = pol.POLICIES[name][1]
+    max_retries = int(ct.static.max_retries)
+    shed_total = int(trace.metrics["policy_shed"].sum())
+    quar_peak = int(trace.metrics["policy_quarantined"].max())
+    cap_min = int(trace.metrics["policy_retry_cap"].min())
+    if "admission" in mechs:
+        assert shed_total > 0
+    else:
+        assert shed_total == 0
+    if "quarantine" in mechs:
+        assert quar_peak > 0
+    else:
+        assert quar_peak == 0
+    if "retry_budget" in mechs:
+        assert cap_min < max_retries
+    else:
+        assert cap_min == max_retries
+
+
+@pytest.mark.parametrize("name", sorted(pol.POLICIES))
+def test_policy_parity_dense(name):
+    """Tier-1 acceptance oracle, one parametrization per policy:
+    compiled scan == per-tick host walk, bit for bit — counters
+    (``policy_shed`` included), histogram, overload AND policy
+    telemetry, final state/net/checksums.  All four share ONE compiled
+    program (knobs are traced); only the knob values differ."""
+    _assert_policy_parity("dense", name)
+
+
+@pytest.mark.slow
+def test_policy_parity_delta():
+    """The delta twin of the acceptance oracle (own XLA compile of the
+    policy-armed scenario program, so it rides the nightly lane)."""
+    _assert_policy_parity(
+        "delta", "combined", capacity=N, wire_cap=N, claim_grid=3 * N * N
+    )
+
+
+# ---------------------------------------------------------------------------
+# slow: execution-strategy + sweep-axis contracts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_policy_streamed_and_resume_bit_identical(tmp_path):
+    """Streaming a policy-armed run is an execution strategy (same
+    trace, same final policy state), and a SIGKILL mid-run resumes
+    from the checkpoint v5 ``po_*`` tensors + the cursor's exact
+    compiled knobs to a bit-identical end state."""
+    from ringpop_tpu.scenarios import stream as sstream
+
+    spec = {
+        "ticks": 24,
+        "events": [
+            {"at": 2, "op": "overload", "until": 24, "capacity": 1,
+             "threshold": 5, "recover": 1, "factor": 4},
+        ],
+    }
+    cp = _oracle_policy("combined")
+    a = SimCluster(N, LEAN, seed=7)
+    ta = a.run_scenario(spec, traffic=PO_WL, policy=cp)
+    assert int(ta.metrics["policy_shed"].sum()) > 0
+    b = SimCluster(N, LEAN, seed=7)
+    tb = b.run_scenario(spec, traffic=PO_WL, policy=cp, segment_ticks=7)
+    for k in ta.metrics:
+        np.testing.assert_array_equal(ta.metrics[k], tb.metrics[k], err_msg=k)
+    np.testing.assert_array_equal(
+        np.asarray(a.net.po_press), np.asarray(b.net.po_press)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.net.po_sends_w), np.asarray(b.net.po_sends_w)
+    )
+
+    # killed-after-first-checkpoint + resume == uninterrupted
+    ckpt_path = str(tmp_path / "po.npz")
+    cv = SimCluster(N, LEAN, seed=7)
+    with pytest.raises(sstream.StreamInterrupted):
+        sstream.run_streamed(
+            cv, spec, segment_ticks=7, traffic=PO_WL, policy=cp,
+            checkpoint_path=ckpt_path, interrupt_after=1,
+        )
+    # the checkpoint carries the mid-run policy tensors
+    from ringpop_tpu import checkpoint as ckpt
+
+    mid = ckpt.load(ckpt_path)
+    assert mid.net.po_press is not None
+    assert mid.net.po_sends_w.shape == (cp.config.amp_window,)
+    cr, tr = sstream.resume(ckpt_path)
+    for k in ta.metrics:
+        np.testing.assert_array_equal(ta.metrics[k], tr.metrics[k], err_msg=k)
+    for field in ("po_press", "po_shed", "po_quar", "po_sends_w",
+                  "po_deliv_w", "po_retry_cap"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.net, field)),
+            np.asarray(getattr(cr.net, field)), err_msg=field,
+        )
+    assert a.checksums() == cr.checksums()
+
+
+@pytest.mark.slow
+def test_policy_sweep_axes_parity():
+    """Policy knobs as traced batch axes: replica r of a
+    ``policy_axes`` sweep is bit-identical to a standalone
+    ``run_scenario`` armed with ``sweep.replica_policy``'s effective
+    knobs — and an INF axis value really turns the mechanism off in
+    that replica only (one compiled program for the whole grid)."""
+    from ringpop_tpu.scenarios import sweep as ssweep
+
+    spec = {
+        "ticks": 16,
+        "events": [
+            {"at": 1, "op": "overload", "until": 16, "capacity": 1,
+             "threshold": 5, "recover": 1, "factor": 4},
+        ],
+    }
+    cp = _oracle_policy("admission")
+    axes = {"shed_hi": [ORACLE_KNOBS["admission"]["shed_hi"], pol.INF]}
+    c = SimCluster(N, LEAN, seed=9)
+    ct = c.compile_traffic(PO_WL)
+    strace = c.run_sweep(spec, 2, traffic=ct, policy=cp, policy_axes=axes)
+    rep0, rep1 = strace.replica(0), strace.replica(1)
+    # replica 0 sheds; replica 1's INF threshold never latches
+    assert int(rep0.metrics["policy_shed"].sum()) > 0
+    assert int(rep1.metrics["policy_shed"].sum()) == 0
+    # replica 1 standalone from its replica key + its effective knobs
+    d = SimCluster(N, LEAN, seed=9)
+    d.key = jnp.asarray(strace.replica_keys[1])
+    td = d.run_scenario(
+        spec, traffic=ct, policy=ssweep.replica_policy(cp, axes, 1)
+    )
+    for k in td.metrics:
+        np.testing.assert_array_equal(rep1.metrics[k], td.metrics[k],
+                                      err_msg=k)
+    np.testing.assert_array_equal(
+        rep1.planes["lat_hist_ms"], td.planes["lat_hist_ms"]
+    )
+    for field in ("po_press", "po_shed", "po_quar", "po_sends_w",
+                  "po_deliv_w", "po_retry_cap"):
+        np.testing.assert_array_equal(
+            np.asarray(strace.final_nets[field][1]
+                       if isinstance(strace.final_nets, dict)
+                       else getattr(strace.final_nets, field)[1]),
+            np.asarray(getattr(d.net, field)), err_msg=field,
+        )

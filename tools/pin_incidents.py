@@ -9,7 +9,14 @@ protocol or serving change; the nightly golden lane
 (tests/test_incidents.py::test_golden_incident_grid) compares against
 these files bit-for-bit.
 
-    JAX_PLATFORMS=cpu python tools/pin_incidents.py [NAME ...]
+The policy-armed grid (library.policy_golden_grid: cascading_overload
+under every remediation policy on both backends + every other
+incident under the winning policy) is pinned in the same pass as
+``{incident}+{policy}.{backend}.json`` files; ``--policies`` pins
+ONLY that grid (after a policies/ change that leaves the bare
+incident trajectories untouched).
+
+    JAX_PLATFORMS=cpu python tools/pin_incidents.py [--policies] [NAME ...]
 """
 
 from __future__ import annotations
@@ -23,23 +30,35 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN_DIR = os.path.join(REPO, "tests", "golden", "incidents")
 
 
+def _pin(lib, name, backend, policy=None):
+    t0 = time.time()
+    summary = lib.run_golden(name, backend, policy=policy)
+    path = lib.golden_path(name, backend, GOLDEN_DIR, policy=policy)
+    with open(path, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+        f.write("\n")
+    label = f"{name}+{policy}" if policy else name
+    print(f"{label}.{backend}: {time.time() - t0:.1f}s -> {path}")
+
+
 def main(argv: list[str]) -> None:
     sys.path.insert(0, REPO)
     from ringpop_tpu.scenarios import library as lib
 
+    policies_only = "--policies" in argv
+    argv = [a for a in argv if a != "--policies"]
     names = argv or lib.incident_names()
     os.makedirs(GOLDEN_DIR, exist_ok=True)
-    for name in names:
-        for backend in lib.INCIDENTS[name].backends:
-            t0 = time.time()
-            summary = lib.run_golden(name, backend)
-            path = lib.golden_path(name, backend, GOLDEN_DIR)
-            with open(path, "w") as f:
-                json.dump(summary, f, indent=2, sort_keys=True)
-                f.write("\n")
-            print(f"{name}.{backend}: {time.time() - t0:.1f}s -> {path}")
-    written = lib.write_specs()
-    print(f"re-rendered {len(written)} reference specs")
+    if not policies_only:
+        for name in names:
+            for backend in lib.INCIDENTS[name].backends:
+                _pin(lib, name, backend)
+    for name, policy, backend in lib.policy_golden_grid():
+        if name in names:
+            _pin(lib, name, backend, policy=policy)
+    if not policies_only:
+        written = lib.write_specs()
+        print(f"re-rendered {len(written)} reference specs")
 
 
 if __name__ == "__main__":

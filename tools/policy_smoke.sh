@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# CPU policy smoke: the remediation policy plane end to end through
+# the CLI.  Replays the cascading_overload incident at the golden
+# configuration with the winning policy armed (--policy combined); the
+# CLI's control arm (an identically-seeded no-policy sibling) replays
+# first, so the printed before/after line is a true A/B.  Asserts the
+# policy-armed summary is BIT-IDENTICAL to its pinned golden
+# (tests/golden/incidents/cascading_overload+combined.dense.json) and
+# that the remediation actually beats the incident: goodput within the
+# acceptance band of no-fault, amplification under 1.5x, the gray
+# cascade never forms — against the CONTROL numbers read from the bare
+# incident pin (same seed, same configuration).
+# This is the CI policy-smoke job's body; run it locally the same
+# way:  tools/policy_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/ringpop-policy.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+
+# the catalog lists every policy (with concrete defaults) without
+# starting a cluster
+JAX_PLATFORMS=cpu python -m ringpop_tpu tick-cluster --list-policies \
+  -n 16 | tee "$workdir/catalog.txt"
+for p in admission retry_budget quarantine combined; do
+  grep -q "$p" "$workdir/catalog.txt"
+done
+
+# cascading_overload + combined at the GOLDEN configuration (n=16
+# seed=3, streamed by default): control arm replays first, the policy
+# arm must print a recovery line, and the summary matches the pin
+echo "== policy-armed incident run (golden configuration)"
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+  python -m ringpop_tpu tick-cluster --backend tpu-sim -n 16 --seed 3 \
+  --incident cascading_overload --policy combined \
+  --trace-out "$workdir/trace.npz" \
+  | tee "$workdir/run.log"
+
+grep -q "incident cascading_overload:" "$workdir/run.log"
+grep -q "policy combined: goodput" "$workdir/run.log"
+
+JAX_PLATFORMS=cpu python - "$workdir" <<'EOF'
+import json
+import sys
+
+from ringpop_tpu.scenarios import library as lib
+from ringpop_tpu.scenarios.trace import Trace
+
+workdir = sys.argv[1]
+trace = Trace.load(f"{workdir}/trace.npz")
+summary = lib.incident_summary(trace)
+
+# golden-summary match: the CLI run IS the pinned policy-armed golden
+with open("tests/golden/incidents/cascading_overload+combined.dense.json") as f:
+    want = json.load(f)
+assert summary == want, (
+    f"policy summary diverged from the golden pin:\n got {summary}\n"
+    f"want {want}\nre-pin with tools/pin_incidents.py --policies if "
+    "intentional"
+)
+
+# the control numbers are the bare incident's own pin (same seed/config)
+with open("tests/golden/incidents/cascading_overload.dense.json") as f:
+    control = json.load(f)
+
+goodput = 100.0 * summary["delivered"] / summary["lookups"]
+amp = summary["sends"] / max(summary["delivered"], 1)
+g_ctl = 100.0 * control["delivered"] / control["lookups"]
+a_ctl = control["sends"] / max(control["delivered"], 1)
+# the acceptance bar (ROADMAP item 3): goodput within ~5% of no-fault,
+# amplification < 1.5, and the cascade visibly beaten vs control
+assert goodput >= 95.0, (goodput, summary)
+assert amp < 1.5, (amp, summary)
+assert goodput > g_ctl and amp < a_ctl, (goodput, g_ctl, amp, a_ctl)
+assert summary["ov_gray_peak"] < control["ov_gray_peak"], summary
+# the remediation plane really engaged (not a no-op win)
+assert summary["policy_quar_peak"] > 0 or summary["policy_shed"] > 0, summary
+print(
+    f"policy smoke OK: goodput {g_ctl:.1f}% -> {goodput:.1f}%, "
+    f"amplification {a_ctl:.2f}x -> {amp:.2f}x, "
+    f"gray peak {control['ov_gray_peak']} -> {summary['ov_gray_peak']}"
+)
+EOF
+
+echo "policy smoke passed"

@@ -13,6 +13,10 @@
 # plane co-runs in the scan and the spec carries an overload feedback
 # window, so the checkpoint round-trips the ov_cnt/ov_gray tensors and
 # the resumed run's serving + overload series must bit-match too.
+# It is also POLICY-ARMED (--policy combined): the remediation plane
+# rides the same scan, so the kill/resume additionally round-trips the
+# po_* tensors (pressure, hysteresis flags, amp windows, retry cap)
+# and the resumed policy series must bit-match mid-window.
 # This is the CI soak-resume-smoke job's body; run it locally the
 # same way:  tools/soak_smoke.sh
 set -euo pipefail
@@ -38,7 +42,7 @@ cat > "$spec" <<'EOF'
 EOF
 
 run_args=(--backend tpu-sim -n 24 --seed 1 --scenario "$spec"
-          --traffic zipf:96 --latency-buckets 8
+          --traffic zipf:96 --latency-buckets 8 --policy combined
           --segment-ticks 20 --checkpoint-every 1)
 
 echo "== act 1: uninterrupted reference run"
@@ -100,6 +104,10 @@ for k in ref.metrics:
 # the incident shape really ran: serving + overload series present,
 # the feedback loop fired, and the latency plane reassembled bit-equal
 assert ref.metrics["ov_gray_nodes"].max() > 0, "overload never degraded a node"
+# the remediation plane really ran: the policy series resumed
+# bit-equal (checked in the loop above) and its meter saw pressure
+assert "policy_shed" in ref.metrics and "policy_retry_cap" in ref.metrics
+assert ref.metrics["policy_pressure_max"].max() > 0, "policy meter stayed idle"
 assert set(ref.planes) == set(res.planes) and "lat_hist_ms" in ref.planes
 for k in ref.planes:
     np.testing.assert_array_equal(ref.planes[k], res.planes[k], err_msg=k)
