@@ -1,0 +1,198 @@
+"""Multi-chip gossip-plane race: ring remote-copy vs all-gather vs 1 chip.
+
+Three lowerings of the SAME protocol step, raced at matched (n, ticks)
+on the virtual CPU mesh (2 and 4 devices):
+
+* ``unsharded``  — the single-device ``swim_run`` scan (the baseline
+  every sharded arm must justify itself against);
+* ``gather``     — ``sharded_run(mesh, gossip="gather")``, the PR-15
+  lowering whose sorted receiver-merge XLA partitions into **75 full
+  member-plane all-gathers per step** at mesh 2;
+* ``ring``       — ``sharded_run(mesh)`` (the default), inter-shard
+  claims/acks as neighbor-exchange hops (ops/gossip_remote_copy.py),
+  member-gather count 0 by construction.
+
+Wall time alone is a weak signal on a CPU host where the device
+threads time-share cores, so the race rows ride with a CENSUS row: the
+collective byte traffic of each partitioned step program (count x
+bytes_each over the audited HLO, the same rows COLLECTIVE_BUDGETS
+pins), split into member-plane bytes vs total.  That is the
+census-backed bytes-moved-per-step comparison against the 75-plane
+all-gather baseline — the number ICI would carry per step on real
+hardware, measured without owning a pod.
+
+The MULTICHIP flagship row (``--flagship``) runs the delta backend —
+the scale flagship — ring-sharded at n=32,768 (the single-chip dense
+peak; see BASELINE.md) for a couple of ticks: an existence-plus-rate
+proof that the p2p plane executes at/above the largest n one chip has
+carried, not just at test sizes.
+
+    python -m benchmarks.run_all --only multichip     # race + census
+    python benchmarks/bench_multichip.py --flagship   # + n=32,768 row
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Own-process entry: provision the virtual mesh before jax
+# initializes.  Under run_all the aggregator owns the device layout.
+if __name__ == "__main__" and "jax" not in sys.modules:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = f"{flags} --xla_force_host_platform_device_count=8".strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time  # noqa: E402
+
+import jax  # noqa: E402
+
+
+def _census_row(n: int, mesh: int) -> dict:
+    """Collective byte traffic of the ring vs gather partitioned step.
+
+    Audits the registry's own entries (``sharded_step`` /
+    ``sharded_step+gather``) so the numbers are exactly the pinned
+    COLLECTIVE_BUDGETS rows' underlying HLO, not a parallel trace."""
+    from ringpop_tpu.analysis.contracts import audit_entry
+    from ringpop_tpu.analysis.partitioning import collective_counts
+
+    out: dict = {"metric": f"multichip_census_n{n}_mesh{mesh}",
+                 "unit": "bytes_per_step"}
+    for arm, entry in (("ring", "sharded_step"),
+                       ("gather", "sharded_step+gather")):
+        r = audit_entry(entry, "dense", n=n, mesh=mesh)
+        rows = r.collectives
+        cc = collective_counts(rows)
+        out[f"{arm}_bytes_per_step"] = int(
+            sum(row["count"] * row["bytes_each"] for row in rows))
+        out[f"{arm}_member_plane_bytes"] = int(
+            sum(row["count"] * row["bytes_each"] for row in rows
+                if row["member"]))
+        out[f"{arm}_member_gathers"] = int(cc.get("member-gather", 0))
+    out["value"] = out["ring_bytes_per_step"]
+    return out
+
+
+def _time_arm(build, ticks: int, warm_reps: int) -> tuple[float, float]:
+    """(cold seconds incl. compile, best warm seconds) for one arm.
+
+    ``build`` returns a zero-arg thunk over FRESH state each call —
+    the scans donate their state argument, so every rep re-inits."""
+    t0 = time.perf_counter()
+    jax.block_until_ready(build()())
+    cold = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(warm_reps):
+        thunk = build()
+        t0 = time.perf_counter()
+        jax.block_until_ready(thunk())
+        best = min(best, time.perf_counter() - t0)
+    return cold, best
+
+
+def run(n: int = 256, ticks: int = 16, meshes=(2, 4), census_n: int = 64,
+        warm_reps: int = 2, flagship: bool = False) -> list[dict]:
+    from ringpop_tpu import parallel
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sim.SwimParams()
+    key = jax.random.PRNGKey(42)
+    results: list[dict] = []
+
+    avail = len(jax.devices())
+    usable = [d for d in meshes if d <= avail]
+
+    def unsharded():
+        state, net = sim.init_state(n), sim.make_net(n)
+        return lambda: sim.swim_run(state, net, key, params, ticks)
+
+    cold, warm = _time_arm(unsharded, ticks, warm_reps)
+    results.append({
+        "metric": f"multichip_race_n{n}_unsharded",
+        "value": round(warm / ticks * 1e3, 3), "unit": "ms_per_tick",
+        "cold_s": round(cold, 2), "ticks": ticks, "devices": 1,
+    })
+
+    for d in usable:
+        mesh = parallel.make_mesh(d)
+        for arm in ("gather", "ring"):
+            run_fn = parallel.sharded_run(
+                mesh, gossip=None if arm == "ring" else arm)
+
+            def sharded(run_fn=run_fn, mesh=mesh):
+                state, net = parallel.shard_cluster(
+                    sim.init_state(n), sim.make_net(n), mesh)
+                return lambda: run_fn(state, net, key, params, ticks)
+
+            cold, warm = _time_arm(sharded, ticks, warm_reps)
+            results.append({
+                "metric": f"multichip_race_n{n}_mesh{d}_{arm}",
+                "value": round(warm / ticks * 1e3, 3),
+                "unit": "ms_per_tick",
+                "cold_s": round(cold, 2), "ticks": ticks, "devices": d,
+            })
+
+    if 2 <= avail:
+        results.append(_census_row(census_n, 2))
+
+    if flagship:
+        results.append(flagship_row())
+    return results
+
+
+def flagship_row(n: int = 32768, d: int = 2, ticks: int = 2,
+                 capacity: int = 64) -> dict:
+    """The MULTICHIP row: delta backend, ring gossip, n at the
+    single-chip dense peak, executed over a real device mesh."""
+    from ringpop_tpu import parallel
+    from ringpop_tpu.models import swim_delta as sd
+    from ringpop_tpu.models import swim_sim as sim
+
+    params = sd.DeltaParams()
+    mesh = parallel.make_mesh(d)
+    t0 = time.perf_counter()
+    state = parallel.shard_delta(sd.init_delta(n, capacity=capacity), mesh)
+    net = sim.make_net(n)
+    run_fn = parallel.sharded_delta_run(mesh)
+    state, _ = run_fn(state, net, jax.random.PRNGKey(7), params, ticks)
+    jax.block_until_ready(state)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state2, _ = run_fn(
+        parallel.shard_delta(sd.init_delta(n, capacity=capacity), mesh),
+        net, jax.random.PRNGKey(7), params, ticks)
+    jax.block_until_ready(state2)
+    warm = time.perf_counter() - t0
+    import numpy as np
+
+    digest = int(np.asarray(state.digest).sum(dtype=np.int64))
+    return {
+        "metric": f"MULTICHIP_delta_ring_n{n}_dev{d}",
+        "value": round(warm / ticks, 2), "unit": "s_per_tick",
+        "ticks": ticks, "cold_s": round(cold, 1), "gossip": "ring",
+        "capacity": capacity, "digest_sum": digest,
+        "compiled_and_ran": True,
+    }
+
+
+def main(argv: list[str]) -> None:
+    import json
+
+    n = 256
+    if "--n" in argv:
+        n = int(argv[argv.index("--n") + 1])
+    kwargs = {"n": n, "flagship": "--flagship" in argv}
+    if "--flagship-only" in argv:
+        print(json.dumps({"bench": "bench_multichip", **flagship_row()}),
+              flush=True)
+        return
+    for row in run(**kwargs):
+        print(json.dumps({"bench": "bench_multichip", **row}), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -31,6 +31,7 @@ SIM_BENCHES = [
     "bench_lookup",  # batched device ring lookups vs the host loop
     "bench_stream",  # pipelined segmented soak vs the blocking loop
     "bench_faults",  # failure-model family sweeps: detect/heal tables
+    "bench_multichip",  # gossip-plane race: ring remote-copy vs all-gather
 ]
 
 

@@ -84,13 +84,19 @@ CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
     # pin
     ("delta_merge_pallas", "delta"): {},
     # the sharded step has no tick scan: its "carries" are the int32
-    # loop state of the step's 22 inner sort/fori kernels (primary at
-    # this program's top level); the sharded sweep's carry is
-    # bit-identical to the unsharded run_sweep rows — sharding the
-    # replica axis must never change WHAT the scan carries, only where
-    # it lives
-    ("sharded_step", "dense"): {"int32": 44},
-    ("sharded_step@4", "dense"): {"int32": 44},
+    # loop state of the step's inner sort/fori kernels (primary at this
+    # program's top level).  The ring gossip plane re-pins the dense
+    # rows 44 -> 24: the sorted receiver-merge's Hillis-Steele
+    # while_loops vanish with the merge (ring_recv_merge is loop-free
+    # scatter-max over hops), taking 20 int32 loop slots with them.
+    # The +gather entry keeps the legacy 44 — it IS the PR-15 lowering.
+    # The sharded sweep's carry is bit-identical to the unsharded
+    # run_sweep rows — sharding the replica axis must never change WHAT
+    # the scan carries, only where it lives.
+    ("sharded_step", "dense"): {"int32": 24},
+    ("sharded_step@4", "dense"): {"int32": 24},
+    ("sharded_step+gather", "dense"): {"int32": 44},
+    ("sharded_delta_step", "delta"): {"int32": 110},
     ("run_sweep+shard", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
     ("run_sweep+shard", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
 }
@@ -112,23 +118,47 @@ def expected(entry: str, backend: str) -> dict[str, int] | None:
 # scalar-telemetry all-reduces, and any member-gather appearing there
 # is a broken replica axis.  Pinned via tools/pin_budgets.py.
 COLLECTIVE_BUDGETS: dict[tuple[str, str, int], dict] = {
-    # the dense sharded step is ALL-GATHER-SHAPED today: 75 of its 143
-    # all-gathers rebuild full [N, *] member planes (30 in
-    # swim.recv_merge alone — the sorted merge's row permutation
-    # re-replicated per call site).  This row is the honest baseline
-    # the remote-copy rebuild (ROADMAP item 1) measures against; the
-    # member-gather count has license to fall, never to rise.
+    # the ring gossip plane (ops/gossip_remote_copy.py): the 75
+    # member-gathers of the PR-15 lowering are GONE — claims, acks, and
+    # the per-row index tensors all move as neighbor-exchange permutes
+    # (collective-permute 36 -> 71: D-1 hops per circulated plane),
+    # and the residual all-gathers are rank-1 [N] rows (status bits,
+    # run bounds) the census exempts by design.  These entries declare
+    # p2p_only, so a member-gather is an ERROR before the count is
+    # even compared; the pinned zero (by omission) is the tentpole's
+    # claim.  The pre-ring census for the record: {"all-gather": 143,
+    # "all-reduce": 58, "collective-permute": 36, "member-gather": 75}
+    # — kept live (and pinned below) under the sharded_step+gather
+    # entry, the bench baseline.
     ("sharded_step", "dense", 2): {
+        "n": 64,
+        "counts": {"all-gather": 13, "all-reduce": 25,
+                   "collective-permute": 71},
+    },
+    # mesh 4 re-partitions the same program: identical gather/reduce
+    # structure, the permute lanes scale with the hop count (D-1 hops
+    # per ring primitive call)
+    ("sharded_step@4", "dense", 4): {
+        "n": 64,
+        "counts": {"all-gather": 13, "all-reduce": 25,
+                   "collective-permute": 187},
+    },
+    # the delta claim routing over the ring: segment rows circulate as
+    # permute hops (route_claims' [S*N, W] row table never replicates),
+    # the all-reduces are the stage preds' jnp.any gates
+    ("sharded_delta_step", "delta", 2): {
+        "n": 64,
+        "counts": {"all-gather": 25, "all-reduce": 106,
+                   "collective-permute": 77},
+    },
+    # the PR-15 all-gather lowering, kept live as the multichip bench's
+    # baseline: this row IS the pre-ring census for the record.  Not
+    # p2p_only — the 75 member-gathers are its measured cost, compared
+    # here rather than outlawed.
+    ("sharded_step+gather", "dense", 2): {
         "n": 64,
         "counts": {"all-gather": 143, "all-reduce": 58,
                    "collective-permute": 36, "member-gather": 75},
-    },
-    # mesh 4 re-partitions the same program: identical gather/reduce
-    # structure, double the permute lanes (ring hops scale with mesh)
-    ("sharded_step@4", "dense", 4): {
-        "n": 64,
-        "counts": {"all-gather": 143, "all-reduce": 58,
-                   "collective-permute": 72, "member-gather": 75},
     },
     # the replica-sharded sweeps are data-parallel by construction:
     # dense reduces its 10 scalar telemetry sums, delta is fully local

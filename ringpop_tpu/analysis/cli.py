@@ -62,6 +62,10 @@ def _parse(argv):
     ap.add_argument("--ticks", type=int, default=4)
     ap.add_argument("--capacity", type=int, default=64)
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--mesh", type=int, default=None,
+                    help="device count for the sharded entries' mesh "
+                         "(default: each entry's registered size; the "
+                         "CLI provisions 4 CPU virtual devices)")
     ap.add_argument("--fail-on", choices=("error", "warning", "info",
                                           "never"),
                     default="error",
@@ -121,6 +125,12 @@ def main(argv: list[str] | None = None) -> None:
         if not list(iter_entries(names, backends)):
             sys.exit("audit: the --entry/--backend selection matches no "
                      "registered (entry, backend) pair")
+        extra = {}
+        if args.mesh is not None:
+            # forwarded through build_entry's **extra; only the mesh
+            # entries consume it (their builders pop it), so the flag
+            # composes with --entry selections that include them
+            extra["mesh"] = args.mesh
         reports, audit_findings = audit_all(
             names,
             backends,
@@ -131,6 +141,7 @@ def main(argv: list[str] | None = None) -> None:
             compile_programs=not args.no_compile,
             census_min_elems=args.census_min_elems,
             force_compile=args.print_budget,
+            **extra,
         )
         findings += audit_findings
 
@@ -193,9 +204,14 @@ def main(argv: list[str] | None = None) -> None:
                         collective_counts,
                     )
 
+                    cc = collective_counts(r.collectives)
                     print(f"    (\"{r.entry}\", \"{r.backend}\", "
                           f"{r.mesh_size}): {{\"n\": {r.n}, \"counts\": "
-                          f"{collective_counts(r.collectives)}}},")
+                          f"{cc}}},")
+                    # the p2p headline: a remote-copy entry pins this
+                    # to zero by omission, so print it explicitly
+                    print(f"    # member-gathers: "
+                          f"{cc.get('member-gather', 0)}")
                 if r.mem_bytes is not None:
                     fields = {k: int(r.mem_bytes[k])
                               for k in ("argument_bytes", "output_bytes",

@@ -514,14 +514,17 @@ def _require_devices(mesh: int, entry: str) -> None:
 
 
 def _build_sharded_step(backend: str, *, n: int, mesh: int = 2,
-                        **_ignored: Any) -> Built:
+                        gossip: str = "ring", **_ignored: Any) -> Built:
     """The viewer-row sharded dense step (parallel/mesh.py) at a fixed
     mesh size, lowered UNCONSTRAINED (no out_shardings) so the
     sharding-propagation contract checks what XLA actually decides.
     The partitioned HLO of this program is the collective census's
-    subject: today it is all-gather-shaped (the pinned budget documents
-    exactly how much), and ROADMAP item 1's remote-copy rebuild must
-    drive the member-gather row to zero and flip ``p2p_only``."""
+    subject.  The default ``ring`` gossip plane routes inter-shard
+    claims as neighbor-exchange hops (ops/gossip_remote_copy.py) and
+    carries ``p2p_only=True`` — a member-plane all-gather is an audit
+    ERROR, with the budget row pinned at zero.  ``gossip="gather"``
+    builds the PR-15 all-gather lowering (entry ``sharded_step+gather``)
+    so the legacy shape stays measurable for the multichip bench."""
     import jax
 
     from ringpop_tpu.models import swim_sim as sim
@@ -541,8 +544,12 @@ def _build_sharded_step(backend: str, *, n: int, mesh: int = 2,
     # kwargs outright (static_argnames still applies by signature).
     # It trails the key, so the PRNG root's flat index is unaffected.
     args = (state, net, key, params)
+    if gossip == "gather":
+        name = "sharded_step+gather"
+    else:
+        name = "sharded_step" if mesh == 2 else f"sharded_step@{mesh}"
     return Built(
-        name="sharded_step" if mesh == 2 else f"sharded_step@{mesh}",
+        name=name,
         backend=backend,
         jitted=jitted,
         args=args,
@@ -554,9 +561,49 @@ def _build_sharded_step(backend: str, *, n: int, mesh: int = 2,
         dims=dict(N=n),
         mesh_size=mesh,
         mesh_axis=pmesh.AXIS,
-        p2p_only=False,  # the gossip path is all-gather-shaped TODAY;
-        #   the pinned collective budget holds the line until item 1
-        trace_context=pmesh._mesh_recv_merge,
+        p2p_only=(gossip == "ring"),
+        trace_context=lambda: pmesh._mesh_gossip(m, gossip),
+    )
+
+
+def _build_sharded_delta_step(backend: str, *, n: int, capacity: int,
+                              mesh: int = 2, gossip: str = "ring",
+                              **_ignored: Any) -> Built:
+    """The row-sharded delta step on a fixed mesh — the scale
+    flagship's production gossip path.  Same contracts as
+    ``sharded_step``: unconstrained lowering, ring gossip plane,
+    ``p2p_only=True`` with the member-gather budget pinned at zero."""
+    import jax
+
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    _require_devices(mesh, f"sharded_delta_step (mesh {mesh})")
+    if n % mesh:
+        raise EntryUnavailable(
+            f"sharded_delta_step needs n divisible by the mesh ({n} % {mesh})"
+        )
+    m = pmesh.make_mesh(mesh)
+    state, net, params = _delta_fixture(n, capacity)
+    state = pmesh.shard_delta(state, m)
+    net = jax.device_put(net, pmesh.net_sharding(m, like=net))
+    key = jax.random.PRNGKey(0)
+    jitted = pmesh.sharded_delta_step_jit(m, constrain_outputs=False)
+    args = (state, net, key, params)
+    return Built(
+        name="sharded_delta_step",
+        backend=backend,
+        jitted=jitted,
+        args=args,
+        statics={},
+        key_roots={"protocol": tree_flat_index_of(args, key)},
+        donates=True,
+        min_aliased=1,
+        census_min_elems=n * capacity,
+        dims=dict(N=n, C=capacity),
+        mesh_size=mesh,
+        mesh_axis=pmesh.AXIS,
+        p2p_only=(gossip == "ring"),
+        trace_context=lambda: pmesh._mesh_gossip(m, gossip),
     )
 
 
@@ -643,16 +690,33 @@ ENTRY_POINTS: dict[str, EntrySpec] = {
         "(ops/delta_merge_pallas.py, interpret lowering)"),
     "sharded_step": EntrySpec(
         "sharded_step", ("dense",),
-        lambda backend, **kw: _build_sharded_step(backend, mesh=2, **kw),
-        "the viewer-row sharded dense step on a 2-device mesh "
-        "(parallel/mesh.py; partitioning contracts)"),
+        lambda backend, **kw: _build_sharded_step(
+            backend, mesh=kw.pop("mesh", 2), **kw),
+        "the viewer-row sharded dense step on a 2-device mesh, ring "
+        "gossip plane (parallel/mesh.py; p2p partitioning contracts)"),
     "sharded_step@4": EntrySpec(
         "sharded_step@4", ("dense",),
-        lambda backend, **kw: _build_sharded_step(backend, mesh=4, **kw),
+        lambda backend, **kw: _build_sharded_step(
+            backend, mesh=kw.pop("mesh", 4), **kw),
         "the viewer-row sharded dense step on a 4-device mesh"),
+    "sharded_step+gather": EntrySpec(
+        "sharded_step+gather", ("dense",),
+        lambda backend, **kw: _build_sharded_step(
+            backend, mesh=kw.pop("mesh", 2), gossip="gather", **kw),
+        "the PR-15 all-gather lowering of the sharded dense step — the "
+        "legacy baseline the multichip bench races the ring plane "
+        "against (not p2p_only; its 75 member-gathers are pinned as "
+        "the measured cost, not outlawed)"),
+    "sharded_delta_step": EntrySpec(
+        "sharded_delta_step", ("delta",),
+        lambda backend, **kw: _build_sharded_delta_step(
+            backend, mesh=kw.pop("mesh", 2), **kw),
+        "the row-sharded delta step on a 2-device mesh, ring gossip "
+        "plane (parallel/mesh.py; p2p partitioning contracts)"),
     "run_sweep+shard": EntrySpec(
         "run_sweep+shard", ("dense", "delta"),
-        lambda backend, **kw: _build_sharded_sweep(backend, mesh=2, **kw),
+        lambda backend, **kw: _build_sharded_sweep(
+            backend, mesh=kw.pop("mesh", 2), **kw),
         "run_sweep(shard=True): the replica-axis-sharded sweep scan on "
         "a 2-device mesh (scenarios/sweep.py)"),
 }

@@ -118,7 +118,9 @@ from ringpop_tpu.models.swim_sim import (
     _check_inc,
     _distinct_ranks,
     _drop_net,
+    _gather_rows,
     _message_delay,
+    _on_ring,
     _stagger_send_gate,
     _sweep_divisor,
     _validate_params,
@@ -1332,8 +1334,30 @@ def _route_claims_multi(
     )  # [N, R]
     row_ok = jnp.arange(r, dtype=jnp.int32)[None, :] < counts[:, None]
     src = jnp.where(row_ok, order[idx], 0)  # [N, R] source row ids
-    g_subj = jnp.where(row_ok[:, :, None], rows_subj[src], SENTINEL).reshape(n, r * w)
-    g_key = jnp.where(row_ok[:, :, None], rows_key[src], 0).reshape(n, r * w)
+    if _on_ring():
+        # p2p form: the [S*N, W] row table never replicates — each
+        # segment's [N, W] block circulates the ring separately and a
+        # receiver keeps only the <= R rows addressed to it.  The
+        # row-id arithmetic (sort, run bounds, src) is rank-1 and
+        # stays replicated; only the claim PAYLOAD rows ride the ring.
+        seg_i = src // n
+        snd = src - seg_i * n  # [N, R] sender row within its segment
+        g_subj = jnp.full((n, r, w), SENTINEL, jnp.int32)
+        g_key = jnp.zeros((n, r, w), jnp.int32)
+        for s, (subj, key, valid, _) in enumerate(segments):
+            pick = row_ok & (seg_i == s)
+            snd_s = jnp.where(pick, snd, 0)
+            f_subj = _gather_rows(jnp.where(valid, subj, SENTINEL), snd_s)
+            f_key = _gather_rows(jnp.where(valid, key, 0), snd_s)
+            g_subj = jnp.where(pick[:, :, None], f_subj, g_subj)
+            g_key = jnp.where(pick[:, :, None], f_key, g_key)
+        g_subj = g_subj.reshape(n, r * w)
+        g_key = g_key.reshape(n, r * w)
+    else:
+        g_subj = jnp.where(
+            row_ok[:, :, None], rows_subj[src], SENTINEL
+        ).reshape(n, r * w)
+        g_key = jnp.where(row_ok[:, :, None], rows_key[src], 0).reshape(n, r * w)
     kept = jnp.sum(jnp.where(row_ok, rows_nvalid[src], 0), dtype=jnp.int32)
     dropped = jnp.sum(rows_nvalid, dtype=jnp.int32) - kept
 
@@ -1678,8 +1702,8 @@ def delta_step_impl(
         & _adj(net, t_safe, ids)
         & ~_drop_net(k_loss2, (n,), sw.loss, net, t_safe, ids)
     )
-    a_subj = rep_subj[t_safe]  # [N, W]
-    a_key = rep_key[t_safe]
+    a_subj = _gather_rows(rep_subj, t_safe)  # [N, W]
+    a_key = _gather_rows(rep_key, t_safe)
     a_subj_q = jnp.where(a_subj < SENTINEL, a_subj, 0)
 
     # anti-echo (value form, dense phase 4): drop reply claims about a
@@ -1756,8 +1780,8 @@ def delta_step_impl(
             # served view actually held.  One consistent pre-flip
             # snapshot (table + side + base) is the view the provider
             # held when it answered the ping.
-            fs_subj0 = st2.d_subj[t_safe]  # [N, C]
-            fs_key0 = st2.d_key[t_safe]
+            fs_subj0 = _gather_rows(st2.d_subj, t_safe)  # [N, C]
+            fs_key0 = _gather_rows(st2.d_key, t_safe)
             fs_provider_side = None
             if st2.side is not None:
                 # Sided mode: the full-sync PROVIDER is the ping
@@ -2033,20 +2057,27 @@ def delta_step_impl(
             st2, win_b = _stage_issue_delta(st2, nsrv, maxpb, w)
             sb_subj, sb_key = _windowed_changes(st2, win_b, w)
             nping_del = _role_counts(wit_safe, ping_del)
+
+            def segs_b(st3):
+                segs = []
+                for m in range(kk):
+                    b_subj = _gather_rows(sb_subj, wit_safe[:, m])
+                    b_key = _gather_rows(sb_key, wit_safe[:, m])
+                    segs.append(
+                        (
+                            b_subj,
+                            b_key,
+                            (b_subj < SENTINEL) & ping_del[:, m][:, None],
+                            t_safe,
+                        )
+                    )
+                return segs
+
             st2, acc2 = _stage(
                 st2,
                 (jnp.int32(0), jnp.int32(0)),
                 jnp.any(win_b),
-                lambda st3: [
-                    (
-                        sb_subj[wit_safe[:, m]],
-                        sb_key[wit_safe[:, m]],
-                        (sb_subj[wit_safe[:, m]] < SENTINEL)
-                        & ping_del[:, m][:, None],
-                        t_safe,
-                    )
-                    for m in range(kk)
-                ],
+                segs_b,
             )
             # the witness's delivered set (5c anti-echo): its windowed
             # list, where it made at least one delivered relay ping
@@ -2073,15 +2104,19 @@ def delta_step_impl(
 
             def segs_c(st3):
                 segs = []
+                subj = _gather_rows(sc_subj, t_safe)
+                key_c = _gather_rows(sc_key, t_safe)
+                subj_q = jnp.where(subj < SENTINEL, subj, 0)
                 for m in range(kk):
                     w_m = wit_safe[:, m]
-                    subj = sc_subj[t_safe]
-                    key_c = sc_key[t_safe]
-                    subj_q = jnp.where(subj < SENTINEL, subj, 0)
                     # anti-echo: the witness delivered this subject in
                     # 5b and its current belief equals the claim
-                    _, in_sent = _lookup_pos(wit_sent_subj[w_m], subj_q)
-                    pos_w, found_w = _lookup_pos(st3.d_subj[w_m], subj_q)
+                    _, in_sent = _lookup_pos(
+                        _gather_rows(wit_sent_subj, w_m), subj_q
+                    )
+                    pos_w, found_w = _lookup_pos(
+                        _gather_rows(st3.d_subj, w_m), subj_q
+                    )
                     if st3.side is None:
                         base_w = st3.base_key[subj_q]
                     else:
@@ -2090,7 +2125,9 @@ def delta_step_impl(
                         base_w = st3.base_key[st3.side[w_m][:, None], subj_q]
                     cur_w = jnp.where(
                         found_w,
-                        jnp.take_along_axis(st3.d_key[w_m], pos_w, axis=1),
+                        jnp.take_along_axis(
+                            _gather_rows(st3.d_key, w_m), pos_w, axis=1
+                        ),
                         base_w,
                     )
                     echo = in_sent & (key_c == cur_w)
@@ -2134,8 +2171,8 @@ def delta_step_impl(
                 segs = []
                 for m in range(kk):
                     w_m = wit_safe[:, m]
-                    subj = sd_subj[w_m]
-                    key_d = sd_key[w_m]
+                    subj = _gather_rows(sd_subj, w_m)
+                    key_d = _gather_rows(sd_key, w_m)
                     subj_q = jnp.where(subj < SENTINEL, subj, 0)
                     _, in_sent = _lookup_pos(src_sent_subj, subj_q)
                     cur_s = view_lookup(st3, subj_q)

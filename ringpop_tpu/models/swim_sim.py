@@ -110,6 +110,7 @@ import jax
 import jax.numpy as jnp
 
 from ringpop_tpu.obs import annotate
+from ringpop_tpu.ops import gossip_remote_copy as _grc
 
 
 # Status encoding: lattice rank == code (alive < suspect < faulty < leave,
@@ -713,7 +714,7 @@ def _merge_incoming(
         eye & active[:, None] & ((in_status == SUSPECT) | (in_status == FAULTY))
     )
     refuted = jnp.any(rumor_self, axis=1)
-    self_inc = jnp.diagonal(cur_key) >> 3
+    self_inc = _diag(cur_key) >> 3
     rumor_inc = jnp.where(rumor_self, in_key >> 3, -1).max(axis=1)
     new_self_inc = jnp.maximum(self_inc, rumor_inc) + 1
 
@@ -739,12 +740,10 @@ def _merge_incoming(
     # Refutation writes the diagonal and records a self-sourced alive change.
     ids = jnp.arange(n, dtype=jnp.int32)
     diag_key = jnp.where(
-        refuted, new_self_inc * 8 + ALIVE, jnp.diagonal(view_key)
+        refuted, new_self_inc * 8 + ALIVE, _diag(view_key)
     ).astype(jnp.int32)
-    view_key = view_key.at[ids, ids].set(diag_key, unique_indices=True)
-    pb = pb.at[ids, ids].set(
-        jnp.where(refuted, jnp.int8(0), jnp.diagonal(pb)), unique_indices=True
-    )
+    view_key = _row_update(view_key, ids, diag_key)
+    pb = _row_update(pb, ids, jnp.where(refuted, jnp.int8(0), _diag(pb)))
 
     applied = apply | (eye & refuted[:, None])
 
@@ -790,20 +789,17 @@ def _declare(
     n = state.n
     ids = jnp.arange(n, dtype=jnp.int32)
     subj = jnp.clip(subject, 0, n - 1)
-    cur = state.view_key[ids, subj]
+    cur = _row_at(state.view_key, subj)
     in_key = jnp.where(cur > 0, (cur >> 3) * 8 + new_status, 0)
     ok = viewer_mask & (subj != ids) & _apply_mask(cur, in_key)
-    vk = state.view_key.at[ids, subj].set(
-        jnp.where(ok, in_key, cur), unique_indices=True
-    )
-    pb = state.pb.at[ids, subj].set(
-        jnp.where(ok, jnp.int8(0), state.pb[ids, subj]), unique_indices=True
+    vk = _row_update(state.view_key, subj, jnp.where(ok, in_key, cur))
+    pb = _row_update(
+        state.pb, subj, jnp.where(ok, jnp.int8(0), _row_at(state.pb, subj))
     )
     sus = state.suspect_left
     if new_status == SUSPECT:
-        sus = sus.at[ids, subj].set(
-            jnp.where(ok, jnp.int8(sl_start), sus[ids, subj]),
-            unique_indices=True,
+        sus = _row_update(
+            sus, subj, jnp.where(ok, jnp.int8(sl_start), _row_at(sus, subj))
         )
     return state._replace(view_key=vk, pb=pb, suspect_left=sus), ok
 
@@ -858,7 +854,7 @@ def _phase01_select(
     maxpb = _max_piggyback(status_ok, params.piggyback_factor)
     h_pre = _view_hash(state)
 
-    own_status = jnp.diagonal(status)
+    own_status = _diag(status)
     gossiping = (
         net.up & net.responsive & ((own_status == ALIVE) | (own_status == SUSPECT))
     )
@@ -905,7 +901,7 @@ def _phase01_select(
         swept = (
             start + state.tick // (div if div is not None else jnp.int32(1))
         ) % jnp.int32(n)
-        ok = pingable[ids, swept]
+        ok = _row_at(pingable, swept)
         target = jnp.where(ok, swept, target)
         has_target = has_target | ok
         # witnesses were drawn excluding the rank-picked target; also
@@ -1125,7 +1121,9 @@ def _phase5_pingreq(
                     t_safe,
                     ping_del[:, m],
                     jnp.where(
-                        ping_del[:, m][:, None], claims_wit[wit_safe[:, m]], 0
+                        ping_del[:, m][:, None],
+                        _gather_rows(claims_wit, wit_safe[:, m]),
+                        0,
                     ),
                 )
                 acc_in = jnp.maximum(acc_in, slot_in)
@@ -1149,13 +1147,16 @@ def _phase5_pingreq(
             # the ack hop (post-5b views vs the witness's period-start
             # hash, mirroring h_post vs the sender's h_pre)
             h_mid = _view_hash(st)
-            rows0 = jnp.where(issue_tgt, st.view_key, 0)[t_safe]
+            rows0 = _gather_rows(jnp.where(issue_tgt, st.view_key, 0), t_safe)
+            issue_tgt_t = _gather_rows(issue_tgt, t_safe)
             fs_cols = []
             for m in range(kk):
                 w_m = wit_safe[:, m]
-                echo0 = deliv_wit[w_m] & (rows0 == st.view_key[w_m])
+                echo0 = _gather_rows(deliv_wit, w_m) & (
+                    rows0 == _gather_rows(st.view_key, w_m)
+                )
                 has_claim = jnp.any(
-                    ack_del[:, m][:, None] & issue_tgt[t_safe] & ~echo0,
+                    ack_del[:, m][:, None] & issue_tgt_t & ~echo0,
                     axis=1,
                 )
                 fs_cols.append(
@@ -1168,14 +1169,16 @@ def _phase5_pingreq(
 
         def in_c(st2):
             claims_tgt = jnp.where(issue_tgt, st2.view_key, 0)
-            full_rows = st2.view_key[t_safe]
+            full_rows = _gather_rows(st2.view_key, t_safe)
+            rows = _gather_rows(claims_tgt, t_safe)
             acc_in = jnp.zeros((n, n), jnp.int32)
             for m in range(kk):
                 w_m = wit_safe[:, m]
-                rows = claims_tgt[t_safe]
                 # anti-echo: drop claims equal to what the witness itself
                 # delivered to this target in 5b
-                echo = deliv_wit[w_m] & (rows == st2.view_key[w_m])
+                echo = _gather_rows(deliv_wit, w_m) & (
+                    rows == _gather_rows(st2.view_key, w_m)
+                )
                 send = jnp.where(ack_del[:, m][:, None] & ~echo, rows, 0)
                 if fs_slots is not None:
                     send = jnp.where(
@@ -1204,7 +1207,7 @@ def _phase5_pingreq(
             claims_wit2 = jnp.where(issue_wit2, st2.view_key, 0)
             acc_in = jnp.zeros((n, n), jnp.int32)
             for m in range(kk):
-                rows = claims_wit2[wit_safe[:, m]]
+                rows = _gather_rows(claims_wit2, wit_safe[:, m])
                 echo = deliv_src & (rows == st2.view_key)
                 acc_in = jnp.maximum(
                     acc_in,
@@ -1289,16 +1292,18 @@ def _phase6_expiry(
 # bit-identical, and benchmarks/hlo_census.py --backend dense shows
 # the per-form op budget without a chip.
 _RECV_MERGE = os.environ.get("RINGPOP_RECV_MERGE", "sorted")
-if _RECV_MERGE not in ("sorted", "scatter", "pallas"):
+if _RECV_MERGE not in ("sorted", "scatter", "pallas", "ring"):
     raise ValueError(
-        f"RINGPOP_RECV_MERGE={_RECV_MERGE!r}: sorted|scatter|pallas"
+        f"RINGPOP_RECV_MERGE={_RECV_MERGE!r}: sorted|scatter|pallas|ring"
     )
 
-# Trace-time override stack for program builders that cannot host the
-# Pallas kernel: tpu_custom_call has no SPMD partitioning rule, so the
-# sharded mesh path (parallel/mesh.py) wraps its jitted calls in
-# _force_recv_merge("sorted") — bit-identical semantics, sharding-aware
-# lowering.  A stack (not a flag) so nested builders compose.
+# Trace-time override stack for program builders whose lowering needs
+# differ from the env default: the sharded mesh path (parallel/mesh.py)
+# wraps its jitted calls in _force_recv_merge("ring") — the merge runs
+# as shard_map ring hops (ops/gossip_remote_copy.py) so no member plane
+# is ever all-gathered — or "sorted" for its gather fallback (the
+# single-chip pallas kernel's tpu_custom_call has no SPMD partitioning
+# rule either way).  A stack (not a flag) so nested builders compose.
 _RECV_MERGE_FORCE: list[str] = []
 
 
@@ -1348,6 +1353,10 @@ def _receiver_merge(
     of the delivered claim rows, and the delivered-ping count."""
     n = t_safe.shape[0]
     form = _recv_merge_form()
+    if form == "ring":
+        if _grc.active_ring() is not None:
+            return _grc.ring_recv_merge(t_safe, fwd_ok, claim_rows)
+        form = "sorted"  # no ambient ring: exact single-device fallback
     if form == "scatter":
         in_key = jnp.zeros((n, n), dtype=jnp.int32).at[t_safe].max(claim_rows)
         inbound = jnp.zeros((n,), jnp.int32).at[t_safe].add(
@@ -1389,6 +1398,54 @@ def _receiver_merge(
     start_c = jnp.minimum(starts[:-1], n - 1)
     in_key = jnp.where((inbound > 0)[:, None], rows_s[start_c], 0)
     return in_key, inbound
+
+
+def _gather_rows(plane: jax.Array, idx: jax.Array) -> jax.Array:
+    """``plane[idx]`` for a member plane indexed across rows.
+
+    On the p2p gossip plane (ring merge form + an ambient
+    ``ring_mesh``), the rows are fetched as neighbor-exchange hops so
+    the row-sharded plane is never all-gathered; everywhere else this
+    is a plain gather.  Exact either way."""
+    if _recv_merge_form() == "ring" and _grc.active_ring() is not None:
+        return _grc.ring_fetch_rows(plane, idx)
+    return plane[idx]
+
+
+def _on_ring() -> bool:
+    return _recv_merge_form() == "ring" and _grc.active_ring() is not None
+
+
+def _row_at(plane: jax.Array, col: jax.Array) -> jax.Array:
+    """``plane[arange(N), col]`` (viewer i's view of column col[i]) —
+    shard-local on the p2p gossip plane, where the fused gather's
+    [N, 2] index tensor would otherwise be all-gathered."""
+    if _on_ring():
+        return _grc.ring_take_per_row(plane, col)
+    n = plane.shape[0]
+    return plane[jnp.arange(n, dtype=jnp.int32), col]
+
+
+def _diag(plane: jax.Array) -> jax.Array:
+    """``jnp.diagonal(plane)`` routed like ``_row_at``."""
+    if _on_ring():
+        n = plane.shape[0]
+        return _grc.ring_take_per_row(plane, jnp.arange(n, dtype=jnp.int32))
+    return jnp.diagonal(plane)
+
+
+def _row_update(
+    plane: jax.Array, col: jax.Array, values: jax.Array, op: str = "set"
+) -> jax.Array:
+    """``plane.at[arange(N), col].set/max(values)`` routed like
+    ``_row_at`` (the scatter twin)."""
+    if _on_ring():
+        return _grc.ring_update_per_row(plane, col, values, op=op)
+    n = plane.shape[0]
+    upd = plane.at[jnp.arange(n, dtype=jnp.int32), col]
+    if op == "set":
+        return upd.set(values, unique_indices=True)
+    return upd.max(values, unique_indices=True)
 
 
 def converged_impl(state: ClusterState, net: NetState) -> jax.Array:
@@ -1564,8 +1621,10 @@ def swim_step_impl(
     # claims; anti-echo (value form, see module docstring) drops claims
     # equal to what s itself holds now — s delivered the claim this tick,
     # so equality means s provably already has it.
-    reply_key = state.view_key[t_safe]  # int32[N(sender), N(subject)]
-    rep_row = rep_issuable[t_safe] & ~(delivered & (reply_key == state.view_key))
+    reply_key = _gather_rows(state.view_key, t_safe)  # int32[N(snd), N(subj)]
+    rep_row = _gather_rows(rep_issuable, t_safe) & ~(
+        delivered & (reply_key == state.view_key)
+    )
     # full sync (dissemination.js:100-118): nothing to say but checksums
     # disagree -> entire view row
     full_sync = fwd_ok & ~jnp.any(rep_row, axis=1) & (h_post[t_safe] != h_pre)
@@ -1619,7 +1678,7 @@ def swim_step_impl(
         # a viewer that itself declares alive->suspect flaps too (the host
         # library scores these via the membership 'updated' event)
         declare_flap = declared & was_alive_at_target
-        flaps = flaps.at[ids, t_safe].max(declare_flap, unique_indices=True)
+        flaps = _row_update(flaps, t_safe, declare_flap, op="max")
         damp = (
             state.damp.astype(jnp.float32) * params.damp_decay_per_tick
             + jnp.where(flaps, jnp.float32(params.damp_penalty), 0.0)

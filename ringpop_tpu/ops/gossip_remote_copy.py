@@ -1,0 +1,472 @@
+"""Point-to-point gossip plane: ring collectives for the sharded step.
+
+The mesh-2 sharded dense step's collective census (PR 15) counts 75
+full member-plane all-gathers per step — 30 in ``swim.recv_merge``
+alone, where the sorted merge's [N, N] row permutation is re-replicated
+on every call.  This module replaces those gathers with
+neighbor-exchange ring steps inside ``shard_map``: each shard holds a
+contiguous slice of the member axis, and inter-shard claims/acks hop
+around the ring device-to-device instead of being broadcast.  In the
+post-SPMD HLO the member plane never appears as an ``all-gather``
+operand again — the partitioning auditor's ``p2p_only`` fence
+(analysis/partitioning.py) pins that forever.
+
+Three primitives, all exact (bit-identical to the gather forms they
+replace — every one is a permutation/selection, never a re-association
+of floating point):
+
+* ``ring_recv_merge(t_safe, fwd_ok, claim_rows)`` — the receiver merge
+  (``swim_sim._receiver_merge``).  Claim rows circulate the ring; each
+  hop every shard folds the rows addressed to its own receivers with a
+  local scatter-max, so the [N, N] permutation/merge intermediates of
+  the sorted form stay shard-local ([N/D, N]) instead of being
+  re-replicated 30x per step.
+* ``ring_fetch_rows(plane, idx)`` — a row gather ``plane[idx]`` where
+  ``plane`` is row-sharded and ``idx`` is aligned with the member axis
+  (one fetch per local row).  The plane's shard blocks circulate; each
+  shard picks its rows out of the passing block.
+* ``ring_fetch_global(plane, idx)`` — same, but ``idx`` is replicated
+  and so is the output (the traffic plane's ``mask_all[viewer]``
+  lookups, which every host serves identically).
+
+The per-hop transport is swappable at trace time via
+``RINGPOP_GOSSIP_HOP``:
+
+* ``ppermute`` — ``lax.ppermute`` (lowers to ``collective-permute``,
+  which the census already classifies as point-to-point).  The only
+  executable form on CPU virtual meshes, hence the default off-TPU.
+* ``pallas`` — a Pallas kernel built on
+  ``pltpu.make_async_remote_copy`` with paired send/recv DMA
+  semaphores (the SNIPPETS right-permute pattern): each shard starts
+  one async copy of its block into its right neighbor's output buffer
+  and waits both semaphores.  Lowers to a ``tpu_custom_call`` the
+  census reports as a DMA custom-call, not a collective at all.
+  Remote DMA has no interpret-mode emulation on CPU in the pinned
+  jax, so off-TPU coverage is structural: the kernel must lower for
+  the TPU platform (tests/test_gossip_remote_copy.py) while the
+  padding math is exercised through a local ``make_async_copy``
+  kernel in interpret mode.
+* ``auto`` (default) — ``pallas`` iff ``jax.default_backend()`` is
+  TPU, else ``ppermute``.
+
+Like the ``RINGPOP_RECV_MERGE`` knob, the env var is read at trace
+time; changing it requires ``jax.clear_caches()``.
+
+The mesh/axis the primitives run over comes from an ambient trace-time
+context, not an argument: ``parallel/mesh.py`` wraps its traces in
+``ring_mesh(mesh)`` and the models ask ``active_ring()``.  This keeps
+models/ free of any parallel/ import (the same layering rule that puts
+this file in ops/).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ringpop_tpu.obs import annotate
+
+# ---------------------------------------------------------------------------
+# Ambient ring context (trace-time, same stack idiom as _RECV_MERGE_FORCE)
+# ---------------------------------------------------------------------------
+
+_RING_STACK: list[tuple[Mesh, str]] = []
+
+
+@contextlib.contextmanager
+def ring_mesh(mesh: Mesh, axis: str | None = None) -> Iterator[None]:
+    """Make ``mesh`` the ambient gossip ring for traces in this block.
+
+    ``axis`` defaults to the mesh's (single) axis name.  Re-entrant:
+    the innermost context wins, so a nested trace over a different
+    mesh (e.g. the audit CLI compiling mesh-2 and mesh-4 entries back
+    to back) never leaks.
+    """
+    if axis is None:
+        (axis,) = mesh.axis_names
+    _RING_STACK.append((mesh, axis))
+    try:
+        yield
+    finally:
+        _RING_STACK.pop()
+
+
+def active_ring() -> tuple[Mesh, str] | None:
+    """The innermost ``ring_mesh`` context, or None outside any."""
+    return _RING_STACK[-1] if _RING_STACK else None
+
+
+def ring_devices() -> int:
+    """Ring size of the active context (0 when no ring is active)."""
+    ring = active_ring()
+    if ring is None:
+        return 0
+    mesh, axis = ring
+    return mesh.shape[axis]
+
+
+# ---------------------------------------------------------------------------
+# Hop transport: one rightward ring shift of each shard's block
+# ---------------------------------------------------------------------------
+
+
+def hop_mode() -> str:
+    """Resolve RINGPOP_GOSSIP_HOP to the transport for this trace."""
+    raw = os.environ.get("RINGPOP_GOSSIP_HOP", "auto").strip().lower()
+    if raw not in ("auto", "pallas", "ppermute"):
+        raise ValueError(
+            f"RINGPOP_GOSSIP_HOP={raw!r}: want auto, pallas or ppermute"
+        )
+    if raw == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "ppermute"
+    return raw
+
+
+def ring_perm(d: int) -> list[tuple[int, int]]:
+    """The rightward ring permutation: shard i's block goes to i+1."""
+    return [(i, (i + 1) % d) for i in range(d)]
+
+
+def block_origin(me: int, hop: int, d: int) -> int:
+    """Which shard's block ``me`` holds after ``hop`` rightward shifts.
+
+    Host-side mirror of the traced arithmetic in the fetch primitives;
+    the unit tests pin both against each other.
+    """
+    return (me - hop) % d
+
+
+def hop_schedule(d: int) -> list[list[tuple[int, int]]]:
+    """Per-hop (sender, receiver) pairs for a full D-1-hop circulation.
+
+    Every hop is the same rightward permutation; the schedule form
+    exists so tests can assert the pairing invariants (each shard
+    sends exactly once and receives exactly once per hop — one send
+    semaphore and one recv semaphore satisfied per kernel launch —
+    and over the full schedule each shard has seen every block).
+    """
+    return [ring_perm(d) for _ in range(d - 1)]
+
+
+# -- Pallas transport -------------------------------------------------------
+
+_SUBLANE = 8  # int32 sublane tile
+_LANE = 128
+
+
+def _pad_tile(r: int, c: int) -> tuple[int, int]:
+    """Mosaic-aligned (rows, cols) for an int32 [r, c] block.
+
+    The ragged last-shard case (block dims not tile-aligned, e.g.
+    n=48 over 4 shards at lane width 128) pads up; the wrapper slices
+    the pad back off after the copy.
+    """
+    return -(-r // _SUBLANE) * _SUBLANE, -(-c // _LANE) * _LANE
+
+
+_CompilerParams = getattr(pltpu, "TPUCompilerParams", None) or getattr(
+    pltpu, "CompilerParams", None
+)
+_MEMSPACE_ANY = getattr(pltpu.TPUMemorySpace, "ANY", None) or getattr(
+    pltpu, "ANY"
+)
+
+
+def _hop_kernel(d: int, axis: str, in_ref, out_ref, send_sem, recv_sem):
+    """Send my block to the right neighbor; wait for the left's.
+
+    One ``make_async_remote_copy`` per launch: ``send_sem`` tracks my
+    outbound DMA, ``recv_sem`` the inbound one the left neighbor
+    started, and ``wait()`` blocks on both — the pairing the unit
+    tests assert on the schedule.  The barrier semaphore up front
+    keeps a fast shard from writing into a neighbor still in a prior
+    kernel (pallas guide ring idiom).
+    """
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, d)
+    left = jax.lax.rem(me + d - 1, d)
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, 1, device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL
+    )
+    pltpu.semaphore_signal(
+        barrier, 1, device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    copy = pltpu.make_async_remote_copy(
+        src_ref=in_ref,
+        dst_ref=out_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    copy.start()
+    copy.wait()
+
+
+def _hop_pallas_2d(x2: jax.Array, axis: str, d: int) -> jax.Array:
+    """Rightward shift of an int32 [r, c] block via remote DMA."""
+    r, c = x2.shape
+    pr, pc = _pad_tile(r, c)
+    if (pr, pc) != (r, c):
+        x2 = jnp.pad(x2, ((0, pr - r), (0, pc - c)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=_MEMSPACE_ANY)],
+        out_specs=pl.BlockSpec(memory_space=_MEMSPACE_ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+    )
+    params: dict[str, Any] = {}
+    if _CompilerParams is not None:
+        # collective_id pairs the barrier semaphore across the
+        # participating cores; the DMA/semaphore ops themselves mark the
+        # kernel effectful (the pinned TPUCompilerParams has no
+        # has_side_effects field)
+        params["compiler_params"] = _CompilerParams(collective_id=0)
+    out = pl.pallas_call(
+        functools.partial(_hop_kernel, d, axis),
+        out_shape=jax.ShapeDtypeStruct((pr, pc), jnp.int32),
+        grid_spec=grid_spec,
+        **params,
+    )(x2)
+    return out[:r, :c]
+
+
+def _hop_pallas_one(x: jax.Array, axis: str, d: int) -> jax.Array:
+    """Shift one block of any rank/dtype: flatten to int32 2-D, copy,
+    restore.  Hop payloads are int32/bool member-plane slices, so the
+    widening is at most 4x on the [n_loc] vectors — noise next to the
+    [n_loc, N] rows that dominate the hop."""
+    orig_dtype = x.dtype
+    orig_shape = x.shape
+    lead = orig_shape[0] if x.ndim >= 1 else 1
+    x2 = x.astype(jnp.int32).reshape(lead, -1)
+    out = _hop_pallas_2d(x2, axis, d)
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def _hop(blocks: tuple[jax.Array, ...], axis: str, d: int) -> tuple[jax.Array, ...]:
+    """One rightward ring shift of every array in ``blocks``."""
+    if hop_mode() == "pallas":
+        return tuple(_hop_pallas_one(b, axis, d) for b in blocks)
+    perm = ring_perm(d)
+    return tuple(jax.lax.ppermute(b, axis, perm) for b in blocks)
+
+
+# ---------------------------------------------------------------------------
+# Ring primitives
+# ---------------------------------------------------------------------------
+
+
+def _bcast(mask: jax.Array, ndim: int) -> jax.Array:
+    """Right-pad ``mask`` with singleton dims up to ``ndim``."""
+    return mask.reshape(mask.shape + (1,) * (ndim - mask.ndim))
+
+
+def _require_ring(n: int) -> tuple[Mesh, str, int, int]:
+    ring = active_ring()
+    if ring is None:
+        raise RuntimeError(
+            "ring primitive called outside a ring_mesh() context"
+        )
+    mesh, axis = ring
+    d = mesh.shape[axis]
+    if n % d != 0:
+        raise ValueError(f"member axis {n} not divisible by ring size {d}")
+    return mesh, axis, d, n // d
+
+
+@annotate.scoped("swim.recv_merge")
+def ring_recv_merge(
+    t_safe: jax.Array, fwd_ok: jax.Array, claim_rows: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(in_key int32[N, N], inbound int32[N]): the receiver merge as a
+    D-1-hop ring exchange — bit-identical to the sorted/scatter forms.
+
+    ``t_safe[s]`` is sender s's receiver, ``fwd_ok[s]`` delivery,
+    ``claim_rows[s]`` its already-masked (>= 0) claim row.  Sender
+    blocks circulate; at each hop a shard scatter-maxes the passing
+    rows addressed to its own receiver range and counts them.  Max and
+    add are commutative over the hop order and the rows are
+    non-negative int32, so the fold equals the global sorted merge
+    exactly — while the [*, N] merge state stays [N/D, N] per shard.
+    """
+    n = t_safe.shape[0]
+    mesh, axis, d, n_loc = _require_ring(n)
+
+    def body(dest: jax.Array, ok: jax.Array, rows: jax.Array):
+        me = jax.lax.axis_index(axis)
+        off = me * n_loc
+        acc = jnp.zeros((n_loc, n), jnp.int32)
+        inb = jnp.zeros((n_loc,), jnp.int32)
+        blk = (dest, ok, rows)
+        for h in range(d):
+            bdest, bok, brows = blk
+            tgt = bdest - off
+            # out-of-range (another shard's receiver) or undelivered
+            # senders fold into the dropped n_loc slot
+            tgt = jnp.where(
+                (bok > 0) & (tgt >= 0) & (tgt < n_loc), tgt, n_loc
+            )
+            acc = acc.at[tgt].max(
+                jnp.where((bok > 0)[:, None], brows, 0), mode="drop"
+            )
+            inb = inb.at[tgt].add(1, mode="drop")
+            if h < d - 1:
+                blk = _hop(blk, axis, d)
+        in_key = jnp.where((inb > 0)[:, None], acc, 0)
+        return in_key, inb
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis, None)),
+        out_specs=(P(axis, None), P(axis)),
+        check_rep=False,
+    )(
+        t_safe.astype(jnp.int32),
+        fwd_ok.astype(jnp.int32),
+        claim_rows.astype(jnp.int32),
+    )
+
+
+@annotate.scoped("gossip.ring_fetch")
+def ring_fetch_rows(plane: jax.Array, idx: jax.Array) -> jax.Array:
+    """``plane[idx]`` with ``plane`` row-sharded and ``idx`` aligned to
+    the member axis (``idx.shape[0] == plane.shape[0]``, global row
+    ids, any trailing index shape).  Output shape
+    ``idx.shape + plane.shape[1:]``, row-sharded like the inputs.
+
+    The plane's shard blocks circulate the ring; at hop h a shard
+    holds the block of ``block_origin(me, h, d)`` and resolves every
+    local index pointing into that range.  A pure gather — exact.
+    """
+    n = plane.shape[0]
+    mesh, axis, d, n_loc = _require_ring(n)
+
+    def body(blk: jax.Array, il: jax.Array) -> jax.Array:
+        me = jax.lax.axis_index(axis)
+        out = jnp.zeros(il.shape + blk.shape[1:], blk.dtype)
+        cur = (blk,)
+        for h in range(d):
+            src = jax.lax.rem(me - h + d, d)
+            sel = (il // n_loc) == src
+            loc = jnp.clip(il - src * n_loc, 0, n_loc - 1)
+            got = cur[0][loc]
+            out = jnp.where(_bcast(sel, got.ndim), got, out)
+            if h < d - 1:
+                cur = _hop(cur, axis, d)
+        return out
+
+    plane_spec = P(axis, *([None] * (plane.ndim - 1)))
+    idx_spec = P(axis, *([None] * (idx.ndim - 1)))
+    out_spec = P(axis, *([None] * (idx.ndim - 1 + plane.ndim - 1)))
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(plane_spec, idx_spec),
+        out_specs=out_spec,
+        check_rep=False,
+    )(plane, idx.astype(jnp.int32))
+
+
+@annotate.scoped("gossip.per_row")
+def ring_take_per_row(plane: jax.Array, col: jax.Array) -> jax.Array:
+    """``plane[arange(N), col]`` — each viewer row reads one of its own
+    columns (the diagonal when ``col = arange(N)``).  Row-local under
+    viewer-row sharding, so the shard_map body does NO communication;
+    the point is to stop XLA from materializing (and re-replicating)
+    the [N, 2] gather-index tensor the fused form all-gathers."""
+    n = plane.shape[0]
+    mesh, axis, d, n_loc = _require_ring(n)
+
+    def body(blk: jax.Array, cl: jax.Array) -> jax.Array:
+        r = jnp.arange(n_loc, dtype=jnp.int32)
+        return blk[r, jnp.clip(cl, 0, n - 1)]
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis)),
+        out_specs=P(axis),
+        check_rep=False,
+    )(plane, col.astype(jnp.int32))
+
+
+@annotate.scoped("gossip.per_row")
+def ring_update_per_row(
+    plane: jax.Array, col: jax.Array, values: jax.Array, op: str = "set"
+) -> jax.Array:
+    """``plane.at[arange(N), col].set/max(values)`` — each viewer row
+    writes one of its own columns.  Row-local like
+    ``ring_take_per_row``; ``op`` picks the scatter combiner."""
+    if op not in ("set", "max"):
+        raise ValueError(f"op={op!r}: set|max")
+    n = plane.shape[0]
+    mesh, axis, d, n_loc = _require_ring(n)
+
+    def body(blk: jax.Array, cl: jax.Array, vl: jax.Array) -> jax.Array:
+        r = jnp.arange(n_loc, dtype=jnp.int32)
+        upd = blk.at[r, jnp.clip(cl, 0, n - 1)]
+        if op == "set":
+            return upd.set(vl, unique_indices=True)
+        return upd.max(vl, unique_indices=True)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis)),
+        out_specs=P(axis, None),
+        check_rep=False,
+    )(plane, col.astype(jnp.int32), values)
+
+
+@annotate.scoped("gossip.ring_fetch")
+def ring_fetch_global(plane: jax.Array, idx: jax.Array) -> jax.Array:
+    """``plane[idx]`` with ``plane`` row-sharded and ``idx`` (any
+    shape of global row ids) replicated; the output is replicated too.
+
+    Every shard watches all D blocks pass and resolves the full index
+    set identically, so the replicated output needs no final gather —
+    the traffic plane's ``mask_all[viewer]`` lookups served from
+    sharded membership truth.
+    """
+    n = plane.shape[0]
+    mesh, axis, d, n_loc = _require_ring(n)
+
+    def body(blk: jax.Array, il: jax.Array) -> jax.Array:
+        me = jax.lax.axis_index(axis)
+        out = jnp.zeros(il.shape + blk.shape[1:], blk.dtype)
+        cur = (blk,)
+        for h in range(d):
+            src = jax.lax.rem(me - h + d, d)
+            sel = (il // n_loc) == src
+            loc = jnp.clip(il - src * n_loc, 0, n_loc - 1)
+            got = cur[0][loc]
+            out = jnp.where(_bcast(sel, got.ndim), got, out)
+            if h < d - 1:
+                cur = _hop(cur, axis, d)
+        return out
+
+    plane_spec = P(axis, *([None] * (plane.ndim - 1)))
+    idx_spec = P(*([None] * idx.ndim))
+    out_spec = P(*([None] * (idx.ndim + plane.ndim - 1)))
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(plane_spec, idx_spec),
+        out_specs=out_spec,
+        check_rep=False,
+    )(plane, idx.astype(jnp.int32))

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.models.swim_sim import ALIVE, SUSPECT, _link_delay_bounds
+from ringpop_tpu.ops import gossip_remote_copy as _grc
 from ringpop_tpu.ops.ring_ops import DeviceRing, lookup_n_idx
 from ringpop_tpu.traffic import latency as tlat
 
@@ -222,6 +223,27 @@ def plane_names(static: TrafficStatic) -> tuple[tuple[str, int], ...]:
     return ()
 
 
+def _viewer_rows(mask_all: jax.Array, req_idx: jax.Array) -> jax.Array:
+    """``mask_all[req_idx]`` — per-request viewer rows of the [N, N]
+    ring mask.  Under an ambient gossip ring the row-sharded membership
+    plane resolves the (replicated, request-aligned) viewer ids hop by
+    hop instead of being all-gathered — the traffic plane serves from
+    sharded membership truth."""
+    if _grc.active_ring() is not None:
+        return _grc.ring_fetch_global(mask_all, req_idx)
+    return mask_all[req_idx]
+
+
+def _self_in_ring(mask_all: jax.Array) -> jax.Array:
+    """The ``mask_all[i, i]`` diagonal (does i's own view hold i) —
+    row-local under the gossip ring, so no index tensor replicates."""
+    n = mask_all.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    if _grc.active_ring() is not None:
+        return _grc.ring_take_per_row(mask_all, ids)
+    return mask_all[ids, ids]
+
+
 def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
                 net=None, period=None, policy=None):
     n = view_rows.shape[0]
@@ -233,7 +255,7 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
     # the gossip predicate (truth ring + served arrivals) is pure
     # liveness — a member damped out of everyone's ring still serves
     # the requests that land on it
-    gossip = up & responsive & mask_all[ids, ids]  # ground-truth ring
+    gossip = up & responsive & _self_in_ring(mask_all)  # ground-truth ring
     if damped is not None:
         # damped members are quarantined from the viewer's RING, same
         # as the host ring_for (damping extension)
@@ -256,7 +278,7 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
         rh, ro, khash, truth_mask, window=w
     )
     owner0, found0 = lookup_masked_idx(
-        rh, ro, khash, mask_all[viewer], window=w
+        rh, ro, khash, _viewer_rows(mask_all, viewer), window=w
     )
     resolved = served & found0
     handled_local = resolved & (owner0 == viewer)
@@ -326,7 +348,7 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             has_retry = retries < cap
             alive_h = gossip[hc]
             retry_dead = act & ~alive_h & has_retry  # failed send, re-sent
-            nxt, f = lookup_masked_idx(rh, ro, khash, mask_all[hc], window=w)
+            nxt, f = lookup_masked_idx(rh, ro, khash, _viewer_rows(mask_all, hc), window=w)
             done = act & alive_h & f & (nxt == h)
             settled = settled | done
             final = jnp.where(done, h, final)
@@ -407,7 +429,7 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
             dead = act & ~alive_h
             gray_to = gray_to + jnp.sum(timeout, dtype=jnp.int32)
             send_err = send_err + jnp.sum(dead | timeout, dtype=jnp.int32)
-            nxt, f = lookup_masked_idx(rh, ro, khash, mask_all[hc], window=w)
+            nxt, f = lookup_masked_idx(rh, ro, khash, _viewer_rows(mask_all, hc), window=w)
             done = serves & f & (nxt == h)
             settled = settled | done
             final = jnp.where(done, h, final)
@@ -493,7 +515,7 @@ def _serve_impl(view_rows, up, responsive, tensors, t, static, damped=None,
         # M); the incomplete residue is counted, not silently padded
         wn = min(w, 32 + 8 * static.lookup_n)
         _, complete = lookup_n_masked_idx(
-            rh, ro, khash, mask_all[viewer], static.lookup_n, window=wn
+            rh, ro, khash, _viewer_rows(mask_all, viewer), static.lookup_n, window=wn
         )
         out["lookupns"] = count(served)
         out["lookupn_incomplete"] = count(served & ~complete)

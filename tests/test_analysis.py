@@ -431,10 +431,12 @@ def test_registry_builders_cover_declared_backends():
     assert built.mesh_size == 0
     sharded = build_entry("sharded_step", "dense", n=8)
     assert sharded.mesh_size == 2 and sharded.mesh_axis == "nodes"
-    # the data-parallel sweep declares the strict point-to-point
-    # contract; the gossip step cannot yet (ROADMAP item 1)
+    # the strict point-to-point contract: the ring gossip plane (PR 18)
+    # declares it on the remote-copy entries; only the explicit
+    # all-gather baseline entry opts out
     assert build_entry("run_sweep+shard", "dense", n=8, ticks=2).p2p_only
-    assert not sharded.p2p_only
+    assert sharded.p2p_only
+    assert not build_entry("sharded_step+gather", "dense", n=8).p2p_only
 
 
 @pytest.mark.slow
@@ -715,9 +717,11 @@ def test_sharded_step_audits_clean():
     # the clean sharded lane's fast representative: the real mesh-2
     # sharded dense step at the PINNED budget shape must satisfy every
     # partitioning contract — collective census matching the pinned
-    # (all-gather-shaped, honestly) budget, member-bearing outputs
-    # still row-sharded after unconstrained propagation, donation via
-    # the compiled alias table
+    # budget, member-bearing outputs still row-sharded after
+    # unconstrained propagation, donation via the compiled alias table.
+    # Since PR 18 the default lowering is the p2p ring plane: ZERO
+    # member-gathers (the fence), with the old 75-gather lowering
+    # pinned separately on the sharded_step+gather baseline entry
     report = audit_entry("sharded_step", "dense", n=64)
     assert report.mesh_size == 2
     assert [f for f in report.findings if f.severity != "info"] == [], [
@@ -725,9 +729,9 @@ def test_sharded_step_audits_clean():
     ]
     assert report.aliased_outputs >= 1
     counts = partitioning.collective_counts(report.collectives)
-    assert counts.get("member-gather", 0) > 0  # today's honest baseline
-    phases = {r["phase"] for r in report.collectives if r["member"]}
-    assert any(p.startswith("swim.") for p in phases)
+    assert counts.get("member-gather", 0) == 0  # the flipped fence
+    # the ring hops ARE the cross-shard gossip now
+    assert counts.get("collective-permute", 0) > 0
 
 
 def test_registry_sharded_entries_skip_without_devices(monkeypatch):

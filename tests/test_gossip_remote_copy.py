@@ -1,0 +1,401 @@
+"""The point-to-point gossip plane (ops/gossip_remote_copy.py).
+
+Three layers of coverage, mirroring what each can prove on a CPU host:
+
+* numeric — the ring primitives against their gather/scatter reference
+  forms on virtual CPU meshes (the ppermute hop transport), including
+  the ragged last-shard shapes;
+* structural — the Pallas remote-copy hop must LOWER for the TPU
+  platform (remote DMA has no CPU interpret emulation in the pinned
+  jax), and the hop schedule's semaphore-pairing invariants hold;
+* interpret — the Mosaic tile-padding math runs for real through a
+  local ``make_async_copy`` kernel in interpret mode.
+
+Plus the fast mesh-2 bit-parity checks (dense and delta at n=16) and
+the sharding-spec completeness gate: a state field added without an
+explicit layout in parallel/mesh.py's FIELD_SPECS maps must fail
+loudly here, not silently replicate.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu import parallel
+from ringpop_tpu.models import swim_delta as sd
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.ops import gossip_remote_copy as grc
+from ringpop_tpu.parallel import mesh as pmesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+# ---------------------------------------------------------------------------
+# hop schedule invariants (the semaphore-pairing contract, host-side)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("d", [2, 3, 4, 8])
+def test_hop_schedule_pairing_and_coverage(d):
+    """Per hop every shard sends exactly once and receives exactly
+    once — the one-send-semaphore/one-recv-semaphore pairing each
+    kernel launch satisfies — and over the full D-1-hop schedule every
+    shard has held every block (``block_origin`` is the ledger)."""
+    sched = grc.hop_schedule(d)
+    assert len(sched) == d - 1
+    for perm in sched:
+        assert sorted(s for s, _ in perm) == list(range(d))
+        assert sorted(r for _, r in perm) == list(range(d))
+    # replay the schedule: held[me] = origin of the block me holds
+    held = list(range(d))
+    for h, perm in enumerate(sched, start=1):
+        held = [held[dict((r, s) for s, r in perm)[me]] for me in range(d)]
+        for me in range(d):
+            assert held[me] == grc.block_origin(me, h, d)
+    # D-1 hops visit all D blocks (the initial hold counts)
+    seen = {grc.block_origin(0, h, d) for h in range(d)}
+    assert seen == set(range(d))
+
+
+def test_hop_mode_env_validation(monkeypatch):
+    monkeypatch.setenv("RINGPOP_GOSSIP_HOP", "nope")
+    with pytest.raises(ValueError, match="RINGPOP_GOSSIP_HOP"):
+        grc.hop_mode()
+    monkeypatch.setenv("RINGPOP_GOSSIP_HOP", "auto")
+    assert grc.hop_mode() == "ppermute"  # CPU host
+
+
+def test_ring_context_required_and_divisibility():
+    with pytest.raises(RuntimeError, match="ring_mesh"):
+        grc.ring_fetch_rows(jnp.zeros((8, 4)), jnp.arange(8))
+    with grc.ring_mesh(parallel.make_mesh(4)):
+        assert grc.ring_devices() == 4
+        with pytest.raises(ValueError, match="not divisible"):
+            grc.ring_fetch_rows(jnp.zeros((6, 4)), jnp.arange(6))
+    assert grc.active_ring() is None
+
+
+# ---------------------------------------------------------------------------
+# numeric parity of the primitives (ppermute transport, CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("d,n", [(2, 48), (4, 48), (8, 64)])
+def test_ring_fetch_rows_matches_gather(d, n):
+    """Row fetch == plain gather, including the ragged last-shard
+    shapes (n=48 over 4 shards: 12-row blocks, no tile alignment)."""
+    rng = np.random.default_rng(d * 100 + n)
+    plane = jnp.asarray(rng.integers(0, 1 << 20, (n, 7), dtype=np.int32))
+    idx1 = jnp.asarray(rng.integers(0, n, (n,), dtype=np.int32))
+    idx2 = jnp.asarray(rng.integers(0, n, (n, 3), dtype=np.int32))
+    with grc.ring_mesh(parallel.make_mesh(d)):
+        got1 = jax.jit(grc.ring_fetch_rows)(plane, idx1)
+        got2 = jax.jit(grc.ring_fetch_rows)(plane, idx2)
+    np.testing.assert_array_equal(np.asarray(got1), np.asarray(plane[idx1]))
+    np.testing.assert_array_equal(np.asarray(got2), np.asarray(plane[idx2]))
+
+
+@pytest.mark.parametrize("d", [2, 4])
+def test_ring_fetch_global_matches_gather(d):
+    """Replicated-index fetch (the traffic plane's viewer lookups):
+    every shard resolves the full index set, bool planes included."""
+    n, m = 64, 23
+    rng = np.random.default_rng(d)
+    plane = jnp.asarray(rng.integers(0, 2, (n, n), dtype=np.int32) > 0)
+    idx = jnp.asarray(rng.integers(0, n, (m,), dtype=np.int32))
+    with grc.ring_mesh(parallel.make_mesh(d)):
+        got = jax.jit(grc.ring_fetch_global)(plane, idx)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(plane[idx]))
+
+
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_ring_recv_merge_matches_scatter_reference(d):
+    n = 64
+    rng = np.random.default_rng(d + 7)
+    t_safe = jnp.asarray(rng.integers(0, n, (n,), dtype=np.int32))
+    fwd_ok = jnp.asarray(rng.integers(0, 2, (n,), dtype=np.int32) > 0)
+    rows = jnp.asarray(rng.integers(0, 1 << 16, (n, n), dtype=np.int32))
+    # the reference: scatter-max delivered rows per receiver
+    ref_key = jnp.zeros((n, n), jnp.int32).at[
+        jnp.where(fwd_ok, t_safe, n)
+    ].max(jnp.where(fwd_ok[:, None], rows, 0), mode="drop")
+    ref_inb = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(fwd_ok, t_safe, n)
+    ].add(1, mode="drop")
+    ref_key = jnp.where((ref_inb > 0)[:, None], ref_key, 0)
+    with grc.ring_mesh(parallel.make_mesh(d)):
+        in_key, inb = jax.jit(grc.ring_recv_merge)(t_safe, fwd_ok, rows)
+    np.testing.assert_array_equal(np.asarray(in_key), np.asarray(ref_key))
+    np.testing.assert_array_equal(np.asarray(inb), np.asarray(ref_inb))
+
+
+def test_ring_per_row_take_and_update():
+    n, d = 64, 4
+    rng = np.random.default_rng(11)
+    plane = jnp.asarray(rng.integers(0, 1 << 20, (n, n), dtype=np.int32))
+    col = jnp.asarray(rng.integers(0, n, (n,), dtype=np.int32))
+    vals = jnp.asarray(rng.integers(0, 1 << 20, (n,), dtype=np.int32))
+    ids = jnp.arange(n, dtype=jnp.int32)
+    with grc.ring_mesh(parallel.make_mesh(d)):
+        take = jax.jit(grc.ring_take_per_row)(plane, col)
+        upd_set = jax.jit(
+            lambda p, c, v: grc.ring_update_per_row(p, c, v, op="set")
+        )(plane, col, vals)
+        upd_max = jax.jit(
+            lambda p, c, v: grc.ring_update_per_row(p, c, v, op="max")
+        )(plane, col, vals)
+        with pytest.raises(ValueError, match="set|max"):
+            grc.ring_update_per_row(plane, col, vals, op="mean")
+    np.testing.assert_array_equal(np.asarray(take), np.asarray(plane[ids, col]))
+    np.testing.assert_array_equal(
+        np.asarray(upd_set),
+        np.asarray(plane.at[ids, col].set(vals, unique_indices=True)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(upd_max),
+        np.asarray(plane.at[ids, col].max(vals, unique_indices=True)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pallas transport: padding math (interpret) + TPU lowering (structural)
+# ---------------------------------------------------------------------------
+
+
+def test_pad_tile_rounds_to_mosaic_tiles():
+    assert grc._pad_tile(8, 128) == (8, 128)
+    assert grc._pad_tile(6, 48) == (8, 128)  # ragged both ways
+    assert grc._pad_tile(12, 64) == (16, 128)  # n=48 over 4 shards
+    assert grc._pad_tile(1, 1) == (8, 128)
+    for r, c in [(3, 5), (9, 129), (16, 256)]:
+        pr, pc = grc._pad_tile(r, c)
+        assert pr % grc._SUBLANE == 0 and pc % grc._LANE == 0
+        assert pr >= r and pc >= c and pr - r < grc._SUBLANE
+
+
+def test_local_async_copy_through_padded_tile_interpret():
+    """The pad -> DMA-copy -> slice round trip of the hop wrapper,
+    run for real in interpret mode with a LOCAL ``make_async_copy``
+    (remote DMA has no CPU emulation; the padding math is identical)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def copy_kernel(in_ref, out_ref, sem):
+        copy = pltpu.make_async_copy(in_ref, out_ref, sem)
+        copy.start()
+        copy.wait()
+
+    r, c = 12, 33  # the ragged shard block shape class
+    pr, pc = grc._pad_tile(r, c)
+    x = jnp.arange(r * c, dtype=jnp.int32).reshape(r, c)
+    x_pad = jnp.pad(x, ((0, pr - r), (0, pc - c)))
+    out = pl.pallas_call(
+        copy_kernel,
+        out_shape=jax.ShapeDtypeStruct((pr, pc), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=grc._MEMSPACE_ANY)],
+        out_specs=pl.BlockSpec(memory_space=grc._MEMSPACE_ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+        interpret=True,
+    )(x_pad)
+    np.testing.assert_array_equal(np.asarray(out[:r, :c]), np.asarray(x))
+
+
+@pytest.mark.slow
+def test_pallas_hop_lowers_for_tpu(monkeypatch):
+    """The remote-copy hop must produce a TPU ``tpu_custom_call``
+    module via cross-platform lowering — the structural half of the
+    off-TPU contract (execution coverage needs a real TPU)."""
+    import jax.export
+
+    monkeypatch.setenv("RINGPOP_GOSSIP_HOP", "pallas")
+    jax.clear_caches()
+    d, n = 2, 64
+    mesh = parallel.make_mesh(d)
+    plane = jnp.zeros((n, 16), jnp.int32)
+    idx = jnp.zeros((n,), jnp.int32)
+    try:
+        with grc.ring_mesh(mesh):
+            exported = jax.export.export(
+                jax.jit(grc.ring_fetch_rows), platforms=["tpu"]
+            )(plane, idx)
+        text = exported.mlir_module()
+    finally:
+        jax.clear_caches()  # drop programs traced under the forced env
+    assert "tpu_custom_call" in text
+
+
+# ---------------------------------------------------------------------------
+# fast mesh-2 bit parity at n=16 (the ring plane as the default lowering)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_step_ring_bit_parity_n16():
+    n = 16
+    params = sim.SwimParams(loss=0.05)
+    mesh = parallel.make_mesh(2)
+    ref = sim.init_state(n, mode="self")
+    sh, net = parallel.shard_cluster(sim.init_state(n, mode="self"),
+                                     sim.make_net(n), mesh)
+    step = parallel.sharded_step(mesh)
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    for k in keys:
+        ref, m_ref = sim.swim_step(ref, sim.make_net(n), k, params)
+        sh, m_sh = step(sh, net, k, params)
+    np.testing.assert_array_equal(np.asarray(ref.view_key),
+                                  np.asarray(sh.view_key))
+    np.testing.assert_array_equal(np.asarray(ref.pb), np.asarray(sh.pb))
+    for k in m_ref:
+        np.testing.assert_array_equal(np.asarray(m_ref[k]),
+                                      np.asarray(m_sh[k]), err_msg=k)
+
+
+def test_sharded_delta_ring_bit_parity_n16():
+    n = 16
+    params = sd.DeltaParams(swim=sim.SwimParams(loss=0.05, suspicion_ticks=4),
+                            wire_cap=4, claim_grid=8)
+    net = sim.make_net(n)
+    mesh = parallel.make_mesh(2)
+    ref = sd.init_delta(n, capacity=8)
+    sh = parallel.shard_delta(sd.init_delta(n, capacity=8), mesh)
+    step_ref = jax.jit(sd.delta_step_impl, static_argnames=("params", "upto"))
+    step_sh = parallel.sharded_delta_step(mesh)
+    keys = jax.random.split(jax.random.PRNGKey(9), 3)
+    for t, k in enumerate(keys):
+        ref, _ = step_ref(ref, net, k, params)
+        sh, _ = step_sh(sh, net, k, params)
+        for name in ("d_subj", "d_key", "d_pb", "d_sl", "base_key", "digest"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, name)),
+                np.asarray(getattr(sh, name)),
+                err_msg=f"{name} tick {t}",
+            )
+
+
+def test_sharded_delta_run_ring_bit_parity_n16():
+    """The scanned form too: sharded ``delta_run`` over 2 devices is
+    bit-identical to the unsharded scan (state AND summed metrics)."""
+    n, ticks = 16, 6
+    params = sd.DeltaParams(swim=sim.SwimParams(loss=0.02), wire_cap=4,
+                            claim_grid=8)
+    net = sim.make_net(n)
+    key = jax.random.PRNGKey(5)
+    ref, m_ref = jax.jit(
+        sd.delta_run_impl, static_argnames=("params", "ticks")
+    )(sd.init_delta(n, capacity=8), net, key, params, ticks)
+    mesh = parallel.make_mesh(2)
+    run = parallel.sharded_delta_run(mesh)
+    sh, m_sh = run(parallel.shard_delta(sd.init_delta(n, capacity=8), mesh),
+                   net, key, params, ticks)
+    for name in ("d_subj", "d_key", "base_key", "digest", "tick"):
+        np.testing.assert_array_equal(np.asarray(getattr(ref, name)),
+                                      np.asarray(getattr(sh, name)), err_msg=name)
+    for k in m_ref:
+        np.testing.assert_array_equal(np.asarray(m_ref[k]),
+                                      np.asarray(m_sh[k]), err_msg=k)
+
+
+def test_gossip_gather_fallback_matches_ring():
+    """RINGPOP_GOSSIP=gather (the PR-15 lowering) stays bit-identical
+    to the ring default — the fallback matrix's exactness row."""
+    n = 16
+    params = sim.SwimParams(loss=0.05)
+    mesh = parallel.make_mesh(2)
+    key = jax.random.PRNGKey(1)
+    outs = {}
+    for mode in ("ring", "gather"):
+        sh, net = parallel.shard_cluster(sim.init_state(n, mode="self"),
+                                         sim.make_net(n), mesh)
+        step = parallel.sharded_step(mesh, gossip=mode)
+        sh, _ = step(sh, net, key, params)
+        outs[mode] = np.asarray(sh.view_key)
+    np.testing.assert_array_equal(outs["ring"], outs["gather"])
+    with pytest.raises(ValueError, match="RINGPOP_GOSSIP"):
+        pmesh.gossip_mode("carrier-pigeon")
+
+
+# ---------------------------------------------------------------------------
+# traffic plane from sharded membership truth
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_serve_matches_unsharded():
+    """Traffic lookups served from the row-sharded view table match
+    ``serve_once`` counter for counter; host-``HashRing`` parity then
+    rides on the oracle tests in test_traffic.py (transitivity)."""
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.traffic import engine as tengine
+
+    c = SimCluster(32, sim.SwimParams(), seed=3)
+    ct = c.compile_traffic({"keys_per_tick": 48, "pool": 128, "lookup_n": 3})
+    base = tengine.serve_once(c.state.view_key, c.net.up, c.net.responsive,
+                              ct.tensors, jnp.int32(0), static=ct.static)
+    serve = pmesh.sharded_serve(parallel.make_mesh(2), static=ct.static)
+    out = serve(c.state.view_key, c.net.up, c.net.responsive, ct.tensors,
+                jnp.int32(0))
+    assert set(out) == set(base)
+    for k in base:
+        np.testing.assert_array_equal(np.asarray(base[k]),
+                                      np.asarray(out[k]), err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# sharding-spec completeness: new state fields must declare a layout
+# ---------------------------------------------------------------------------
+
+
+def test_field_specs_cover_every_state_field():
+    """Walking the dataclass fields: a field added to any of the three
+    sharded state types without an explicit entry in the FIELD_SPECS
+    maps fails HERE (and at trace time with a named KeyError), never
+    silently replicating an [N, N] plane."""
+    assert set(pmesh.CLUSTER_FIELD_SPECS) == set(sim.ClusterState._fields)
+    assert set(pmesh.NET_FIELD_SPECS) == set(sim.NetState._fields)
+    assert set(pmesh.DELTA_FIELD_SPECS) == set(sd.DeltaState._fields)
+    # every declared kind resolves to a real PartitionSpec
+    for specs in (pmesh.CLUSTER_FIELD_SPECS, pmesh.NET_FIELD_SPECS,
+                  pmesh.DELTA_FIELD_SPECS):
+        for kind in specs.values():
+            assert kind in pmesh._SPEC_PARTS or kind == pmesh._ADJ, kind
+
+
+def test_unmapped_field_fails_loudly():
+    mesh = parallel.make_mesh(2)
+    with pytest.raises(KeyError, match="FIELD_SPECS"):
+        pmesh._field_sharding(mesh, {}, "brand_new_plane", jnp.zeros((4, 4)))
+
+
+# ---------------------------------------------------------------------------
+# the audit fence (slow lane): every p2p entry censuses clean
+# ---------------------------------------------------------------------------
+
+P2P_ENTRIES = (
+    ("sharded_step", "dense"),
+    ("sharded_step@4", "dense"),
+    ("sharded_delta_step", "delta"),
+    ("run_sweep+shard", "dense"),
+    ("run_sweep+shard", "delta"),
+)
+
+
+@pytest.mark.slow
+@pytest.mark.allow_transfers
+@pytest.mark.parametrize("name,backend", P2P_ENTRIES)
+def test_p2p_entry_zero_member_gathers(name, backend):
+    """The tentpole's fence: every entry that declares ``p2p_only``
+    must hold ZERO member-plane all-gathers in its partitioned HLO,
+    and its audit board must be error-free (budgets pinned)."""
+    from ringpop_tpu.analysis.contracts import audit_entry
+    from ringpop_tpu.analysis.partitioning import collective_counts
+    from ringpop_tpu.analysis.registry import build_entry
+
+    assert build_entry(name, backend).p2p_only
+    r = audit_entry(name, backend)
+    cc = collective_counts(r.collectives)
+    assert cc.get("member-gather", 0) == 0, cc
+    errors = [f for f in r.findings if f.severity == "error"]
+    assert not errors, errors

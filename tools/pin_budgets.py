@@ -84,13 +84,21 @@ def pin_collectives(n: int, ticks: int) -> None:
 
     print(f"# COLLECTIVE_BUDGETS rows (sharded entries, n={n}):")
     reports, _ = audit_all(
-        names=("sharded_step", "sharded_step@4", "run_sweep+shard"),
+        names=("sharded_step", "sharded_step@4", "sharded_delta_step",
+               "sharded_step+gather", "run_sweep+shard"),
         n=n, ticks=ticks,
     )
     for r in reports:
         counts = collective_counts(r.collectives)
+        # a remote-copy (p2p_only) entry pins member-gather to ZERO by
+        # omission — a clean census has no member-gather key at all, so
+        # surface the count where the paste happens to make the zero an
+        # explicit claim rather than an absence
+        mg = counts.get("member-gather", 0)
+        note = (f"  # member-gather {mg} — NOT pasteable on a p2p_only entry"
+                if mg else "  # member-gather 0 (p2p clean)")
         print(f'    ("{r.entry}", "{r.backend}", {r.mesh_size}): '
-              f'{{"n": {r.n}, "counts": {counts}}},')
+              f'{{"n": {r.n}, "counts": {counts}}},{note}')
 
 
 def pin_bytes(n: int, ticks: int, flagship: bool) -> None:
