@@ -1,0 +1,413 @@
+#!/usr/bin/env python
+"""Sweep-driven Pareto tuner over the incident suite (BASELINE round 10).
+
+The compile-once knob plane (``run_sweep(param_axes=...)`` /
+``policy_axes=...``) turns protocol tuning from a recompile-per-point
+grid search into a handful of vmapped dispatches: every knob value is
+a traced int32/float scalar batched along the replica axis, so one
+compiled signature serves the whole grid.  This script runs the full
+incident x traffic x knob grid in FIVE dispatches (declared budget:
+``DISPATCH_BUDGET = 10``) and reports:
+
+* the Pareto frontier of detection latency vs false-faulty count vs
+  gossip bytes (proxy) vs serve p99 over a shared
+  ``suspicion_ticks x piggyback_factor`` grid, measured on the two
+  incidents that pull those objectives in opposite directions
+  (``thundering_rejoin`` wants fast detection and cheap mass rejoin;
+  ``brownout_loss_ramp`` punishes trigger-happy detectors with
+  false-faulty declarations — nothing there is actually down);
+* the auto-located flap/suspicion regime boundary on the PR 10 flap
+  storm (down=3/up=4): the suspicion_ticks value below which flapping
+  nodes stop evading declaration, found in one dispatch instead of
+  the hand-bisection BASELINE round 6 recorded;
+* the ping-req fanout curve (capacity-padded ``ping_req_size`` knob)
+  under the cross-rack-delay incident;
+* a tuned operating point for the admission policy's shed hysteresis
+  on the n=64 ``cascading_overload`` headline — the round-9 table
+  showed default admission over-shedding (goodput 0.392, 37k sheds).
+
+Each arm runs under its own ledger ``program_tag``, so the in-memory
+dispatch ledger proves the compile-once contract directly: the script
+asserts the dispatch count stays within ``DISPATCH_BUDGET`` and that
+the ledger holds ZERO ``recompile_cause`` rows.
+
+    JAX_PLATFORMS=cpu python benchmarks/tune.py
+    JAX_PLATFORMS=cpu python benchmarks/tune.py --micro   # CI smoke grid
+    JAX_PLATFORMS=cpu python benchmarks/tune.py --json /tmp/tune.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ringpop_tpu.models.cluster import SimCluster  # noqa: E402
+from ringpop_tpu.models.swim_sim import SwimParams  # noqa: E402
+from ringpop_tpu.obs import ledger as obs_ledger  # noqa: E402
+from ringpop_tpu.scenarios import library as lib  # noqa: E402
+from ringpop_tpu.scenarios import sweep as ssweep  # noqa: E402
+
+DISPATCH_BUDGET = 10  # hard ceiling; the planned grid uses 5
+SEED = 3  # the BASELINE pin seed
+
+# nominal wire weights for the gossip-bytes proxy: a probe/ack/ping-req
+# envelope (addr + incarnation + sequence) and one piggybacked change
+# entry.  Applied-change counters are the per-dispatch observable;
+# shipped entries scale with them at fixed loss, so the proxy preserves
+# the frontier ORDERING even though the absolute byte counts are
+# nominal.  A full sync ships the whole n-entry table.
+HDR_BYTES = 32
+CHANGE_BYTES = 24
+
+
+def wire_bytes_proxy(m: dict[str, np.ndarray], n: int) -> int:
+    """Gossip bytes shipped by one replica, from its [ticks] counters."""
+    msgs = m["pings_sent"].sum() + m["acks"].sum()
+    msgs += m.get("ping_reqs", np.zeros(1)).sum()
+    changes = m["ping_changes_applied"].sum() + m["ack_changes_applied"].sum()
+    changes += m.get("pingreq_changes_applied", np.zeros(1)).sum()
+    syncs = m.get("full_syncs", np.zeros(1)).sum() * n
+    return int(HDR_BYTES * msgs + CHANGE_BYTES * (changes + syncs))
+
+
+def pareto_front(rows: list[dict], keys: tuple[str, ...]) -> list[dict]:
+    """Non-dominated subset of ``rows`` minimizing every key at once."""
+    front = []
+    for a in rows:
+        dominated = any(
+            all(b[k] <= a[k] for k in keys)
+            and any(b[k] < a[k] for k in keys)
+            for b in rows
+        )
+        if not dominated:
+            front.append(a)
+    return front
+
+
+def knee_point(front: list[dict], keys: tuple[str, ...]) -> dict:
+    """The frontier point minimizing the normalized objective sum —
+    the single recommended operating point when no objective is
+    privileged."""
+    lo = {k: min(r[k] for r in front) for k in keys}
+    hi = {k: max(r[k] for r in front) for k in keys}
+
+    def score(r):
+        return sum(
+            (r[k] - lo[k]) / (hi[k] - lo[k]) if hi[k] > lo[k] else 0.0
+            for k in keys
+        )
+
+    return min(front, key=score)
+
+
+def _replica_metrics(tr, r: int) -> dict[str, np.ndarray]:
+    return {k: np.asarray(v[r]) for k, v in tr.metrics.items()}
+
+
+def _p99(tr, r: int) -> int:
+    rows = tr.serving_summary()
+    if rows is None:
+        return 0
+    return int(rows[r].get("lat_p99_ms", 0))
+
+
+# ---------------------------------------------------------------------------
+# the five arms
+# ---------------------------------------------------------------------------
+
+
+def arm_frontier(cfg) -> tuple[list[dict], dict]:
+    """Arms 1+2 (one dispatch each): the shared suspicion x piggyback
+    grid on thundering_rejoin (detect latency + bytes + p99) and
+    brownout_loss_ramp (false-faulty + p99), joined per grid index."""
+    grid = [(s, p) for s in cfg.suspicion for p in cfg.piggyback]
+    axes = {
+        "suspicion_ticks": [s for s, _ in grid],
+        "piggyback_factor": [p for _, p in grid],
+    }
+    r_count = len(grid)
+
+    spec_a, wl_a = lib.build_incident(
+        "thundering_rejoin", cfg.n, ticks=cfg.ticks
+    )
+    kill_at = min(e.at for e in spec_a.events if e.op == "kill")
+    c = SimCluster(cfg.n, SwimParams(), seed=SEED)
+    tr_a = c.run_sweep(
+        spec_a, r_count, traffic=wl_a, param_axes=axes,
+        program_tag="frontier-rejoin",
+    )
+
+    spec_b, wl_b = lib.build_incident(
+        "brownout_loss_ramp", cfg.n, ticks=cfg.ticks
+    )
+    c = SimCluster(cfg.n, SwimParams(), seed=SEED)
+    tr_b = c.run_sweep(
+        spec_b, r_count, traffic=wl_b, param_axes=axes,
+        program_tag="frontier-brownout",
+    )
+
+    det = tr_a.detect_ticks()
+    rows = []
+    for i, (s, p) in enumerate(grid):
+        m_a = _replica_metrics(tr_a, i)
+        m_b = _replica_metrics(tr_b, i)
+        rows.append({
+            "suspicion_ticks": s,
+            "piggyback_factor": p,
+            # detection latency after the mass kill; undetected grid
+            # points get a past-the-end penalty so they sort last
+            "detect_latency": (
+                int(det[i]) - kill_at if det[i] >= 0 else spec_a.ticks
+            ),
+            # brownout declares are ALL false-faulty: nothing is down
+            "false_faulty": int(m_b["faulty_declared"].sum()),
+            "gossip_kb": (
+                wire_bytes_proxy(m_a, cfg.n)
+                + wire_bytes_proxy(m_b, cfg.n)
+            ) // 1024,
+            "serve_p99_ms": max(_p99(tr_a, i), _p99(tr_b, i)),
+        })
+    objectives = (
+        "detect_latency", "false_faulty", "gossip_kb", "serve_p99_ms"
+    )
+    front = pareto_front(rows, objectives)
+    return rows, {
+        "objectives": objectives,
+        "front": front,
+        "knee": knee_point(front, objectives),
+        "kill_at": kill_at,
+    }
+
+
+def arm_boundary(cfg) -> dict:
+    """One dispatch: the PR 10 flap storm (down=3/up=4) with
+    suspicion_ticks swept along the replica axis — the regime boundary
+    is the smallest suspicion value whose flapping nodes evade
+    declaration for the whole run."""
+    n, ticks = cfg.boundary_n, cfg.boundary_ticks
+    spec = {
+        "ticks": ticks,
+        "events": [{
+            "at": 10, "op": "flap", "nodes": [n - 2, n - 3, n - 4],
+            "until": int(ticks * 0.6), "down": 3, "up": 4, "stagger": 2,
+        }],
+    }
+    c = SimCluster(n, SwimParams(), seed=SEED)
+    tr = c.run_sweep(
+        spec, len(cfg.boundary_suspicion),
+        param_axes={"suspicion_ticks": list(cfg.boundary_suspicion)},
+        program_tag="flap-boundary",
+    )
+    det = tr.detect_ticks()
+    detected = {
+        s: bool(det[i] >= 0) for i, s in enumerate(cfg.boundary_suspicion)
+    }
+    evading = [s for s, hit in detected.items() if not hit]
+    return {
+        "suspicion_axis": list(cfg.boundary_suspicion),
+        "detected": detected,
+        # None when every sweep point still declares (boundary above
+        # the axis) — the full axis tops out at the PR 10 pin of 12
+        "boundary": min(evading) if evading else None,
+        "hand_found": "suspicion 12 with down=3 never declares (round 6)",
+    }
+
+
+def arm_pingreq(cfg) -> list[dict]:
+    """One dispatch: effective ping-req fanout k swept 1..k_max under
+    the brownout loss ramp — the capacity-padded knob (compiled at
+    k_max, witnesses masked to the traced k).  Loss is what fires the
+    indirect-probe path, so this is the incident where fanout earns
+    its bytes: more witnesses, fewer false suspicions."""
+    spec, wl = lib.build_incident(
+        "brownout_loss_ramp", cfg.n, ticks=cfg.ticks
+    )
+    c = SimCluster(cfg.n, SwimParams(), seed=SEED)
+    tr = c.run_sweep(
+        spec, len(cfg.pingreq_axis), traffic=wl,
+        param_axes={"ping_req_size": list(cfg.pingreq_axis)},
+        program_tag="pingreq-fanout",
+    )
+    det = tr.detect_ticks()
+    rows = []
+    for i, k in enumerate(cfg.pingreq_axis):
+        m = _replica_metrics(tr, i)
+        row = {
+            "ping_req_size": k,
+            "detect_tick": int(det[i]),
+            "ping_reqs": int(m.get("ping_reqs", np.zeros(1)).sum()),
+            "false_faulty": int(m["faulty_declared"].sum()),
+            "serve_p99_ms": _p99(tr, i),
+        }
+        serving = tr.serving_summary()
+        if serving is not None:
+            row["gray_timeouts"] = int(serving[i].get("gray_timeouts", 0))
+        rows.append(row)
+    return rows
+
+
+def arm_admission(cfg) -> tuple[list[dict], dict]:
+    """One dispatch: the admission policy's shed hysteresis swept on
+    the n=64 cascading_overload headline.  Round 9 pinned the default
+    point (shed_hi = 2*base) over-shedding: goodput 0.392 vs the
+    quarantine arms' 1.000.  The sweep raises the latch threshold
+    until shedding stops eating deliverable traffic."""
+    spec, wl = lib.build_incident(
+        "cascading_overload", cfg.admission_n, ticks=cfg.admission_ticks
+    )
+    shed_hi = list(cfg.shed_hi_axis)
+    # keep the hysteresis width proportional: release at half the latch
+    shed_lo = [max(1, v // 2) for v in shed_hi]
+    c = SimCluster(cfg.admission_n, SwimParams(), seed=SEED)
+    tr = c.run_sweep(
+        spec, len(shed_hi), traffic=wl, policy="admission",
+        policy_axes={"shed_hi": shed_hi, "shed_lo": shed_lo},
+        program_tag="admission-shed",
+    )
+    serving = tr.serving_summary()
+    rows = []
+    for i, hi in enumerate(shed_hi):
+        s = serving[i]
+        rows.append({
+            "shed_hi": hi,
+            "shed_lo": shed_lo[i],
+            "goodput": round(s["goodput"], 3),
+            "amplification": round(s["amplification"], 2),
+            "shed": s.get("policy_shed", 0),
+            "gray_timeouts": s.get("gray_timeouts", 0),
+            "serve_p99_ms": s.get("lat_p99_ms", 0),
+        })
+    # recommended = best goodput among the points that keep the gray
+    # cascade fully closed (minimum gray timeouts) — raw max-goodput
+    # would buy a few points of goodput by letting the cascade leak
+    min_gray = min(r["gray_timeouts"] for r in rows)
+    best = max(
+        (r for r in rows if r["gray_timeouts"] == min_gray),
+        key=lambda r: r["goodput"],
+    )
+    return rows, best
+
+
+# ---------------------------------------------------------------------------
+# grid configuration (full vs --micro)
+# ---------------------------------------------------------------------------
+
+
+class Config:
+    def __init__(self, micro: bool):
+        if micro:
+            self.n = 16
+            self.ticks = 40
+            self.suspicion = [6, 12]
+            self.piggyback = [15]
+            self.boundary_n = 16
+            self.boundary_ticks = 40
+            self.boundary_suspicion = [2, 12]
+            self.pingreq_axis = [1, 3]
+            self.admission_n = 16
+            self.admission_ticks = 40
+            self.shed_hi_axis = [6, 24]
+        else:
+            self.n = 32
+            self.ticks = None  # incident defaults
+            self.suspicion = [6, 12, 25, 40]
+            self.piggyback = [6, 15]
+            # PR 10 flap-storm configuration (bench_faults, round 6)
+            self.boundary_n = 48
+            self.boundary_ticks = 80
+            self.boundary_suspicion = [1, 2, 3, 4, 6, 8, 10, 12]
+            self.pingreq_axis = [1, 2, 3]
+            self.admission_n = 64
+            self.admission_ticks = None
+            # default admission point for n=64 @ 512 keys/tick is
+            # base=12 -> shed_hi=24; sweep upward from there
+            self.shed_hi_axis = [24, 36, 48, 64, 96, 128, 192, 256]
+
+
+def _table(rows: list[dict]) -> str:
+    keys = list(rows[0])
+    lines = ["| " + " | ".join(keys) + " |",
+             "|" + "---|" * len(keys)]
+    for r in rows:
+        lines.append("| " + " | ".join(str(r[k]) for k in keys) + " |")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--micro", action="store_true",
+                    help="CI smoke grid: tiny n/ticks, 2-point axes")
+    ap.add_argument("--json", metavar="PATH",
+                    help="also dump the result object as JSON")
+    args = ap.parse_args()
+    cfg = Config(args.micro)
+
+    led = obs_ledger.default_ledger().enable(None)  # in-memory rows
+    d0 = ssweep.dispatch_count()
+    t0 = time.time()
+
+    out: dict = {"micro": args.micro, "dispatch_budget": DISPATCH_BUDGET}
+
+    grid_rows, frontier = arm_frontier(cfg)
+    out["grid"] = grid_rows
+    out["frontier"] = frontier
+    print(f"## Knob frontier ({len(grid_rows)} grid points, 2 dispatches)")
+    print(_table(grid_rows))
+    print(f"\nPareto frontier ({len(frontier['front'])} points) on "
+          f"{', '.join(frontier['objectives'])}; recommended knee:")
+    print(_table([frontier["knee"]]))
+
+    out["boundary"] = arm_boundary(cfg)
+    b = out["boundary"]
+    print("\n## Flap/suspicion regime boundary (1 dispatch)")
+    print(f"axis {b['suspicion_axis']} -> detected {b['detected']}")
+    print(f"auto-located boundary: suspicion_ticks >= "
+          f"{b['boundary'] if b['boundary'] is not None else '(above axis)'}"
+          f" evades; hand-found pin: {b['hand_found']}")
+
+    out["pingreq"] = arm_pingreq(cfg)
+    print("\n## Ping-req fanout (capacity-padded knob, 1 dispatch)")
+    print(_table(out["pingreq"]))
+
+    adm_rows, adm_best = arm_admission(cfg)
+    out["admission"] = {"rows": adm_rows, "recommended": adm_best}
+    print("\n## Admission shed hysteresis (1 dispatch)")
+    print(_table(adm_rows))
+    print("recommended operating point:")
+    print(_table([adm_best]))
+
+    # -- the compile-once contract, asserted ---------------------------------
+    dispatches = ssweep.dispatch_count() - d0
+    recompiles = [r for r in led.rows if r.get("recompile_cause")]
+    out["dispatches"] = dispatches
+    out["recompile_rows"] = len(recompiles)
+    print(f"\ndispatches: {dispatches} (budget {DISPATCH_BUDGET}), "
+          f"recompile rows: {len(recompiles)}, "
+          f"wall: {time.time() - t0:.0f}s")
+    if dispatches > DISPATCH_BUDGET:
+        raise SystemExit(
+            f"dispatch budget blown: {dispatches} > {DISPATCH_BUDGET}"
+        )
+    if recompiles:
+        raise SystemExit(
+            "recompile_cause rows in the ledger: "
+            + json.dumps(recompiles[:3], default=str)
+        )
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(out, fh, indent=1, default=str)
+        print(f"wrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,11 @@ CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
                                        "uint32": 7},
     ("run_sweep", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
     ("run_sweep", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
+    # the knob-grid sweep carries EXACTLY the run_sweep rows: the traced
+    # protocol knobs (sim.SwimKnobs) close over the scan body as
+    # constants — a knob leaking into the carry would surface here
+    ("run_sweep+param_axes", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
+    ("run_sweep+param_axes", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
     ("recv_merge_pallas", "dense"): {"int32": 2},
     # the fused delta insert-merge kernel is scan-free: its merge
     # inversion is pure VPU arithmetic (compare-reduces + lane rolls),

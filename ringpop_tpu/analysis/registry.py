@@ -440,6 +440,34 @@ def _build_sweep(backend: str, *, n: int, ticks: int, capacity: int,
     )
 
 
+def _build_param_sweep(backend: str, *, n: int, ticks: int, capacity: int,
+                       replicas: int) -> Built:
+    """``run_sweep(param_axes=...)``'s program: the vmapped sweep scan
+    with the traced protocol knobs batched [R] (``sim.SwimKnobs`` — a
+    suspicion_ticks axis here, every other knob broadcast from the
+    fixture params).  One extra leading-replica-axis operand on the
+    same scan: the knob grid must change NEITHER the carry multiset
+    (knobs close over the body as scan constants) nor any other pinned
+    contract of the plain run_sweep entry."""
+    from ringpop_tpu.scenarios import sweep as ssweep
+
+    base = _build_sweep(backend, n=n, ticks=ticks, capacity=capacity,
+                        replicas=replicas)
+    sw_knobs = ssweep.param_knob_axes(
+        base.statics["params"],
+        {"suspicion_ticks": [3 + 2 * r for r in range(replicas)]},
+        replicas, n=n, backend=backend, period_active=False, damping=False,
+    )
+    # positional tail of _sweep_scan_impl up to sw_knobs:
+    # tick0, faults, tr_tensors, ov, po, po_knobs
+    args = base.args + (None, None, None, None, None, None, sw_knobs)
+    return base._replace(
+        name="run_sweep+param_axes",
+        args=args,
+        key_roots={"protocol": tree_flat_index_of(args, args[11])},
+    )
+
+
 def _build_recv_merge(backend: str, *, n: int, **_ignored) -> Built:
     """The Pallas receiver-merge kernel's host-level jit wrapper
     (interpret mode — the Mosaic kernel itself needs a TPU to compile,
@@ -680,6 +708,11 @@ ENTRY_POINTS: dict[str, EntrySpec] = {
     "run_sweep": EntrySpec(
         "run_sweep", ("dense", "delta"), _build_sweep,
         "the vmapped R-replica sweep scan (scenarios/sweep.py)"),
+    "run_sweep+param_axes": EntrySpec(
+        "run_sweep+param_axes", ("dense", "delta"), _build_param_sweep,
+        "run_sweep with the traced protocol knobs batched [R] "
+        "(sim.SwimKnobs: a suspicion_ticks axis) — the compile-once "
+        "knob-grid program (scenarios/sweep.py param_knob_axes)"),
     "recv_merge_pallas": EntrySpec(
         "recv_merge_pallas", ("dense",), _build_recv_merge,
         "the Pallas receiver-merge kernel wrapper "

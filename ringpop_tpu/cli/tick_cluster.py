@@ -551,6 +551,7 @@ class TpuSimCluster(ClusterDriver):
         sweep_loss_scales: list[float] | None = None,
         sweep_kill_jitter: list[int] | None = None,
         sweep_flap_jitter: list[int] | None = None,
+        sweep_param_axes: dict[str, list[float | int]] | None = None,
         traffic: str | None = None,
         latency_buckets: int = 0,
         segment_ticks: int | None = None,
@@ -611,7 +612,7 @@ class TpuSimCluster(ClusterDriver):
                 spec, trace_out, sweep, sweep_loss_scales, sweep_kill_jitter,
                 flap_jitter=sweep_flap_jitter, traffic=traffic,
                 segment_ticks=segment_ticks, segment_store=segment_store,
-                policy=policy,
+                policy=policy, param_axes=sweep_param_axes,
             )
             return
         control = None
@@ -725,14 +726,14 @@ class TpuSimCluster(ClusterDriver):
 
     def _run_sweep(self, spec, trace_out, replicas, loss_scales, kill_jitter,
                    flap_jitter=None, traffic=None, segment_ticks=None,
-                   segment_store=None, policy=None):
+                   segment_store=None, policy=None, param_axes=None):
         t0 = time.perf_counter()
         strace = self.cluster.run_sweep(
             spec, replicas,
             loss_scales=loss_scales, kill_jitter=kill_jitter,
             flap_jitter=flap_jitter, traffic=traffic,
             segment_ticks=segment_ticks, store=segment_store,
-            policy=policy,
+            policy=policy, param_axes=param_axes,
         )
         wall_ms = (time.perf_counter() - t0) * 1000
         summary = strace.summary()
@@ -971,6 +972,15 @@ def add_args(parser: argparse.ArgumentParser) -> None:
                              "windows (at AND until move together, so "
                              "every replica keeps the same duty cycle at "
                              "a different storm phase)")
+    parser.add_argument("--sweep-param-axes", default=None,
+                        metavar="K=V,V,..;K=V,..",
+                        help="with --sweep: semicolon list of traced "
+                             "protocol knob axes, each a comma list of R "
+                             "per-replica values (e.g. "
+                             "suspicion_ticks=6,12,25) — one compiled "
+                             "program serves the whole knob grid "
+                             "(docs/simulation.md, 'Traced protocol "
+                             "knobs')")
     parser.add_argument("--stats-out", default=None, metavar="SPEC",
                         help="tpu-sim: stream protocol stats under "
                              "reference statsd keys (obs/bridge.py key "
@@ -1106,13 +1116,26 @@ def main(argv: list[str] | None = None) -> None:
                      "(the obs bridge and profiler scopes instrument the "
                      "tensor simulation; proc nodes inject a statsd "
                      "emitter via RingPop(statsd=...))")
-    sweep_scales = sweep_jitter = sweep_fjitter = None
+    sweep_scales = sweep_jitter = sweep_fjitter = sweep_paxes = None
     if args.sweep_loss_scales is not None:
         sweep_scales = [float(x) for x in args.sweep_loss_scales.split(",")]
     if args.sweep_kill_jitter is not None:
         sweep_jitter = [int(x) for x in args.sweep_kill_jitter.split(",")]
     if args.sweep_flap_jitter is not None:
         sweep_fjitter = [int(x) for x in args.sweep_flap_jitter.split(",")]
+    if args.sweep_param_axes is not None:
+        # knob names and per-replica counts are validated host-side by
+        # the sweep (before any key draw), with loud errors there —
+        # the CLI only splits the grid syntax
+        sweep_paxes = {}
+        for part in args.sweep_param_axes.split(";"):
+            name, sep, vals = part.partition("=")
+            if not sep or not vals:
+                parser.error("--sweep-param-axes entries look like "
+                             "knob=v1,v2,... (semicolon-separated)")
+            sweep_paxes[name.strip()] = [
+                float(x) if "." in x else int(x) for x in vals.split(",")
+            ]
     if backend == "host-sim":
         driver: ClusterDriver = SimCluster(args.size, args.base_port,
                                            seed=args.seed)
@@ -1143,6 +1166,7 @@ def main(argv: list[str] | None = None) -> None:
                     sweep_loss_scales=sweep_scales,
                     sweep_kill_jitter=sweep_jitter,
                     sweep_flap_jitter=sweep_fjitter,
+                    sweep_param_axes=sweep_paxes,
                     traffic=args.traffic,
                     latency_buckets=args.latency_buckets,
                     segment_ticks=args.segment_ticks,

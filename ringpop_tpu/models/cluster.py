@@ -216,6 +216,7 @@ class SimCluster:
         assemble: bool = True,
         pipeline: bool = True,
         policy: Any | None = None,
+        param_knobs: dict[str, float | int] | None = None,
     ) -> Any:
         """Run a declarative fault timeline as ONE jitted call.
 
@@ -254,6 +255,14 @@ class SimCluster:
         Requires ``traffic``; the policy's per-tick fold rides the same
         scan carry as the overload feedback loop, and its final state
         persists on ``self.net.po_*`` (``clear_policy()`` drops it).
+
+        ``param_knobs`` overrides traced PROTOCOL knobs for this run
+        (``{"suspicion_ticks": 9, "piggyback_factor": 2, ...}`` — the
+        ``sim.SwimKnobs`` names): same compiled program as the
+        defaults, different scalar operands, so a knob change never
+        recompiles.  Values are host-validated against the backend and
+        scenario (``runner.validate_param_knobs``).  Not available
+        streamed (``segment_ticks``).
         """
         from ringpop_tpu.scenarios import compile as scompile
         from ringpop_tpu.scenarios import runner as srunner
@@ -261,6 +270,11 @@ class SimCluster:
         from ringpop_tpu.scenarios.trace import Trace
 
         if segment_ticks is not None:
+            if param_knobs is not None:
+                raise ValueError(
+                    "param_knobs is not wired through the streamed "
+                    "runner yet; run unsegmented (drop segment_ticks)"
+                )
             from ringpop_tpu.scenarios import stream as sstream
 
             return sstream.run_streamed(
@@ -304,11 +318,24 @@ class SimCluster:
                 policy, n=self.n, m=traffic.static.m
             )
         srunner.precheck_policy(policy, traffic, self.net)
+        if param_knobs is not None:
+            # knob validation is a static rejection too: it must fire
+            # before the key draw (same no-desync contract as precheck)
+            srunner.validate_param_knobs(
+                self.n,
+                params.swim if self.backend == "delta" else params,
+                {k: [v] for k, v in param_knobs.items()},
+                backend=self.backend,
+                period_active=(self.net.period is not None
+                               or compiled.has_gray
+                               or compiled.overload is not None),
+                damping=getattr(self.state, "damp", None) is not None,
+            )
         keys = scompile.key_schedule(self._split, compiled)
         start_tick = int(self.state.tick)
         self.state, self.net, ys = srunner.run_compiled(
             self.state, self.net, keys, compiled, params, traffic=traffic,
-            adj=adj, policy=policy,
+            adj=adj, policy=policy, param_knobs=param_knobs,
         )
         self.set_loss(float(compiled.loss[-1]))  # host mirror of the schedule
         stacks = {k: np.asarray(v) for k, v in ys.items()}
@@ -376,6 +403,8 @@ class SimCluster:
         pipeline: bool = True,
         policy: Any | None = None,
         policy_axes: dict[str, Any] | None = None,
+        param_axes: dict[str, Any] | None = None,
+        program_tag: str | None = None,
     ) -> Any:
         """Run R replicas of a scenario as ONE vmapped jitted call.
 
@@ -419,12 +448,29 @@ class SimCluster:
         batch axes, so the whole knob grid shares one compiled program,
         and replica r stays bit-identical to a standalone
         ``run_scenario(policy=sweep.replica_policy(...))``.
+
+        ``param_axes`` sweeps traced PROTOCOL knobs the same way:
+        ``{"suspicion_ticks": [3, 6, 9, 12]}`` gives replica r the r-th
+        value (``sim.SwimKnobs`` names — suspicion timeout, piggyback
+        factor, ping-req fanout, phase_mod, relay_full_sync, damp
+        thresholds), one compiled program for the whole knob grid, and
+        replica r bit-identical to a standalone ``run_scenario(
+        param_knobs=sweep.replica_param_knobs(param_axes, r))``.
+        Composes with every other axis (and ``policy_axes``) in the
+        same dispatch.  ``program_tag`` names this dispatch's ledger
+        program ``run_sweep:<tag>`` so a multi-arm tuner's shape-
+        distinct arms don't read as recompiles of one another.
         """
         from ringpop_tpu.scenarios import runner as srunner
         from ringpop_tpu.scenarios import sweep as ssweep
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
         if segment_ticks is not None:
+            if param_axes:
+                raise ValueError(
+                    "param_axes is not wired through the streamed "
+                    "sweep yet; run unsegmented (drop segment_ticks)"
+                )
             from ringpop_tpu.scenarios import stream as sstream
 
             return sstream.run_sweep_streamed(
@@ -477,11 +523,24 @@ class SimCluster:
         srunner.precheck_policy(policy, traffic, self.net)
         if shard:
             ssweep.precheck_shard(replicas)
+        if param_axes:
+            # static rejection before the R key draws (no-desync
+            # contract): shape + range + composition checks; the device
+            # arrays this builds are rebuilt inside run_sweep_compiled
+            ssweep.param_knob_axes(
+                params, param_axes, replicas, n=self.n,
+                backend=self.backend,
+                period_active=(self.net.period is not None
+                               or cs.base.has_gray
+                               or cs.base.overload is not None),
+                damping=getattr(self.state, "damp", None) is not None,
+            )
         replica_keys = [self._split() for _ in range(replicas)]
         keys = ssweep.sweep_key_schedule(replica_keys, cs)
         states, nets, ys = ssweep.run_sweep_compiled(
             self.state, self.net, keys, cs, params, shard=shard,
             traffic=traffic, policy=policy, policy_axes=policy_axes,
+            param_axes=param_axes, program_tag=program_tag,
         )
         stacks = {k: np.asarray(v) for k, v in ys.items()}
         trace = ssweep.SweepTrace(

@@ -112,6 +112,7 @@ from ringpop_tpu.models.swim_sim import (
     SUSPECT,
     ClusterState,
     NetState,
+    SwimKnobs,
     SwimParams,
     _adj,
     _apply_mask,
@@ -790,6 +791,7 @@ def _selection(
     net: NetState,
     k_sel: jax.Array,
     params: DeltaParams,
+    knobs: SwimKnobs | None = None,
 ):
     """Probe target + witnesses, RNG-identical to the dense phase 1
     (same _distinct_ranks stream, same rank -> subject mapping).
@@ -895,6 +897,14 @@ def _selection(
     has_target = valid[:, 0]
     wit = picks[:, 1:]
     wit_valid = valid[:, 1:]
+    phase_mod = sw.phase_mod if knobs is None else knobs.phase_mod
+    if knobs is not None:
+        # capacity-padded effective k (the dense _phase01_select mask):
+        # draws stay at the static k_max shape, masked tail slots fall
+        # out of every phase-5 delivery column
+        wit_valid = wit_valid & (
+            jnp.arange(k, dtype=jnp.int32)[None, :] < knobs.ping_req_size
+        )
 
     # staggered protocol periods (the swim_sim phase-1 port, VERDICT
     # item 4): static phase_mod = P gates probe initiation to one
@@ -913,7 +923,7 @@ def _selection(
         while math.gcd(mult, n) != 1:
             mult += 1
         start = (ids * jnp.int32(mult)) % jnp.int32(n)
-        div = _sweep_divisor(sw.phase_mod, per)
+        div = _sweep_divisor(phase_mod, per)
         if div is not None:
             swept = (start + state.tick // div) % jnp.int32(n)
         else:
@@ -930,7 +940,7 @@ def _selection(
         raise ValueError(f"unknown probe policy: {sw.probe!r}")
 
     sends = _stagger_send_gate(
-        gossiping & has_target, state.tick, n, sw.phase_mod, per
+        gossiping & has_target, state.tick, n, phase_mod, per
     )
     t_safe = jnp.where(sends, target, 0)
     return gossiping, sends, t_safe, wit, wit_valid
@@ -954,7 +964,7 @@ def _merge_claims(
     c_subj: jax.Array,  # int32[N, K] subject per claim, ascending per row, SENTINEL pad
     c_key: jax.Array,  # int32[N, K] claim lattice keys (pre-deduped per subject)
     valid: jax.Array,  # bool[N, K]
-    sl_start: int,
+    sl_start: int | jax.Array,
 ) -> _MergeOut:
     """Apply per-row claim lists (the sparse _merge_incoming).
 
@@ -1431,7 +1441,7 @@ def _stage_issue_delta(
 
 def delta_step_impl(
     state: DeltaState, net: NetState, key: jax.Array, params: DeltaParams,
-    upto: int = 7,
+    upto: int = 7, knobs: SwimKnobs | None = None,
 ) -> tuple[DeltaState, dict[str, jax.Array]]:
     """One synchronized protocol period — the dense ``swim_step_impl``
     phase for phase (see its docstring for the reference parity map),
@@ -1485,10 +1495,22 @@ def delta_step_impl(
             "per-node periods (NetState.period) do not compose with the "
             "static phase_mod stagger: a row of P subsumes phase_mod=P"
         )
+    if knobs is not None and _MERGE_METHOD == "pallas":
+        raise ValueError(
+            "RINGPOP_DELTA_MERGE=pallas bakes the suspicion countdown "
+            "into the fused merge kernel as a compile-time constant; "
+            "traced knobs (SwimKnobs) need the sorted merge lowering"
+        )
     n = state.n
     w = params.wire_cap
     ids = jnp.arange(n, dtype=jnp.int32)
-    sl_start = _validate_params(n, sw)
+    sl_start: int | jax.Array = _validate_params(n, sw)
+    if knobs is not None:
+        # traced countdown start (the dense convention); the delta
+        # backend has no damping plane and rejects relay_full_sync, so
+        # those knobs are host-pinned to their defaults upstream
+        # (scenarios/sweep.py param_knob_axes validates per backend)
+        sl_start = knobs.suspicion_ticks + jnp.int32(1)
     has_delay = state.pend_subj is not None
     if has_delay:
         # the two extra streams draw per-message jitter; split width is
@@ -1549,12 +1571,13 @@ def delta_step_impl(
 
     # -- phases 0-1 ---------------------------------------------------------
     stats = _phase0_stats(state)
-    maxpb = _max_piggyback_1d(stats.server_count, sw.piggyback_factor).astype(jnp.int8)
+    pb_factor = sw.piggyback_factor if knobs is None else knobs.piggyback_factor
+    maxpb = _max_piggyback_1d(stats.server_count, pb_factor).astype(jnp.int8)
     h_pre = stats.digest
     if upto <= 0:
         return cut(state, _t=stats.digest.astype(jnp.int32) + maxpb.astype(jnp.int32))
     gossiping, sends, t_safe, wit, wit_valid = _selection(
-        state, stats, net, k_sel, params
+        state, stats, net, k_sel, params, knobs
     )
     if upto <= 1:
         return cut(state, _t=t_safe + wit[:, 0] + stats.digest.astype(jnp.int32))
@@ -2331,11 +2354,14 @@ def delta_run_impl(
     key: jax.Array,
     params: DeltaParams,
     ticks: int,
+    knobs: SwimKnobs | None = None,
 ) -> tuple[DeltaState, dict[str, jax.Array]]:
-    """``ticks`` periods under lax.scan (one compiled program)."""
+    """``ticks`` periods under lax.scan (one compiled program).  Traced
+    knobs ride as scan constants, not carry entries (the dense
+    swim_run_impl convention — CARRY_BUDGETS stays knob-invariant)."""
 
     def body(st, subkey):
-        return delta_step_impl(st, net, subkey, params)
+        return delta_step_impl(st, net, subkey, params, knobs=knobs)
 
     keys = jax.random.split(key, ticks)
     state, ms = jax.lax.scan(body, state, keys)

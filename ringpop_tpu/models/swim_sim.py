@@ -194,6 +194,126 @@ class SwimParams(NamedTuple):
     phase_mod: int = 1
 
 
+class SwimKnobs(NamedTuple):
+    """Traced protocol knobs — the value-like ``SwimParams`` fields as
+    device scalars, so a knob change (or a whole per-replica knob grid,
+    ``run_sweep(param_axes=...)``) reuses ONE compiled program instead
+    of forcing a recompile per point (the dispatch ledger's
+    ``recompile_cause`` names exactly these statics).  The policy plane
+    established the idiom (policies/core.py PolicyKnobs): every field is
+    a 0-d array here and an [R] axis-0 batch under the vmapped sweep.
+
+    Traced-vs-static split (docs/simulation.md has the full matrix):
+
+    * Value knobs trace directly: ``suspicion_ticks``,
+      ``piggyback_factor``, ``phase_mod`` (the gossip-cadence divisor),
+      ``relay_full_sync`` (a 0/1 scalar masking the always-built 5c
+      full-sync machinery — the damping/quarantine masked-mechanism
+      precedent), and the damp knobs.
+    * ``ping_req_size`` is shape-bearing, so it capacity-pads: the
+      program compiles at the static ``SwimParams.ping_req_size``
+      (= k_max, fixing every PRNG draw shape) and the traced effective
+      k masks witness slots ``>= k``.  Bit-parity with the legacy
+      program is therefore pinned at effective k == capacity.
+    * ``period_ms`` stays compile-time: it never enters the protocol
+      step — it is the tick -> wall-clock scale the traffic plane's
+      host-side backoff quantization consumes (traffic/latency.py).
+
+    Dtypes follow each knob's legacy consumption site: the damp
+    hysteresis thresholds compare against the float16 damp plane under
+    weak scalar promotion, so they ride as float16 (a float32 knob
+    would promote the compare and break bit-parity); decay/penalty feed
+    float32 arithmetic.  ``knobs=None`` everywhere compiles the exact
+    legacy program — the None path changes nothing.
+    """
+
+    suspicion_ticks: Any  # int32[] — countdown start is this + 1
+    piggyback_factor: Any  # int32[]
+    phase_mod: Any  # int32[] — stagger divisor (1 = lockstep)
+    relay_full_sync: Any  # int32[] 0/1 — dense-only mechanism gate
+    ping_req_size: Any  # int32[] — effective k <= static capacity
+    damp_penalty: Any  # float32[]
+    damp_decay_per_tick: Any  # float32[]
+    damp_suppress: Any  # float16[] — compared against the f16 damp plane
+    damp_reuse: Any  # float16[]
+
+
+# knob name -> target dtype (shared with scenarios/sweep.py's
+# param_knob_axes, which builds the [R]-batched form of the same tuple)
+SWIM_KNOB_DTYPES = {
+    "suspicion_ticks": jnp.int32,
+    "piggyback_factor": jnp.int32,
+    "phase_mod": jnp.int32,
+    "relay_full_sync": jnp.int32,
+    "ping_req_size": jnp.int32,
+    "damp_penalty": jnp.float32,
+    "damp_decay_per_tick": jnp.float32,
+    "damp_suppress": jnp.float16,
+    "damp_reuse": jnp.float16,
+}
+
+
+def swim_knob_values(params: SwimParams) -> dict[str, float | int]:
+    """Host-side knob values implied by ``params`` (the defaults every
+    un-swept knob pins to, so traced and legacy programs agree)."""
+    return {
+        "suspicion_ticks": int(params.suspicion_ticks),
+        "piggyback_factor": int(params.piggyback_factor),
+        "phase_mod": int(params.phase_mod),
+        "relay_full_sync": int(bool(params.relay_full_sync)),
+        "ping_req_size": int(params.ping_req_size),
+        "damp_penalty": float(params.damp_penalty),
+        "damp_decay_per_tick": float(params.damp_decay_per_tick),
+        "damp_suppress": float(params.damp_suppress),
+        "damp_reuse": float(params.damp_reuse),
+    }
+
+
+def check_knob_value(name: str, v: float | int, params: SwimParams) -> None:
+    """Host-side range guard for one traced-knob value (the digit-budget
+    check additionally needs ``n`` — ``_validate_params`` owns it)."""
+    if name == "suspicion_ticks" and not 0 <= int(v) <= 126:
+        raise ValueError(
+            f"suspicion_ticks knob {v} outside the int8 countdown "
+            "range [0, 126]"
+        )
+    if name == "ping_req_size" and not 1 <= int(v) <= int(params.ping_req_size):
+        raise ValueError(
+            f"ping_req_size knob {v} outside the compiled capacity "
+            f"[1, {params.ping_req_size}] (capacity-padded knob: raise "
+            "SwimParams.ping_req_size to widen the compiled k_max)"
+        )
+    if name == "phase_mod" and int(v) < 1:
+        raise ValueError(f"phase_mod knob must be >= 1, got {v}")
+    if name == "relay_full_sync" and int(v) not in (0, 1):
+        raise ValueError(f"relay_full_sync knob is 0/1, got {v}")
+    if name == "piggyback_factor" and int(v) < 0:
+        raise ValueError(f"piggyback_factor knob must be >= 0, got {v}")
+
+
+def swim_knob_arrays(
+    params: SwimParams, overrides: dict[str, float | int] | None = None
+) -> SwimKnobs:
+    """Device-ify the traced knobs (0-d scalars) for one run.
+
+    ``overrides`` replaces individual knob values (host numbers) before
+    the cast; unknown names and out-of-range values fail loudly here,
+    on the host, before any trace sees them."""
+    vals = swim_knob_values(params)
+    if overrides:
+        bad = sorted(set(overrides) - set(vals))
+        if bad:
+            raise ValueError(
+                f"unknown traced swim knob(s) {bad}; valid: {sorted(vals)}"
+            )
+        for k, v in overrides.items():
+            check_knob_value(k, v, params)
+            vals[k] = v
+    return SwimKnobs(
+        **{k: jnp.asarray(v, SWIM_KNOB_DTYPES[k]) for k, v in vals.items()}
+    )
+
+
 class ClusterState(NamedTuple):
     """Per-(viewer i, subject j) membership views + dissemination buffers.
 
@@ -646,21 +766,32 @@ def _message_delay(
     return base + extra
 
 
-def _sweep_divisor(phase_mod: int, per: jax.Array | None) -> jax.Array | None:
+def _sweep_divisor(
+    phase_mod: int | jax.Array, per: jax.Array | None
+) -> jax.Array | None:
     """Per-node sweep-advance divisor for staggered protocol periods,
     or None for the literal lockstep path.  ONE definition shared by
     both backends' selections: the bit-for-bit phase_mod-subsumption
     contract (a period row of P == phase_mod=P, VERDICT item 4) rests
-    on the dense and delta arms staying value-identical."""
+    on the dense and delta arms staying value-identical.
+
+    A TRACED phase_mod (the knob plane) always takes the divide path:
+    ``max(pm, 1)`` at pm=1 divides by (traced) one — value-identical to
+    the lockstep expression, so the traced program pins bit-equal
+    outputs against the legacy compile-time one.  Scenarios with a
+    per-node period tensor keep it (the period row subsumes the
+    stagger); host-side validation pins the traced knob to 1 there."""
     if per is not None:
         return per
+    if isinstance(phase_mod, jax.Array):
+        return jnp.maximum(phase_mod, jnp.int32(1))
     if phase_mod > 1:
         return jnp.int32(phase_mod)
     return None
 
 
 def _stagger_send_gate(
-    sends: jax.Array, tick: jax.Array, n: int, phase_mod: int,
+    sends: jax.Array, tick: jax.Array, n: int, phase_mod: int | jax.Array,
     per: jax.Array | None,
 ) -> jax.Array:
     """Probe-initiation gate for staggered periods (both backends):
@@ -691,7 +822,7 @@ def _merge_incoming(
     state: ClusterState,
     in_key: jax.Array,  # int32[N, N]: claim about j arriving at receiver r (0 = none)
     active: jax.Array,  # bool[N]: receiver r processes input this tick
-    sl_start: int,  # suspicion countdown start value (ticks + 1)
+    sl_start: int | jax.Array,  # suspicion countdown start value (ticks + 1)
 ) -> _Merge:
     """Apply one batch of incoming changes at every receiver.
 
@@ -780,7 +911,7 @@ def _declare(
     viewer_mask: jax.Array,  # bool[N]
     subject: jax.Array,  # int32[N] (index per viewer; clipped where invalid)
     new_status: int,
-    sl_start: int,
+    sl_start: int | jax.Array,
 ) -> tuple[ClusterState, jax.Array]:
     """Local declaration (makeSuspect / makeFaulty, membership.js:141-156):
     viewer i re-labels ``subject[i]`` with its currently-known incarnation,
@@ -822,28 +953,59 @@ class _Selection(NamedTuple):
     h_pre: jax.Array  # uint32[N]
 
 
-def _validate_params(n: int, params: SwimParams) -> int:
-    """Static int8-range guards; returns the suspicion countdown start."""
-    if params.suspicion_ticks > 126:
-        raise ValueError(
-            f"suspicion_ticks={params.suspicion_ticks} exceeds the int8 "
-            "countdown range (max 126); raise period_ms instead"
-        )
+def _validate_params(
+    n: int,
+    params: SwimParams,
+    knob_values: dict[str, Any] | None = None,
+) -> int:
+    """Host-side int8-range guards; returns the suspicion countdown start.
+
+    ``knob_values`` maps a traced-knob name to every host value it will
+    take — a one-element list for a single traced run, the full sweep
+    axis for ``run_sweep(param_axes=...)``.  The int8 budgets must hold
+    at the axis MAXIMUM, not at the ``params`` default the trace-entry
+    call sees (the scalar default is all this function ever checked
+    before the knob plane), so each axis value is checked individually
+    and the error names the offending one."""
+    sus_vals = [(int(params.suspicion_ticks), None)]
+    fac_vals = [(int(params.piggyback_factor), None)]
+    if knob_values:
+        if "suspicion_ticks" in knob_values:
+            sus_vals = [(int(v), i) for i, v in
+                        enumerate(knob_values["suspicion_ticks"])]
+        if "piggyback_factor" in knob_values:
+            fac_vals = [(int(v), i) for i, v in
+                        enumerate(knob_values["piggyback_factor"])]
+
+    def _where(i):
+        return "" if i is None else f" (param_axes replica {i})"
+
+    for v, i in sus_vals:
+        if v > 126:
+            raise ValueError(
+                f"suspicion_ticks={v}{_where(i)} exceeds the int8 "
+                "countdown range (max 126); raise period_ms instead"
+            )
     # _max_piggyback's digit count maxes at len(str(n)): x = count+1 <= n+1
     # and the strict '>' comparisons give ceil(log10(x)) = len(str(x-1)).
     max_digits = len(str(n))
-    if params.piggyback_factor * max_digits > 126:
-        raise ValueError(
-            f"piggyback_factor={params.piggyback_factor} can exceed the "
-            f"int8 piggyback budget at n={n} "
-            f"(factor * {max_digits} digits > 126)"
-        )
+    for v, i in fac_vals:
+        if v * max_digits > 126:
+            raise ValueError(
+                f"piggyback_factor={v}{_where(i)} can exceed the "
+                f"int8 piggyback budget at n={n} "
+                f"(factor * {max_digits} digits > 126)"
+            )
     return int(params.suspicion_ticks) + 1
 
 
 @annotate.scoped("swim.phase01_select")
 def _phase01_select(
-    state: ClusterState, net: NetState, k_sel: jax.Array, params: SwimParams
+    state: ClusterState,
+    net: NetState,
+    k_sel: jax.Array,
+    params: SwimParams,
+    knobs: SwimKnobs | None = None,
 ) -> _Selection:
     """Phase 0 (derived views) + phase 1 (probe targets and witnesses)."""
     n = state.n
@@ -851,7 +1013,11 @@ def _phase01_select(
     status = state.view_key & 7
     status_ok = (status == ALIVE) | (status == SUSPECT)
     pingable = status_ok & ~eye
-    maxpb = _max_piggyback(status_ok, params.piggyback_factor)
+    pb_factor = (
+        params.piggyback_factor if knobs is None else knobs.piggyback_factor
+    )
+    phase_mod = params.phase_mod if knobs is None else knobs.phase_mod
+    maxpb = _max_piggyback(status_ok, pb_factor)
     h_pre = _view_hash(state)
 
     own_status = _diag(status)
@@ -868,6 +1034,16 @@ def _phase01_select(
     target, has_target, wit, wit_valid = _choose_targets_and_witnesses(
         pingable, params.ping_req_size, k_sel
     )
+    if knobs is not None:
+        # capacity-padding: the selection (and every phase-5 PRNG draw)
+        # runs at the static k_max; the traced effective k masks the
+        # tail witness slots out of every downstream delivery column —
+        # at k == k_max the mask is all-True and the program is
+        # value-identical to the legacy one.
+        wit_valid = wit_valid & (
+            jnp.arange(params.ping_req_size, dtype=jnp.int32)[None, :]
+            < knobs.ping_req_size
+        )
     if params.probe == "sweep":
         # Deterministic rotation restores the reference iterator's
         # probe-every-member-per-round guarantee; the rank-picked target
@@ -897,7 +1073,7 @@ def _phase01_select(
         # step always divides (P=1 divides by 1, the historical
         # program); the delta selection keeps its literal lockstep
         # expression at div=None — both via the shared _sweep_divisor.
-        div = _sweep_divisor(params.phase_mod, per)
+        div = _sweep_divisor(phase_mod, per)
         swept = (
             start + state.tick // (div if div is not None else jnp.int32(1))
         ) % jnp.int32(n)
@@ -917,7 +1093,7 @@ def _phase01_select(
         (target, has_target, wit, wit_valid)
     )
     sends = _stagger_send_gate(
-        gossiping & has_target, state.tick, n, params.phase_mod, per
+        gossiping & has_target, state.tick, n, phase_mod, per
     )
     t_safe = jnp.where(sends, target, 0)
     return _Selection(
@@ -962,8 +1138,9 @@ def _phase5_pingreq(
     k_loss3: jax.Array,
     sel: _Selection,
     ack: jax.Array,
-    sl_start: int,
+    sl_start: int | jax.Array,
     params: SwimParams,
+    knobs: SwimKnobs | None = None,
 ) -> _PingReq:
     """Phase 5: failed probes -> ping-req relay with the full piggyback
     exchange -> suspect (ping-req-sender.js, ping-req-handler.js).
@@ -1041,6 +1218,15 @@ def _phase5_pingreq(
     maxpb8 = sel.maxpb8
     kk = params.ping_req_size
     damp_on = state.damp is not None
+    # Traced relay_full_sync (the masked-mechanism form): the 5c
+    # full-sync machinery is always BUILT when knobs ride along, and a
+    # 0/1 scalar masks its slots — fs_slots all-False at 0 reproduces
+    # the legacy off program's values, fs_slots unmasked at 1 the
+    # legacy on program's (no PRNG lives in the machinery, so the two
+    # pin bit-identical either way).
+    rfs_knob = None if knobs is None else knobs.relay_full_sync
+    rfs_on = None if rfs_knob is None else rfs_knob > 0
+    build_fs = params.relay_full_sync or rfs_knob is not None
 
     def _slot_counts(recv_idx: jax.Array, masks: jax.Array) -> jax.Array:
         """int32[N]: delivered-request count per receiver over all slots."""
@@ -1139,7 +1325,7 @@ def _phase5_pingreq(
 
         fs_slots = None
         relay_fs = jnp.int32(0)
-        if params.relay_full_sync:
+        if build_fs:
             # the relay's inner full sync (SwimParams.relay_full_sync):
             # a target with nothing non-echo to issue to a witness but a
             # diverged view hash answers that witness with its ENTIRE
@@ -1159,11 +1345,14 @@ def _phase5_pingreq(
                     ack_del[:, m][:, None] & issue_tgt_t & ~echo0,
                     axis=1,
                 )
-                fs_cols.append(
+                col = (
                     ack_del[:, m]
                     & ~has_claim
                     & (h_mid[t_safe] != sel.h_pre[w_m])
                 )
+                if rfs_on is not None:
+                    col = col & rfs_on
+                fs_cols.append(col)
             fs_slots = jnp.stack(fs_cols, axis=1)  # bool[N, kk]
             relay_fs = jnp.sum(fs_slots, dtype=jnp.int32)
 
@@ -1234,7 +1423,11 @@ def _phase5_pingreq(
     # (Under relay_full_sync the no-claims shortcut is unsound: a
     # diverged-but-quiet target must still answer full rows.)
     xch_pred = jnp.any(req_del)
-    if not params.relay_full_sync:
+    if rfs_on is not None:
+        # knob form of the shortcut: sound exactly when the knob is off
+        # (value-equal to both legacy programs at the matching value)
+        xch_pred = xch_pred & (rfs_on | jnp.any(state.pb >= 0))
+    elif not params.relay_full_sync:
         xch_pred = xch_pred & jnp.any(state.pb >= 0)
     state, xch_applied, xch_flapped, relay_fs_total = jax.lax.cond(
         xch_pred, exchange, no_exchange, state
@@ -1463,7 +1656,11 @@ def converged_impl(state: ClusterState, net: NetState) -> jax.Array:
 
 
 def swim_step_impl(
-    state: ClusterState, net: NetState, key: jax.Array, params: SwimParams
+    state: ClusterState,
+    net: NetState,
+    key: jax.Array,
+    params: SwimParams,
+    knobs: SwimKnobs | None = None,
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """One synchronized protocol period for every virtual node.
 
@@ -1474,8 +1671,19 @@ def swim_step_impl(
       4. receiver reply (+ full sync) + sender merge  (ping-handler.js:36-39)
       5. failed probes -> ping-req two-hop -> suspect  (ping-req-sender.js)
       6. suspicion countdowns fire -> faulty  (suspicion.js:66-69)
+
+    ``knobs`` (SwimKnobs, optional) replaces the value-like params with
+    traced scalars — one compiled program serves every knob value (and
+    every replica of a ``param_axes`` sweep); None compiles the exact
+    legacy program.
     """
     if params.sparse_cap:
+        if knobs is not None:
+            raise ValueError(
+                "sparse_cap selects the sparse-dissemination program, "
+                "which keeps its knobs compile-time; run knob sweeps "
+                "with sparse_cap=0"
+            )
         if state.pending is not None:
             raise NotImplementedError(
                 "sparse_cap does not compose with the latency model "
@@ -1493,7 +1701,12 @@ def swim_step_impl(
     else:
         k_sel, k_loss1, k_loss2, k_loss3 = jax.random.split(key, 4)
     ids = jnp.arange(n, dtype=jnp.int32)
-    sl_start = _validate_params(n, params)
+    sl_start: int | jax.Array = _validate_params(n, params)
+    if knobs is not None:
+        # traced countdown start: int32 scalar, cast to int8 at every
+        # write site (jnp.int8(traced) is a cast) — value-equal to the
+        # legacy weak-int8 constant whenever the host guard held
+        sl_start = knobs.suspicion_ticks + jnp.int32(1)
 
     # -- in-flight claims mature (latency model) ----------------------------
     # Slot ``tick % D`` lands at the START of the tick, before the
@@ -1529,7 +1742,7 @@ def swim_step_impl(
         state = state._replace(pending=state.pending.at[slot0].set(0))
 
     # -- phases 0-1: derived views + probe/witness selection ----------------
-    sel = _phase01_select(state, net, k_sel, params)
+    sel = _phase01_select(state, net, k_sel, params, knobs)
     gossiping, sends, t_safe = sel.gossiping, sel.sends, sel.t_safe
     maxpb8, h_pre = sel.maxpb8, sel.h_pre
 
@@ -1661,7 +1874,7 @@ def swim_step_impl(
     ack_applied = jnp.sum(merged2.applied, dtype=jnp.int32)
 
     # -- phase 5: ping-req for failed probes --------------------------------
-    pr = _phase5_pingreq(state, net, k_loss3, sel, ack, sl_start, params)
+    pr = _phase5_pingreq(state, net, k_loss3, sel, ack, sl_start, params, knobs)
     state = pr.state
     failed, declare_suspect = pr.failed, pr.declare_suspect
     declared, was_alive_at_target = pr.declared, pr.was_alive_at_target
@@ -1679,14 +1892,23 @@ def swim_step_impl(
         # library scores these via the membership 'updated' event)
         declare_flap = declared & was_alive_at_target
         flaps = _row_update(flaps, t_safe, declare_flap, op="max")
+        if knobs is None:
+            decay = params.damp_decay_per_tick
+            penalty = jnp.float32(params.damp_penalty)
+            suppress, reuse = params.damp_suppress, params.damp_reuse
+        else:
+            # f32 knobs feed the f32 accumulate; the f16 threshold knobs
+            # keep the f16-vs-weak-scalar compare dtype (see SwimKnobs)
+            decay, penalty = knobs.damp_decay_per_tick, knobs.damp_penalty
+            suppress, reuse = knobs.damp_suppress, knobs.damp_reuse
         damp = (
-            state.damp.astype(jnp.float32) * params.damp_decay_per_tick
-            + jnp.where(flaps, jnp.float32(params.damp_penalty), 0.0)
+            state.damp.astype(jnp.float32) * decay
+            + jnp.where(flaps, penalty, 0.0)
         ).astype(jnp.float16)
         damped = jnp.where(
-            damp > params.damp_suppress,
+            damp > suppress,
             True,
-            jnp.where(damp < params.damp_reuse, False, state.damped),
+            jnp.where(damp < reuse, False, state.damped),
         )
         state = state._replace(damp=damp, damped=damped)
         n_damped = jnp.sum(damped, dtype=jnp.int32)
@@ -2060,12 +2282,21 @@ def _swim_step_sparse(
 
 
 def swim_run_impl(
-    state: ClusterState, net: NetState, key: jax.Array, params: SwimParams, ticks: int
+    state: ClusterState,
+    net: NetState,
+    key: jax.Array,
+    params: SwimParams,
+    ticks: int,
+    knobs: SwimKnobs | None = None,
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
-    """``ticks`` protocol periods under lax.scan (one compiled program)."""
+    """``ticks`` protocol periods under lax.scan (one compiled program).
+
+    Traced knobs close over the scan body as loop constants — they do
+    NOT join the carry, so the pinned carry-dtype multisets are knob-
+    invariant (analysis/budgets.py CARRY_BUDGETS)."""
 
     def body(st, subkey):
-        return swim_step_impl(st, net, subkey, params)
+        return swim_step_impl(st, net, subkey, params, knobs)
 
     keys = jax.random.split(key, ticks)
     # Carry is the state alone (scalar metrics stack as scan outputs): a

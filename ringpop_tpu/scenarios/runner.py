@@ -345,6 +345,7 @@ def _scenario_scan_impl(
     ov=None,
     po=None,
     po_knobs=None,
+    sw_knobs=None,
     *,
     params,
     has_revive: bool,
@@ -433,14 +434,18 @@ def _scenario_scan_impl(
                 ov_fl, jnp.maximum(per_eff, jnp.int32(overload.factor)), per_eff
             )
         net = NetState(up=u, responsive=r, adj=gid, period=per_eff, **link_kw)
+        # traced protocol knobs (sim.SwimKnobs) close over the scan body
+        # as constants, not carry entries — the per-tick loss override
+        # stays on the params pytree exactly as before
         if is_delta:
             sp = params._replace(swim=params.swim._replace(loss=loss_t))
-            st, metrics = sdelta.delta_step_impl(st, net, key, sp)
+            st, metrics = sdelta.delta_step_impl(st, net, key, sp,
+                                                 knobs=sw_knobs)
             conv = sdelta._converged_impl(st, u, r)
             own = sdelta.view_lookup(st, ids) & 7
         else:
             sp = params._replace(loss=loss_t)
-            st, metrics = sim.swim_step_impl(st, net, key, sp)
+            st, metrics = sim.swim_step_impl(st, net, key, sp, sw_knobs)
             conv = sim.converged_impl(st, net)
             own = jnp.diagonal(st.view_key) & 7
         live = jnp.sum(
@@ -545,6 +550,71 @@ _scenario_scan = jax.jit(
     donate_argnums=(0, 1, 2, 3),
 )
 
+_DAMP_KNOBS = ("damp_penalty", "damp_decay_per_tick",
+               "damp_suppress", "damp_reuse")
+
+
+def validate_param_knobs(
+    n: int,
+    swim_params: SwimParams,
+    knob_values: dict[str, Any],
+    *,
+    backend: str,
+    period_active: bool,
+    damping: bool,
+) -> None:
+    """Host-side composition guards for traced protocol knobs, shared by
+    the one-dispatch runner (singleton values) and the sweep's
+    ``param_axes`` (one list per knob).  Traced values cannot be checked
+    in-trace, so every constraint a compile-time knob used to enforce
+    statically is re-checked here, against EVERY value the knob will
+    take, before anything is device-ified:
+
+    - range + int8 digit budgets at the axis max (``_validate_params``);
+    - ``phase_mod`` must stay 1 when the scenario carries per-node
+      period rows (gray/overload): the period row subsumes the stagger
+      divisor, so a swept phase_mod would be silently ignored;
+    - the delta backend has no damping plane and statically rejects
+      ``relay_full_sync`` — knob values that would silently no-op raise
+      instead;
+    - damp-threshold knobs need the damping plane armed on the dense
+      backend (``init_state(..., damping=True)``).
+    """
+    for name, vals in knob_values.items():
+        for v in vals:
+            sim.check_knob_value(name, v, swim_params)
+    sim._validate_params(n, swim_params, knob_values=knob_values)
+    if period_active:
+        for i, v in enumerate(knob_values.get("phase_mod", ())):
+            if int(v) != 1:
+                raise ValueError(
+                    f"phase_mod={int(v)} (axis value {i}): scenarios with "
+                    "per-node period rows (gray degradation / overload) "
+                    "subsume the stagger divisor, so the knob would be "
+                    "silently ignored; pin phase_mod to 1 here"
+                )
+    if backend == "delta":
+        for i, v in enumerate(knob_values.get("relay_full_sync", ())):
+            if int(v) != 0:
+                raise ValueError(
+                    f"relay_full_sync={int(v)} (axis value {i}): the delta "
+                    "backend has no full-sync exchange arm; sweep this "
+                    "knob on the dense backend"
+                )
+        bad = sorted(set(knob_values) & set(_DAMP_KNOBS))
+        if bad:
+            raise ValueError(
+                f"damp knob(s) {bad}: the delta backend has no damping "
+                "plane; sweep damp thresholds on the dense backend"
+            )
+    elif not damping:
+        bad = sorted(set(knob_values) & set(_DAMP_KNOBS))
+        if bad:
+            raise ValueError(
+                f"damp knob(s) {bad} need the damping plane armed: "
+                "init the dense cluster with damping=True"
+            )
+
 
 def run_compiled(
     state: Any,
@@ -555,6 +625,7 @@ def run_compiled(
     traffic: Any | None = None,
     adj: jax.Array | None = None,
     policy: Any | None = None,
+    param_knobs: dict[str, float | int] | None = None,
 ) -> tuple[Any, NetState, dict[str, jax.Array]]:
     """One jitted call: (state, net, per-tick telemetry stacks [ticks]).
 
@@ -576,6 +647,12 @@ def run_compiled(
     ``policy`` (a ``policies.CompiledPolicy``) arms the remediation
     plane: its knobs ride as traced scalars, its state rides the scan
     carry, and the post-run net round-trips it (``net.po_*``).
+
+    ``param_knobs`` overrides traced protocol knobs (``sim.SwimKnobs``
+    names) as host values for this run — same compiled program as the
+    defaults, different scalar operands.  Values are validated host-side
+    against the backend/scenario composition rules
+    (``validate_param_knobs``) before the dispatch.
     """
     global _dispatches
     if keys.shape[0] != compiled.ticks:
@@ -595,6 +672,17 @@ def run_compiled(
         po = prepare_policy(policy, net, compiled.n,
                             traffic.static.max_retries)
         knobs = pol.knob_arrays(policy)
+    sw_knobs = None
+    if param_knobs is not None:
+        is_delta = isinstance(state, DeltaState)
+        swp = params.swim if is_delta else params
+        validate_param_knobs(
+            compiled.n, swp, {k: [v] for k, v in param_knobs.items()},
+            backend="delta" if is_delta else "dense",
+            period_active=(period is not None),
+            damping=getattr(state, "damp", None) is not None,
+        )
+        sw_knobs = sim.swim_knob_arrays(swp, param_knobs)
     _dispatches += 1
     meta = {
         "backend": "delta" if isinstance(state, DeltaState) else "dense",
@@ -606,6 +694,8 @@ def run_compiled(
         meta["traffic_m"] = traffic.static.m
     if policy is not None:
         meta["policy"] = policy.name
+    if param_knobs is not None:
+        meta["param_knobs"] = sorted(param_knobs)
     # ledger-off (the default): dispatch() is a plain call-through; on,
     # the dispatch is recorded with its compile/execute split and AOT
     # memory footprint (obs/ledger.py)
@@ -630,6 +720,7 @@ def run_compiled(
         ov,
         po,
         knobs,
+        sw_knobs,
         params=params,
         has_revive=compiled.has_revive,
         traffic=traffic.static if traffic is not None else None,

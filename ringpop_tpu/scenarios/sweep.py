@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ringpop_tpu.models import swim_sim as sim
 from ringpop_tpu.obs.ledger import default_ledger
 from ringpop_tpu.scenarios import runner
 from ringpop_tpu.scenarios.compile import (
@@ -353,6 +354,7 @@ def _sweep_scan_impl(
     ov=None,
     po=None,
     po_knobs=None,
+    sw_knobs=None,
     *,
     params,
     has_revive: bool,
@@ -366,11 +368,11 @@ def _sweep_scan_impl(
     # vmapped body sees the same global tick numbering per segment.
     def one(state, up, responsive, adj, period, ev_tick, ev_kind, ev_node,
             p_tick, p_gid, loss, keys, faults, tr_tensors, ov, po,
-            po_knobs):
+            po_knobs, sw_knobs):
         return runner._scenario_scan_impl(
             state, up, responsive, adj, period,
             ev_tick, ev_kind, ev_node, p_tick, p_gid, loss, keys,
-            tr_tensors, tick0, faults, ov, po, po_knobs,
+            tr_tensors, tick0, faults, ov, po, po_knobs, sw_knobs,
             params=params, has_revive=has_revive, traffic=traffic,
             overload=overload, policy=policy,
         )
@@ -379,15 +381,16 @@ def _sweep_scan_impl(
         one,
         # batched: state/net (leading replica axis, period + overload +
         # policy carries included), node events (jitter reorders rows),
-        # loss (scaled), keys, and the POLICY KNOBS — traced [R] axes,
-        # so a knob sweep is one compile (ROADMAP item 4's frozen-knob
-        # refactor, pre-paid for the policy plane).  Shared: partition
-        # rows, failure-model tensors, and the traffic workload (one
-        # key stream — every replica serves the identical key batches
-        # against its own trajectory, exactly what a standalone
-        # run_scenario with this workload would serve).
+        # loss (scaled), keys, the POLICY KNOBS, and the PROTOCOL KNOBS
+        # (sim.SwimKnobs) — traced [R] axes, so a knob sweep is one
+        # compile (ROADMAP item 4's frozen-knob refactor: protocol
+        # parameters batch exactly like the policy operating points).
+        # Shared: partition rows, failure-model tensors, and the traffic
+        # workload (one key stream — every replica serves the identical
+        # key batches against its own trajectory, exactly what a
+        # standalone run_scenario with this workload would serve).
         in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0, 0, None, None, 0,
-                 0, 0),
+                 0, 0, 0),
     )(
         state,
         up,
@@ -406,6 +409,7 @@ def _sweep_scan_impl(
         ov,
         po,
         po_knobs,
+        sw_knobs,
     )
 
 
@@ -503,6 +507,72 @@ def replica_policy(
     return policy._replace(knobs=type(policy.knobs)(**knobs))
 
 
+def param_knob_axes(
+    params: Any,
+    param_axes: dict[str, Sequence[float | int]] | None,
+    replicas: int,
+    *,
+    n: int,
+    backend: str,
+    period_active: bool,
+    damping: bool,
+):
+    """The [R]-batched protocol-knob arrays the vmapped scan takes —
+    the ``policy_knob_axes`` template applied to ``sim.SwimKnobs``:
+    swept knobs come from ``param_axes`` (one host value per replica),
+    everything else broadcasts the ``params`` default, each cast to its
+    per-site dtype (``sim.SWIM_KNOB_DTYPES``).  Every axis value is
+    validated host-side (range, int8 digit budgets at the axis max,
+    backend/scenario composition) before a trace sees it."""
+    if not param_axes:
+        return None
+    swp = params.swim if backend == "delta" else params
+    axes = dict(param_axes)
+    defaults = sim.swim_knob_values(swp)
+    knob_values: dict[str, list] = {}
+    vals = {}
+    for field in sim.SwimKnobs._fields:
+        dt = sim.SWIM_KNOB_DTYPES[field]
+        if field in axes:
+            v = np.asarray(axes.pop(field))
+            if v.shape != (replicas,):
+                raise ValueError(
+                    f"param axis {field!r} must have one value per "
+                    f"replica (got shape {v.shape} for {replicas})"
+                )
+            knob_values[field] = [x.item() for x in v]
+            vals[field] = jnp.asarray(v, dt)
+        else:
+            vals[field] = jnp.full((replicas,), defaults[field], dt)
+    if axes:
+        raise ValueError(
+            f"unknown param axes {sorted(axes)} "
+            f"(knobs: {', '.join(sim.SwimKnobs._fields)})"
+        )
+    runner.validate_param_knobs(
+        n, swp, knob_values, backend=backend,
+        period_active=period_active, damping=damping,
+    )
+    return sim.SwimKnobs(**vals)
+
+
+def replica_param_knobs(
+    param_axes: dict[str, Sequence[float | int]] | None, r: int
+) -> dict[str, float | int] | None:
+    """Replica r's effective knob overrides — the ``param_knobs`` dict a
+    standalone ``run_scenario`` must be given to reproduce replica r
+    bit-for-bit (the ``replica_spec`` contract, extended to the traced
+    protocol knobs)."""
+    if not param_axes:
+        return None
+    out: dict[str, float | int] = {}
+    for key, vals in param_axes.items():
+        v = vals[r]
+        kind = jnp.dtype(sim.SWIM_KNOB_DTYPES[key]).kind
+        out[key] = float(v) if kind == "f" else int(v)
+    return out
+
+
 def run_sweep_compiled(
     state: Any,
     net: Any,
@@ -514,6 +584,8 @@ def run_sweep_compiled(
     traffic: Any | None = None,
     policy: Any | None = None,
     policy_axes: dict[str, Sequence[int]] | None = None,
+    param_axes: dict[str, Sequence[float | int]] | None = None,
+    program_tag: str | None = None,
 ) -> tuple[Any, Any, dict[str, jax.Array]]:
     """One jitted call: R replicas of the compiled scenario.
 
@@ -534,6 +606,20 @@ def run_sweep_compiled(
     communication exists in the scan), so a multi-chip mesh runs
     R / n_devices replicas per chip; ignored on a single device.
     Requires R divisible by the device count.
+
+    ``param_axes`` batches traced PROTOCOL knobs (``sim.SwimKnobs``
+    names) one value per replica, next to the seed/loss/jitter/policy
+    axes: an R-point knob grid compiles once and runs in this one
+    dispatch.  Replica r reproduces a standalone
+    ``run_scenario(param_knobs=replica_param_knobs(param_axes, r))``
+    bit-for-bit.
+
+    ``program_tag`` renames this dispatch's ledger program to
+    ``run_sweep:<tag>``: a tuner running several incident arms (whose
+    event tensors differ in shape, so they are distinct programs by
+    construction) tags each arm so the ledger's ``recompile_cause``
+    attribution stays scoped to WITHIN-arm drift instead of flagging
+    the arms against each other.
     """
     global _dispatches
     if keys.shape[:2] != (cs.replicas, cs.base.ticks):
@@ -553,6 +639,13 @@ def run_sweep_compiled(
         po = runner.prepare_policy(
             policy, net, cs.base.n, traffic.static.max_retries
         )
+    sw_knobs = param_knob_axes(
+        params, param_axes, r,
+        n=cs.base.n,
+        backend="delta" if hasattr(params, "wire_cap") else "dense",
+        period_active=period is not None,
+        damping=getattr(state, "damp", None) is not None,
+    )
     batched = [
         _broadcast_replicas(state, r),
         _broadcast_replicas(net.up, r),
@@ -582,6 +675,9 @@ def run_sweep_compiled(
             knobs = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, sharding), knobs
             )
+            sw_knobs = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), sw_knobs
+            )
     _dispatches += 1
     meta = {
         "backend": "delta" if hasattr(params, "wire_cap") else "dense",
@@ -593,10 +689,12 @@ def run_sweep_compiled(
         meta["traffic_m"] = traffic.static.m
     if policy is not None:
         meta["policy"] = policy.name
+    if param_axes:
+        meta["param_axes"] = sorted(param_axes)
     # routed through the dispatch ledger (obs/ledger.py): a call-through
     # when disabled, a recorded compile/execute + footprint row when on
     states, up, resp, adj, period, ov, po, ys = default_ledger().dispatch(
-        "run_sweep",
+        "run_sweep" if program_tag is None else f"run_sweep:{program_tag}",
         _sweep_scan,
         *batched,
         cs.ev_tick,
@@ -612,6 +710,7 @@ def run_sweep_compiled(
         ov_b,
         po_b,
         knobs,
+        sw_knobs,
         params=params,
         has_revive=cs.base.has_revive,
         traffic=traffic.static if traffic is not None else None,

@@ -1,0 +1,328 @@
+"""Traced protocol knobs (``sim.SwimKnobs``): bit-parity with the
+compile-time programs, ``run_sweep(param_axes=...)``, and validation.
+
+Fast lane: the host-side knob helpers and every validation rejection
+(range, int8 digit budget at the axis max, backend/scenario
+composition — all pre-key-draw, so a failed call never desyncs the
+cluster key), ONE combo traced-vs-legacy parity run per backend plus
+the damping-threshold knobs, the ``run_scenario(param_knobs=...)``
+trajectory contract, replica parity for a dense ``param_axes`` sweep,
+and the compile-once contract (a second knob grid re-dispatches the
+SAME executable — ledger row warm, no ``recompile_cause``).
+
+Slow lane: the per-knob acceptance grid — each traced knob
+individually, traced program == legacy compile-time program at equal
+values, on BOTH backends, plus delta-backend sweep replica parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.obs.ledger import default_ledger
+from ringpop_tpu.scenarios import runner, sweep
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+N = 12
+TICKS = 20
+SPEC = ScenarioSpec.from_dict(
+    {
+        "ticks": TICKS,
+        "events": [
+            {"at": 3, "op": "kill", "node": 3},
+            {"at": 8, "op": "loss", "p": 0.05},
+            {"at": 14, "op": "loss", "p": 0.0},
+        ],
+    }
+)
+
+
+@pytest.fixture
+def ledger():
+    led = default_ledger()
+    led.enable(None)
+    led.clear()
+    yield led
+    led.disable()
+    led.clear()
+
+
+def _eq_tree(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        bool((np.asarray(x) == np.asarray(y)).all()) for x, y in zip(la, lb)
+    )
+
+
+def _dense_run(params, knobs, *, n=8, ticks=14, damping=False):
+    st = sim.init_state(n, damping=damping)
+    net = sim.NetState(
+        up=jnp.ones((n,), bool).at[3].set(False),
+        responsive=jnp.ones((n,), bool),
+        adj=None,
+    )
+    return sim.swim_run(
+        st, net, jax.random.PRNGKey(0), params, ticks=ticks, knobs=knobs
+    )
+
+
+def _delta_run(params, knobs, *, n=8, ticks=14):
+    st = sdelta.init_delta(n, capacity=16)
+    net = sim.NetState(
+        up=jnp.ones((n,), bool).at[3].set(False),
+        responsive=jnp.ones((n,), bool),
+        adj=None,
+    )
+    dp = sdelta.DeltaParams(swim=params)
+    return sdelta.delta_run(
+        st, net, jax.random.PRNGKey(0), dp, ticks=ticks, knobs=knobs
+    )
+
+
+def _assert_dense_parity(params, overrides=None, damping=False):
+    knobs = sim.swim_knob_arrays(params, overrides)
+    s1, m1 = _dense_run(params, None, damping=damping)
+    s2, m2 = _dense_run(params, knobs, damping=damping)
+    assert _eq_tree(s1, s2) and _eq_tree(m1, m2)
+
+
+def _assert_delta_parity(params, overrides=None):
+    knobs = sim.swim_knob_arrays(params, overrides)
+    s1, m1 = _delta_run(params, None)
+    s2, m2 = _delta_run(params, knobs)
+    assert _eq_tree(s1, s2) and _eq_tree(m1, m2)
+
+
+# -- fast: host-side knob helpers and validation ----------------------------
+
+
+def test_knob_values_and_arrays_roundtrip():
+    p = sim.SwimParams(suspicion_ticks=7, piggyback_factor=4)
+    vals = sim.swim_knob_values(p)
+    assert vals["suspicion_ticks"] == 7 and vals["piggyback_factor"] == 4
+    knobs = sim.swim_knob_arrays(p, {"suspicion_ticks": 11})
+    assert int(knobs.suspicion_ticks) == 11
+    assert knobs.suspicion_ticks.dtype == jnp.int32
+    assert knobs.damp_suppress.dtype == jnp.float16
+    with pytest.raises(ValueError, match="unknown traced swim knob"):
+        sim.swim_knob_arrays(p, {"nope": 1})
+
+
+def test_knob_range_guards():
+    p = sim.SwimParams(ping_req_size=3)
+    with pytest.raises(ValueError, match="int8 countdown"):
+        sim.check_knob_value("suspicion_ticks", 127, p)
+    with pytest.raises(ValueError, match="compiled capacity"):
+        sim.check_knob_value("ping_req_size", 4, p)
+    with pytest.raises(ValueError, match="phase_mod"):
+        sim.check_knob_value("phase_mod", 0, p)
+    with pytest.raises(ValueError, match="relay_full_sync"):
+        sim.check_knob_value("relay_full_sync", 2, p)
+
+
+def test_validate_params_names_offending_axis_value():
+    """Satellite fix: the int8 digit budgets hold at the axis MAX, and
+    the error names the replica whose value broke them."""
+    p = sim.SwimParams()
+    # scalar default passes, replica 2's swept value does not
+    sim._validate_params(1000, p)
+    with pytest.raises(ValueError, match=r"param_axes replica 2"):
+        sim._validate_params(
+            1000, p, knob_values={"piggyback_factor": [2, 3, 40]}
+        )
+    with pytest.raises(ValueError, match=r"param_axes replica 1"):
+        sim._validate_params(
+            16, p, knob_values={"suspicion_ticks": [9, 200]}
+        )
+
+
+def test_composition_guards():
+    p = sim.SwimParams()
+    ok = dict(backend="dense", period_active=False, damping=True)
+    runner.validate_param_knobs(16, p, {"suspicion_ticks": [3, 9]}, **ok)
+    with pytest.raises(ValueError, match="phase_mod"):
+        runner.validate_param_knobs(
+            16, p, {"phase_mod": [1, 2]},
+            backend="dense", period_active=True, damping=False,
+        )
+    with pytest.raises(ValueError, match="full-sync exchange arm"):
+        runner.validate_param_knobs(
+            16, p, {"relay_full_sync": [0, 1]},
+            backend="delta", period_active=False, damping=False,
+        )
+    with pytest.raises(ValueError, match="no damping plane"):
+        runner.validate_param_knobs(
+            16, p, {"damp_penalty": [100.0]},
+            backend="delta", period_active=False, damping=False,
+        )
+    with pytest.raises(ValueError, match="damping=True"):
+        runner.validate_param_knobs(
+            16, p, {"damp_suppress": [900.0]},
+            backend="dense", period_active=False, damping=False,
+        )
+
+
+def test_param_axes_rejections_burn_no_key():
+    p = sim.SwimParams(suspicion_ticks=5)
+    c = SimCluster(8, p, seed=0, backend="delta", capacity=8)
+    key_before = np.asarray(c.key).copy()
+    with pytest.raises(ValueError, match="full-sync"):
+        c.run_sweep(SPEC, 2, param_axes={"relay_full_sync": [0, 1]})
+    with pytest.raises(ValueError, match="unknown param axes"):
+        c.run_sweep(SPEC, 2, param_axes={"bogus": [1, 2]})
+    with pytest.raises(ValueError, match="one value per"):
+        c.run_sweep(SPEC, 2, param_axes={"suspicion_ticks": [1, 2, 3]})
+    np.testing.assert_array_equal(np.asarray(c.key), key_before)
+
+
+# -- fast: one traced-vs-legacy parity per backend + damping ----------------
+
+
+def test_dense_combo_traced_matches_legacy():
+    _assert_dense_parity(
+        sim.SwimParams(suspicion_ticks=9, piggyback_factor=6, phase_mod=2)
+    )
+
+
+@pytest.mark.slow
+def test_delta_combo_traced_matches_legacy():
+    _assert_delta_parity(
+        sim.SwimParams(suspicion_ticks=9, piggyback_factor=6, phase_mod=2)
+    )
+
+
+@pytest.mark.slow
+def test_dense_damping_knobs_match_legacy():
+    _assert_dense_parity(
+        sim.SwimParams(
+            damp_penalty=300.0, damp_suppress=1200.0, damp_reuse=400.0
+        ),
+        damping=True,
+    )
+
+
+# -- fast: scenario/sweep plumbing ------------------------------------------
+
+
+@pytest.mark.slow
+def test_run_scenario_param_knobs_pins_legacy_trajectory():
+    p = sim.SwimParams(suspicion_ticks=6)
+    a = SimCluster(N, p, seed=4)
+    t1 = a.run_scenario(SPEC)
+    b = SimCluster(N, p, seed=4)
+    t2 = b.run_scenario(SPEC, param_knobs={"suspicion_ticks": 6})
+    np.testing.assert_array_equal(t1.converged, t2.converged)
+    np.testing.assert_array_equal(t1.live, t2.live)
+    for k in t1.metrics:
+        np.testing.assert_array_equal(t1.metrics[k], t2.metrics[k])
+    assert _eq_tree(a.state, b.state)
+
+
+@pytest.mark.slow
+def test_run_sweep_param_axes_replica_parity():
+    """Replica r of a suspicion_ticks knob grid == a standalone
+    run_scenario(param_knobs=replica_param_knobs(...)) from the same
+    replica key (the replica_spec contract, knob plane)."""
+    p = sim.SwimParams(suspicion_ticks=8)
+    axes = {"suspicion_ticks": [4, 8, 12]}
+    c = SimCluster(N, p, seed=7)
+    strace = c.run_sweep(SPEC, 3, param_axes=axes)
+    for r in (0, 2):
+        c2 = SimCluster(N, p, seed=7)
+        c2.key = jnp.asarray(strace.replica_keys[r])
+        trace = c2.run_scenario(
+            SPEC, param_knobs=sweep.replica_param_knobs(axes, r)
+        )
+        np.testing.assert_array_equal(strace.converged[r], trace.converged)
+        np.testing.assert_array_equal(strace.live[r], trace.live)
+        for k in trace.metrics:
+            np.testing.assert_array_equal(
+                strace.metrics[k][r], trace.metrics[k]
+            )
+    assert sweep.replica_param_knobs(axes, 1) == {"suspicion_ticks": 8}
+    assert sweep.replica_param_knobs(None, 0) is None
+
+
+@pytest.mark.slow
+def test_param_axes_grid_is_one_compile(ledger):
+    """The compile-once contract: a second knob grid (same shapes, new
+    values) re-dispatches the SAME executable — warm, no recompile —
+    and program_tag renames the ledger program per tuner arm."""
+    p = sim.SwimParams(suspicion_ticks=8)
+    c = SimCluster(N, p, seed=9)
+    c.run_sweep(SPEC, 3, param_axes={"suspicion_ticks": [4, 8, 12]})
+    c.run_sweep(SPEC, 3, param_axes={"suspicion_ticks": [5, 9, 13]})
+    c.run_sweep(
+        SPEC, 3, param_axes={"piggyback_factor": [2, 4, 8]},
+        program_tag="arm0",
+    )
+    rows = [r for r in ledger.rows if r["program"] == "run_sweep"]
+    assert [r["cold"] for r in rows] == [True, False]
+    # the tagged arm is its own ledger program: cold on first dispatch,
+    # but NOT a recompile of run_sweep (attribution stays within-arm)
+    assert all(not r.get("recompile_cause") for r in ledger.rows)
+    tagged = [r for r in ledger.rows if r["program"] == "run_sweep:arm0"]
+    assert len(tagged) == 1 and tagged[0]["cold"]
+    assert rows[0]["param_axes"] == ["suspicion_ticks"]
+
+
+# -- slow: the per-knob acceptance grid -------------------------------------
+
+PER_KNOB = [
+    ("suspicion", sim.SwimParams(suspicion_ticks=7), None),
+    ("piggyback", sim.SwimParams(piggyback_factor=4), None),
+    ("phase_mod", sim.SwimParams(phase_mod=3), None),
+    ("rfs_off", sim.SwimParams(relay_full_sync=False), None),
+    ("ping_req", sim.SwimParams(ping_req_size=3), None),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,params,overrides", PER_KNOB
+    + [("rfs_on", sim.SwimParams(relay_full_sync=True), None)],
+    ids=lambda v: v if isinstance(v, str) else "",
+)
+def test_dense_per_knob_parity(name, params, overrides):
+    _assert_dense_parity(params, overrides)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,params,overrides", PER_KNOB,
+    ids=lambda v: v if isinstance(v, str) else "",
+)
+def test_delta_per_knob_parity(name, params, overrides):
+    _assert_delta_parity(params, overrides)
+
+
+@pytest.mark.slow
+def test_delta_sweep_param_axes_replica_parity():
+    p = sim.SwimParams(suspicion_ticks=8)
+    axes = {"suspicion_ticks": [5, 10], "piggyback_factor": [3, 5]}
+
+    def factory():
+        return SimCluster(
+            N, p, seed=3, backend="delta",
+            capacity=N, wire_cap=N, claim_grid=3 * N * N,
+        )
+
+    c = factory()
+    strace = c.run_sweep(SPEC, 2, param_axes=axes)
+    for r in range(2):
+        c2 = factory()
+        c2.key = jnp.asarray(strace.replica_keys[r])
+        trace = c2.run_scenario(
+            SPEC, param_knobs=sweep.replica_param_knobs(axes, r)
+        )
+        np.testing.assert_array_equal(strace.converged[r], trace.converged)
+        for k in trace.metrics:
+            np.testing.assert_array_equal(
+                strace.metrics[k][r], trace.metrics[k]
+            )

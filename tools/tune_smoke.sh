@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# CPU tune smoke: the compile-once knob plane end to end through the
+# Pareto tuner.  Runs benchmarks/tune.py on its --micro grid (tiny
+# n/ticks, 2-point axes — same five arms, same dispatch shape as the
+# full run) and asserts the two contracts the tuner exists to prove:
+#
+#   * the whole incident x traffic x knob grid fits the declared
+#     dispatch budget (tune.py exits non-zero when it doesn't);
+#   * the in-memory dispatch ledger holds ZERO recompile_cause rows —
+#     every knob value rode a traced operand, nothing re-specialized.
+#
+# This is the CI tune-smoke job's body; run it locally the same way:
+#   tools/tune_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/ringpop-tune.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+
+echo "== tuner micro grid (five arms, budget 10)"
+JAX_PLATFORMS=cpu timeout -k 10 900 \
+  python benchmarks/tune.py --micro --json "$workdir/tune.json" \
+  | tee "$workdir/run.log"
+
+# the tuner already hard-fails on a blown budget or a recompile row;
+# re-assert both from the JSON so the smoke does not silently pass on
+# a future refactor that drops the in-script checks
+python - "$workdir" <<'EOF'
+import json
+import sys
+
+with open(f"{sys.argv[1]}/tune.json") as fh:
+    out = json.load(fh)
+
+assert out["dispatches"] <= out["dispatch_budget"], out
+assert out["recompile_rows"] == 0, out
+# the five arms all reported
+for key in ("grid", "frontier", "boundary", "pingreq", "admission"):
+    assert key in out, f"tuner output missing {key!r}"
+assert out["frontier"]["front"], "empty Pareto frontier"
+print(
+    f"tune smoke OK: {out['dispatches']} dispatches "
+    f"(budget {out['dispatch_budget']}), 0 recompile rows, "
+    f"{len(out['frontier']['front'])} frontier points"
+)
+EOF
+
+grep -q "recompile rows: 0" "$workdir/run.log"
+echo "tune smoke passed"
