@@ -1,0 +1,207 @@
+"""Dissemination-time ladder + per-incident provenance scorecard.
+
+The SWIM/ringpop pitch is O(log N) dissemination: a rumor originated
+anywhere reaches every member in about log2(N) protocol periods.  The
+provenance plane (obs/provenance.py) measures that claim directly —
+per-rumor infection wavefronts recorded inside the compiled scan — so
+this bench is the paper's Figure-style evaluation run against our own
+simulator instead of being asserted from the math.
+
+Two modes:
+
+* the RUNG LADDER (default): n = 64 -> 4096, dense and delta, one
+  kill per rung with ``trace_rumors`` armed; reports the infection-
+  time distribution of the auto-armed suspect rumor (p50/p95/p99 in
+  ticks) against the ceil(log2 n) bound, plus tree depth and
+  straggler count.  ``p99/log2n`` near 1.0 is the paper's claim
+  holding; >>1 means piggyback capacity, loss, or topology is
+  throttling the wavefront.
+
+* ``--scorecard``: every golden incident (scenarios/library.py) at
+  the golden configuration with 8 rumor slots armed — the
+  per-incident provenance scorecard BASELINE.md records: how many
+  rumors each outage originates, confirmed vs refuted, wavefront
+  reach, depth, and infection percentiles under that incident's
+  loss/partition/overload regime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import time
+
+import numpy as np
+
+LADDER = (64, 256, 1024, 4096)
+
+
+def _rung_spec(n: int, ticks: int, k: int) -> dict:
+    # one kill early; the suspect rumor it originates auto-arms a
+    # tracked slot, and its wavefront is the dissemination measurement
+    return {
+        "ticks": ticks,
+        "trace_rumors": k,
+        "events": [{"at": 4, "op": "kill", "node": n - 1}],
+    }
+
+
+def _rumor_stats(report: dict) -> dict:
+    """Aggregate the per-rumor wavefront stats a report carries."""
+    rumors = report["rumors"]
+    if not rumors:
+        return {"rumors": 0}
+    return {
+        "rumors": len(rumors),
+        "infected_min": min(r["infected"] for r in rumors),
+        "infected_max": max(r["infected"] for r in rumors),
+        "depth_max": max(r["depth_max"] for r in rumors),
+        "p50_max": max(r["infection_p50"] for r in rumors),
+        "p95_max": max(r["infection_p95"] for r in rumors),
+        "p99_max": max(r["infection_p99"] for r in rumors),
+        "stragglers": sum(r["stragglers"] for r in rumors),
+        "unattributed": sum(r["unattributed"] for r in rumors),
+    }
+
+
+def run_ladder(
+    ns=LADDER, ticks: int = 48, seed: int = 7, rumors: int = 4,
+    backends=("dense", "delta"),
+):
+    from ringpop_tpu.models.cluster import SimCluster
+    from ringpop_tpu.models.swim_sim import SwimParams
+
+    rows = []
+    for n in ns:
+        for backend in backends:
+            kw = {} if backend == "dense" else {
+                "capacity": min(2 * n, 1024)
+            }
+            c = SimCluster(
+                n, SwimParams(suspicion_ticks=8), seed=seed,
+                backend=backend, **kw,
+            )
+            t0 = time.perf_counter()
+            c.run_scenario(_rung_spec(n, ticks, rumors))
+            wall = time.perf_counter() - t0
+            rep = c.provenance_report()
+            bound = max(1, math.ceil(math.log2(n)))
+            row = {
+                "mode": "ladder",
+                "n": n,
+                "backend": backend,
+                "ticks": ticks,
+                "wall_s": round(wall, 2),
+                "log2_n": bound,
+                **_rumor_stats(rep),
+            }
+            if row["rumors"]:
+                row["p99_vs_log2n"] = round(row["p99_max"] / bound, 2)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    print("\n| n | backend | rumors | infected | depth | "
+          "infect p50/p95/p99 | log2(n) | p99/bound | stragglers |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for r in rows:
+        if not r["rumors"]:
+            print(f"| {r['n']} | {r['backend']} | 0 | — | | | "
+                  f"{r['log2_n']} | | |")
+            continue
+        print(
+            f"| {r['n']} | {r['backend']} | {r['rumors']} "
+            f"| {r['infected_max']}/{r['n']} | {r['depth_max']} "
+            f"| {r['p50_max']}/{r['p95_max']}/{r['p99_max']} "
+            f"| {r['log2_n']} | {r['p99_vs_log2n']} "
+            f"| {r['stragglers']} |"
+        )
+    return rows
+
+
+def run_scorecard(rumors: int = 8):
+    """Every golden incident at the golden configuration, provenance-
+    armed: the per-incident dissemination scorecard."""
+    from ringpop_tpu.obs import provenance as pvn
+    from ringpop_tpu.scenarios import library as ilib
+
+    rows = []
+    for name in ilib.INCIDENTS:
+        spec, wl = ilib.build_incident(name, ilib.GOLDEN_N)
+        spec = spec._replace(trace_rumors=rumors)
+        cluster = ilib.golden_cluster()
+        t0 = time.perf_counter()
+        trace = cluster.run_scenario(
+            spec, traffic=wl,
+            segment_ticks=min(ilib.GOLDEN_SEGMENT, spec.ticks),
+        )
+        wall = time.perf_counter() - t0
+        rep = cluster.provenance_report()
+        block = pvn.summary_block(rep)
+        summary = ilib.incident_summary(trace, prov=rep)
+        row = {
+            "mode": "scorecard",
+            "incident": name,
+            "n": ilib.GOLDEN_N,
+            "slots": rumors,
+            "wall_s": round(wall, 2),
+            **{f"pv_{k}": int(v) for k, v in block.items()},
+            "detect_tick": summary.get("detect_tick", -1),
+            "suspects_declared": summary.get("suspects_declared", 0),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    print("\n| incident | rumors | confirmed/refuted | infected "
+          "| depth | infect p50/p95/p99 | stragglers | unattributed |")
+    print("|---|---|---|---|---|---|---|---|")
+    for r in rows:
+        if not r["pv_rumors"]:
+            print(f"| {r['incident']} | 0 | — | | | | | |")
+            continue
+        print(
+            f"| {r['incident']} | {r['pv_rumors']} "
+            f"| {r['pv_confirmed']}/{r['pv_refuted']} "
+            f"| {r['pv_infected_min']}-{r['pv_infected_max']}/{r['n']} "
+            f"| {r['pv_depth_max']} "
+            f"| {r['pv_p50_max']}/{r['pv_p95_max']}/{r['pv_p99_max']} "
+            f"| {r['pv_stragglers']} | {r['pv_unattributed']} |"
+        )
+    return rows
+
+
+def run(n: int | None = None):
+    """run_all entry point: a CI-sized ladder (two rungs, both
+    backends) plus the golden scorecard."""
+    ns = (n,) if n else (64, 256)
+    for row in run_ladder(ns=ns, ticks=48):
+        yield row
+    for row in run_scorecard():
+        yield row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ladder", type=int, nargs="*", default=None,
+                    help=f"rung sizes (default {list(LADDER)})")
+    ap.add_argument("--ticks", type=int, default=48)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rumors", type=int, default=4)
+    ap.add_argument("--backend", choices=("dense", "delta"), default=None,
+                    help="restrict the ladder to one backend")
+    ap.add_argument("--scorecard", action="store_true",
+                    help="run the golden-incident provenance scorecard "
+                         "instead of the ladder")
+    args = ap.parse_args(argv)
+    if args.scorecard:
+        run_scorecard()
+        return
+    run_ladder(
+        ns=tuple(args.ladder) if args.ladder else LADDER,
+        ticks=args.ticks,
+        seed=args.seed,
+        rumors=args.rumors,
+        backends=(args.backend,) if args.backend else ("dense", "delta"),
+    )
+
+
+if __name__ == "__main__":
+    main()

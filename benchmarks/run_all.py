@@ -32,6 +32,7 @@ SIM_BENCHES = [
     "bench_stream",  # pipelined segmented soak vs the blocking loop
     "bench_faults",  # failure-model family sweeps: detect/heal tables
     "bench_multichip",  # gossip-plane race: ring remote-copy vs all-gather
+    "bench_dissemination",  # infection-time ladder vs the log2(N) bound
 ]
 
 
@@ -59,7 +60,7 @@ def main(argv=None) -> int:
         if args.sim_n and name in (
             "bench_sim_convergence", "bench_partition_heal",
             "bench_scenario", "bench_sweep", "bench_stream",
-            "bench_faults",
+            "bench_faults", "bench_dissemination",
         ):
             kwargs["n"] = args.sim_n
         try:

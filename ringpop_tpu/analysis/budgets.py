@@ -75,6 +75,16 @@ CARRY_BUDGETS: dict[tuple[str, str], dict[str, int]] = {
                                        "uint32": 5},
     ("run_scenario+policy", "delta"): {"int16": 1, "int32": 13, "int8": 2,
                                        "uint32": 7},
+    # the provenance shape adds the rumor-tracing carry on top of
+    # run_scenario — slot/wits/parent (3 x int32), tickv/first
+    # (2 x int16: ticks are bounded MAX_TICKS host-side), and the
+    # bit-packed knows plane (1 x uint32) — ZERO bool leaves, like
+    # every plane since PR 16; the legacy rows above are the
+    # prov-off pin: arming must not change THEM
+    ("run_scenario+provenance", "dense"): {"int16": 2, "int32": 6,
+                                           "int8": 2, "uint32": 3},
+    ("run_scenario+provenance", "delta"): {"int16": 2, "int32": 11,
+                                           "int8": 2, "uint32": 5},
     ("run_sweep", "dense"): {"int32": 3, "int8": 2, "uint32": 2},
     ("run_sweep", "delta"): {"int32": 8, "int8": 2, "uint32": 4},
     # the knob-grid sweep carries EXACTLY the run_sweep rows: the traced

@@ -387,6 +387,85 @@ def _build_policy_scenario(backend: str, *, n: int, ticks: int,
     )
 
 
+def _build_provenance_scenario(backend: str, *, n: int, ticks: int,
+                               capacity: int, trace_rumors: int = 4) -> Built:
+    """run_scenario's jitted scan in its PROVENANCE shape: a kill
+    timeline with the rumor-tracing plane armed (obs/provenance.py) —
+    the program that carries per-rumor first-heard/parent/knows planes
+    through the scan, audited so the tracing carry stays bit-packed
+    (ZERO bool leaves) and its dtype multiset stays pinned next to the
+    legacy shapes (the prov-off program is the run_scenario entry
+    itself: same scan, pv=None, prov=None)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.scenarios import runner
+    from ringpop_tpu.scenarios.compile import compile_spec
+    from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+    if backend == "delta":
+        state, net, params = _delta_fixture(n, capacity)
+        base_loss = params.swim.loss
+    else:
+        state, net, params = _dense_fixture(n)
+        base_loss = params.loss
+    t_kill = min(max(ticks // 4, 1), ticks - 1)
+    spec = ScenarioSpec.from_dict(
+        {
+            "ticks": ticks,
+            "trace_rumors": trace_rumors,
+            "events": [
+                {"at": t_kill, "op": "kill", "node": 0},
+                {"at": 0, "op": "track", "node": 1},
+            ],
+        }
+    )
+    compiled = compile_spec(spec, n, base_loss=base_loss)
+    keys = jax.random.split(jax.random.PRNGKey(0), ticks)
+    pv, pv_at, pv_node = runner.prepare_prov(compiled, net, params)
+    args = (
+        state,
+        net.up,
+        net.responsive,
+        jnp.zeros((n,), jnp.int32),
+        None,  # period
+        compiled.ev_tick,
+        compiled.ev_kind,
+        compiled.ev_node,
+        compiled.p_tick,
+        compiled.p_gid,
+        compiled.loss,
+        jnp.asarray(keys),
+        None,  # tr_tensors
+        None,  # tick0
+        compiled.faults,
+        None,  # ov
+        None,  # po
+        None,  # po_knobs
+        None,  # sw_knobs
+        pv,
+        pv_at,
+        pv_node,
+    )
+    return Built(
+        name="run_scenario+provenance",
+        backend=backend,
+        jitted=runner._scenario_scan,
+        args=args,
+        statics=dict(
+            params=params,
+            has_revive=compiled.has_revive,
+            prov=compiled.trace_rumors,
+        ),
+        key_roots={"protocol": tree_flat_index_of(args, args[11])},
+        donates=True,
+        min_aliased=1,
+        census_min_elems=n * (capacity if backend == "delta" else n),
+        dims=dict(N=n, K=trace_rumors,
+                  **(dict(C=capacity) if backend == "delta" else {})),
+    )
+
+
 def _build_sweep(backend: str, *, n: int, ticks: int, capacity: int,
                  replicas: int) -> Built:
     """run_sweep's jitted vmapped scan (sweep._sweep_scan)."""
@@ -705,6 +784,12 @@ ENTRY_POINTS: dict[str, EntrySpec] = {
         "the scenario scan in its policy shape: the incident fixture "
         "plus the remediation policy carry and traced knob scalars "
         "(ringpop_tpu/policies)"),
+    "run_scenario+provenance": EntrySpec(
+        "run_scenario+provenance", ("dense", "delta"),
+        _build_provenance_scenario,
+        "the scenario scan with the gossip provenance plane armed: "
+        "per-rumor infection wavefronts + detection-causality chains "
+        "carried bit-packed through the scan (obs/provenance.py)"),
     "run_sweep": EntrySpec(
         "run_sweep", ("dense", "delta"), _build_sweep,
         "the vmapped R-replica sweep scan (scenarios/sweep.py)"),

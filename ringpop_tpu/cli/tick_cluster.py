@@ -560,6 +560,8 @@ class TpuSimCluster(ClusterDriver):
         segment_store: str | None = None,
         incident: str | None = None,
         policy: str | None = None,
+        trace_rumors: int = 0,
+        spans_out: str | None = None,
     ) -> None:
         """Run a JSON scenario spec as ONE jitted call (scenarios/);
         with ``sweep=R`` run R replicas in one vmapped dispatch; with
@@ -582,7 +584,14 @@ class TpuSimCluster(ClusterDriver):
         (ringpop_tpu/policies); with ``incident`` a no-policy CONTROL
         arm replays first on an identically-seeded sibling cluster, and
         the before/after goodput + amplification line prints under the
-        summary."""
+        summary.
+
+        ``trace_rumors=K`` arms the provenance plane with K rumor
+        slots (obs/provenance.py; composes with ``incident``: the
+        incident's own declarations auto-arm slots), prints the
+        per-rumor dissemination report, and with ``spans_out=FILE``
+        writes the Perfetto-openable trace-event JSON
+        (obs/spans.py)."""
         from ringpop_tpu.scenarios.spec import ScenarioSpec
 
         incident_name = incident
@@ -599,6 +608,11 @@ class TpuSimCluster(ClusterDriver):
                 segment_ticks = min(32, spec.ticks)
         else:
             spec = ScenarioSpec.load(path)
+        if trace_rumors:
+            # arm the provenance plane on top of whatever the spec (or
+            # the incident) already says — a spec-file trace_rumors
+            # stands unless the flag overrides it
+            spec = spec._replace(trace_rumors=int(trace_rumors))
         if traffic and latency_buckets and incident_name is None:
             # enable the SLO latency plane on the parsed workload
             # (compile_traffic pins the tick->ms period to the cluster)
@@ -704,10 +718,46 @@ class TpuSimCluster(ClusterDriver):
                     f"sends/delivered, "
                     f"{int(m['gray_timeouts'].sum())} gray timeouts"
                 )
+        prov_report = None
+        if spec.trace_rumors:
+            from ringpop_tpu.obs import spans as obs_spans
+
+            prov_report = self.cluster.provenance_report()
+            rumors = prov_report["rumors"]
+            print(
+                f"provenance: {len(rumors)}/{spec.trace_rumors} rumor "
+                f"slots armed (log2(n) bound {prov_report['log2_n']} ticks)"
+            )
+            res_name = {0: "pending", 1: "refuted", 2: "confirmed"}
+            for r in rumors:
+                res = res_name.get(r["resolution"], "?")
+                at = (f"@t{r['resolution_tick']}"
+                      if r["resolution_tick"] >= 0 else "")
+                print(
+                    f"  slot {r['slot']}: n{r['subject']} key {r['key']} — "
+                    f"origin n{r['origin']}@t{r['origin_tick']}, {res}{at}, "
+                    f"infected {r['infected']}/{prov_report['n']} "
+                    f"(depth {r['depth_max']}, p50/p95/p99 "
+                    f"{r['infection_p50']}/{r['infection_p95']}/"
+                    f"{r['infection_p99']} ticks, "
+                    f"{r['stragglers']} stragglers), "
+                    f"witnesses {r['witnesses']}"
+                )
+            if spans_out:
+                nev = obs_spans.write_spans(prov_report, spans_out)
+                print(f"spans ({nev} trace events, Perfetto-openable) "
+                      f"-> {spans_out}")
+            if self.cluster.stats_sink is not None:
+                from ringpop_tpu.obs import bridge as obs_bridge
+
+                sink = self.cluster.stats_sink
+                obs_bridge.emit_provenance(
+                    prov_report, sink.emitter, prefix=sink.prefix
+                )
         if incident_name is not None:
             from ringpop_tpu.scenarios import library as ilib
 
-            summary = ilib.incident_summary(trace)
+            summary = ilib.incident_summary(trace, prov=prov_report)
             print(ilib.format_summary(incident_name, summary))
             if control is not None and control.get("lookups"):
                 g0 = 100.0 * control["delivered"] / control["lookups"]
@@ -909,6 +959,22 @@ def add_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--trace-out", default=None, metavar="FILE",
                         help="with --scenario: write the per-tick telemetry "
                              "trace (.npz) here")
+    parser.add_argument("--trace-rumors", type=int, default=0, metavar="K",
+                        help="with --scenario/--incident: arm the gossip "
+                             "provenance plane with K rumor slots "
+                             "(obs/provenance.py) — per-rumor infection "
+                             "wavefronts and suspect→faulty/refute "
+                             "causality chains recorded INSIDE the "
+                             "compiled scan; the dissemination report "
+                             "(depth, infection-time percentiles vs the "
+                             "paper's log2(N) bound) prints at the end")
+    parser.add_argument("--spans-out", default=None, metavar="FILE",
+                        help="with --trace-rumors: write the run's "
+                             "provenance as Chrome trace-event JSON "
+                             "(obs/spans.py) — open in ui.perfetto.dev "
+                             "or chrome://tracing; one track per rumor, "
+                             "detection window spans + infection flow "
+                             "arrows")
     parser.add_argument("--traffic", default=None, metavar="SPEC",
                         help="with --scenario: co-run a key workload in "
                              "the same compiled program — SPEC is "
@@ -1094,6 +1160,20 @@ def main(argv: list[str] | None = None) -> None:
     if args.latency_buckets and not args.traffic:
         parser.error("--latency-buckets needs --traffic (it extends the "
                      "serving workload with the SLO latency plane)")
+    if args.trace_rumors and not has_run:
+        parser.error("--trace-rumors needs --scenario/--incident (the "
+                     "provenance plane records inside a compiled "
+                     "scenario run)")
+    if args.trace_rumors and args.sweep:
+        parser.error("--trace-rumors does not compose with --sweep on the "
+                     "CLI (the per-replica reports are a library feature: "
+                     "run_sweep + final_nets.pv_*)")
+    if args.trace_rumors and args.sparse_cap:
+        parser.error("--trace-rumors needs --sparse-cap 0 (the plane "
+                     "reads the dense delivery evidence)")
+    if args.spans_out and not args.trace_rumors:
+        parser.error("--spans-out needs --trace-rumors (it exports the "
+                     "provenance plane's report)")
     if args.segment_ticks is not None and not has_run:
         parser.error("--segment-ticks needs --scenario/--incident (it "
                      "segments a compiled scenario run)")
@@ -1175,6 +1255,8 @@ def main(argv: list[str] | None = None) -> None:
                     segment_store=args.segment_store,
                     incident=args.incident,
                     policy=args.policy,
+                    trace_rumors=args.trace_rumors,
+                    spans_out=args.spans_out,
                 )
             elif args.script:
                 run_script(driver, args.script)

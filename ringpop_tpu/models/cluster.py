@@ -318,6 +318,7 @@ class SimCluster:
                 policy, n=self.n, m=traffic.static.m
             )
         srunner.precheck_policy(policy, traffic, self.net)
+        srunner.precheck_prov(compiled, self.net, params)
         if param_knobs is not None:
             # knob validation is a static rejection too: it must fire
             # before the key draw (same no-desync contract as precheck)
@@ -521,6 +522,7 @@ class SimCluster:
                 policy, n=self.n, m=traffic.static.m
             )
         srunner.precheck_policy(policy, traffic, self.net)
+        srunner.precheck_prov(cs.base, self.net, params)
         if shard:
             ssweep.precheck_shard(replicas)
         if param_axes:
@@ -951,6 +953,35 @@ class SimCluster:
         self.net = self.net._replace(
             po_press=None, po_shed=None, po_quar=None,
             po_sends_w=None, po_deliv_w=None, po_retry_cap=None,
+        )
+
+    def clear_provenance(self) -> None:
+        """Drop tracked-rumor state a finished ``trace_rumors`` run left
+        on the net (``NetState.pv_*``) — required before a FRESH traced
+        run on the same cluster (armed slots would otherwise silently
+        extend the old wavefronts; resume keeps them on purpose)."""
+        self.net = self.net._replace(
+            pv_slot=None, pv_tickv=None, pv_wits=None,
+            pv_first=None, pv_parent=None, pv_knows=None,
+        )
+
+    def provenance_report(self) -> dict:
+        """The host-side provenance report from the last traced run's
+        planes on the net: per tracked rumor, the propagation tree
+        (first_heard/parent), the detection-causality chain, and the
+        infection-time stats vs the paper's log2(N) bound
+        (``obs.provenance.build_report``)."""
+        from ringpop_tpu.obs import provenance as pvn
+
+        if self.net.pv_slot is None:
+            raise ValueError(
+                "no provenance state on the net: run a scenario with "
+                "trace_rumors > 0 first"
+            )
+        return pvn.build_report(
+            self.net.pv_slot, self.net.pv_tickv, self.net.pv_wits,
+            self.net.pv_first, self.net.pv_parent, self.net.pv_knows,
+            self.n,
         )
 
     def set_period(self, period) -> None:

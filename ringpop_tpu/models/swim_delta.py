@@ -1441,7 +1441,7 @@ def _stage_issue_delta(
 
 def delta_step_impl(
     state: DeltaState, net: NetState, key: jax.Array, params: DeltaParams,
-    upto: int = 7, knobs: SwimKnobs | None = None,
+    upto: int = 7, knobs: SwimKnobs | None = None, prov: bool = False,
 ) -> tuple[DeltaState, dict[str, jax.Array]]:
     """One synchronized protocol period — the dense ``swim_step_impl``
     phase for phase (see its docstring for the reference parity map),
@@ -1451,12 +1451,27 @@ def delta_step_impl(
     on-device profiling aid (benchmarks/profile_delta.py): each prefix
     compiles as one executable, so consecutive differences attribute
     genuine device time per phase with no dispatch noise.  7 = the full
-    step (production value; anything else returns partial metrics)."""
+    step (production value; anything else returns partial metrics).
+
+    ``prov`` (static) exports the delivery-evidence bundle for the
+    provenance plane (``obs.provenance.EVIDENCE_KEYS``) — metrics-only,
+    the state trajectory and PRNG stream stay bit-identical.  The hop
+    masks already live outside the exchange conds here, so the export
+    is a relabeling, not a recompute (cf. the dense step's CSE note).
+    One documented deviation from the dense bundle: the full-sync base
+    flip stays in-tick even over a delayed ack link (it is a structural
+    flip, not a lane payload), so ``pv_ack`` includes ``fs_apply``."""
 
     def cut(st, **extra):
         m = {"pings_sent": jnp.zeros((), jnp.int32)}
         m.update(extra)
         return st, m
+
+    if prov and upto != 7:
+        raise ValueError(
+            "provenance evidence spans every phase; prov requires the "
+            "full step (upto=7)"
+        )
 
     if net.adj is not None and net.adj.ndim != 1:
         raise NotImplementedError(
@@ -2315,6 +2330,27 @@ def delta_step_impl(
     if has_delay:
         metrics["delayed_claims"] = delayed_claims
         metrics["matured_applied"] = mat_applied
+    if prov:
+        metrics.update(
+            pv_tgt=t_safe,
+            pv_send=sends,
+            # in-tick payload deliveries only (delayed claims park in
+            # the lanes; their eventual arrival has no in-tick edge)
+            pv_ping=(fwd_ok & ~dly3) if has_delay else fwd_ok,
+            # the full-sync flip applies in-tick even over a delayed
+            # link (see docstring) — fs_apply joins the ack edge set
+            pv_ack=((ack & ~dly4) | fs_apply) if has_delay else ack,
+            pv_wit=wit_safe,
+            pv_witv=wit_valid,
+            pv_req=req_del,
+            pv_rping=ping_del,
+            pv_rack=ack_del,
+            pv_resp=resp_del,
+            # ATTEMPTED declarations (the dense export is the applied
+            # mask); prov_update's post-view status gate filters the
+            # lattice-refused ones identically on both backends
+            pv_decl=dec_valid,
+        )
     return state, metrics
 
 
@@ -2344,7 +2380,9 @@ def _sort_claim_rows(
 
 
 delta_step = jax.jit(
-    delta_step_impl, static_argnames=("params", "upto"), donate_argnums=(0,)
+    delta_step_impl,
+    static_argnames=("params", "upto", "prov"),
+    donate_argnums=(0,),
 )
 
 

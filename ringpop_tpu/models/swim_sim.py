@@ -428,6 +428,19 @@ class NetState(NamedTuple):
     po_sends_w: jax.Array | None = None  # int32[W]
     po_deliv_w: jax.Array | None = None  # int32[W]
     po_retry_cap: jax.Array | None = None  # int32 scalar
+    # Gossip provenance plane (ringpop_tpu/obs/provenance; None unless
+    # a rumor-traced run ran/is running): the K tracked-rumor slots
+    # (subject/key/origin/resolution), their origin+resolution ticks,
+    # the origin's ping-req witness sets, the per-node first_heard and
+    # parent planes, and the packed knows bitplanes.  Same contract as
+    # ov_*/po_*: the step never reads these — the scenario scan carries
+    # them — and the None default keeps checkpoint v5 compatible.
+    pv_slot: jax.Array | None = None  # int32[K, 4]
+    pv_tickv: jax.Array | None = None  # int16[K, 2]
+    pv_wits: jax.Array | None = None  # int32[K, ping_req_size]
+    pv_first: jax.Array | None = None  # int16[K, N]
+    pv_parent: jax.Array | None = None  # int32[K, N]
+    pv_knows: jax.Array | None = None  # uint32[K, ceil(N/32)] packed
 
 
 def make_net(n: int, *, partitioned: bool = False) -> NetState:
@@ -1661,6 +1674,7 @@ def swim_step_impl(
     key: jax.Array,
     params: SwimParams,
     knobs: SwimKnobs | None = None,
+    prov: bool = False,
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """One synchronized protocol period for every virtual node.
 
@@ -1676,6 +1690,15 @@ def swim_step_impl(
     traced scalars — one compiled program serves every knob value (and
     every replica of a ``param_axes`` sweep); None compiles the exact
     legacy program.
+
+    ``prov`` (static) additionally exports the delivery-evidence bundle
+    the provenance plane folds (``obs.provenance.EVIDENCE_KEYS``):
+    which protocol edges DELIVERED a payload in-tick, the witness sets,
+    and the applied suspect declarations.  The flag changes only the
+    metrics dict — the state trajectory and every PRNG draw are
+    bit-identical to the off program (the ping-req relay masks are
+    state-independent, so re-deriving them here from the same
+    ``k_loss3`` stream costs one CSE'd recompute, not a new draw).
     """
     if params.sparse_cap:
         if knobs is not None:
@@ -1688,6 +1711,11 @@ def swim_step_impl(
             raise NotImplementedError(
                 "sparse_cap does not compose with the latency model "
                 "(ClusterState.pending); run delay scenarios dense"
+            )
+        if prov:
+            raise NotImplementedError(
+                "the provenance plane needs the dense delivery evidence; "
+                "run traced scenarios with sparse_cap=0"
             )
         return _swim_step_sparse(state, net, key, params)
     n = state.n
@@ -1932,6 +1960,62 @@ def swim_step_impl(
             dly4, dtype=jnp.int32
         )
         metrics["matured_applied"] = mat_applied
+    if prov:
+        # Delivery evidence for the provenance plane.  The four relay
+        # hop masks depend only on (net, sel, ack, k_loss3, params) —
+        # never on membership state — so re-deriving them from the same
+        # k_loss3 stream reproduces _phase5_pingreq's masks bit-for-bit
+        # (XLA CSEs the duplicate; the off-path program is untouched).
+        k_a, k_b, k_c, k_d = jax.random.split(k_loss3, 4)
+        kshape = (n, params.ping_req_size)
+        wit_safe = jnp.clip(sel.wit, 0, n - 1)
+        req_del = (
+            failed[:, None]
+            & sel.wit_valid
+            & _adj(net, ids[:, None], wit_safe)
+            & ~_drop_net(k_a, kshape, params.loss, net, ids[:, None], wit_safe)
+            & resp[wit_safe]
+        )
+        ping_del = (
+            req_del
+            & _adj(net, wit_safe, t_safe[:, None])
+            & ~_drop_net(
+                k_b, kshape, params.loss, net, wit_safe, t_safe[:, None]
+            )
+            & resp[t_safe][:, None]
+        )
+        ack_del = (
+            ping_del
+            & _adj(net, t_safe[:, None], wit_safe)
+            & ~_drop_net(
+                k_c, kshape, params.loss, net, t_safe[:, None], wit_safe
+            )
+        )
+        resp_del = (
+            req_del
+            & _adj(net, wit_safe, ids[:, None])
+            & ~_drop_net(k_d, kshape, params.loss, net, wit_safe, ids[:, None])
+        )
+        metrics.update(
+            pv_tgt=t_safe,
+            pv_send=sends,
+            # in-tick payload deliveries only: a delayed phase-3 claim
+            # (and the dense backend's delayed phase-4 reply, full
+            # syncs included) parks in the in-flight buffer — its
+            # eventual arrival has no attributable in-tick edge
+            pv_ping=fwd_ok & ~dly3,
+            pv_ack=ack & ~dly4,
+            pv_wit=wit_safe,
+            pv_witv=sel.wit_valid,
+            pv_req=req_del,
+            pv_rping=ping_del,
+            pv_rack=ack_del,
+            pv_resp=resp_del,
+            # APPLIED suspect declarations (the lattice accepted them);
+            # prov_update's post-view status gate makes the delta
+            # backend's attempted-mask export land on the same set
+            pv_decl=declared,
+        )
     return state, metrics
 
 
@@ -2308,7 +2392,9 @@ def swim_run_impl(
 
 
 # Jitted entry points; ``state`` is donated so long scans run in-place in HBM.
-swim_step = jax.jit(swim_step_impl, static_argnames=("params",), donate_argnums=(0,))
+swim_step = jax.jit(
+    swim_step_impl, static_argnames=("params", "prov"), donate_argnums=(0,)
+)
 swim_run = jax.jit(
     swim_run_impl, static_argnames=("params", "ticks"), donate_argnums=(0,)
 )

@@ -312,3 +312,20 @@ def replay_trace(
         sink.gauge("checksum", int(checksum))
         calls += 1
     return calls
+
+
+def emit_provenance(
+    report: dict[str, Any], emitter: Any, *, prefix: str = DEFAULT_PREFIX
+) -> int:
+    """Gauge the provenance plane's summary block (one value per
+    ``obs.provenance.summary_block`` field, ``sim.provenance.*`` keys —
+    sim-only: the reference has no rumor-level tracing namespace).
+    Returns the number of stat calls."""
+    from ringpop_tpu.obs.provenance import summary_block
+
+    sink = StatSink(emitter, prefix)
+    calls = 0
+    for name, value in summary_block(report).items():
+        sink.gauge(f"sim.provenance.{name.replace('_', '-')}", int(value))
+        calls += 1
+    return calls

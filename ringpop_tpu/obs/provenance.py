@@ -1,0 +1,429 @@
+"""Gossip provenance plane: rumor-level dissemination tracing.
+
+The scenario scan can track up to K *rumors* — a rumor is a
+``(subject, view_key)`` lattice point, e.g. "node 3 is SUSPECT at
+incarnation 2" — and record, per node, WHEN it first heard the rumor
+and WHO (plausibly) told it, entirely inside the jitted scan.  The
+answer to the operator question "why was node X declared faulty, and
+how long did that rumor take to reach the stragglers?" falls out as a
+propagation tree plus a detection-causality chain per tracked rumor.
+
+Semantics (the pinned conventions; tests/test_provenance.py holds the
+per-tick host oracle to them bit-for-bit):
+
+* **knows** is lattice dominance: node v knows rumor ``(s, k)`` iff its
+  post-tick view key of s is ``>= k``.  Hearing STRONGER news (the
+  faulty escalation ``k+1``, or a refutation at a higher incarnation)
+  counts as having heard — first_heard is a pure function of the view
+  trajectory, not of any payload bookkeeping.
+* **first_heard[v]** is the first tick at which v knows (int16 ticks;
+  the plane rejects runs of >= 32768 ticks).  -1 = never heard.
+  Knowledge that predates a slot's arming collapses to the arming
+  tick (a second, later-armed rumor may find believers on day one).
+* **parent[v]** is a deterministic "canonical plausible infector":
+  among this tick's *delivered* protocol edges whose sender knew the
+  rumor at the START of the tick, the first edge in intra-tick phase
+  order — direct ping (phase 3), ack/full-sync reply (phase 4), then
+  the four ping-req relay hops (5a source->witness, 5b witness->
+  target, 5c target->witness ack, 5d witness->source response) —
+  breaking ties inside a phase by minimum sender index.  The
+  attribution is payload-blind by design: the simulator's piggyback
+  budgets decide what a message CARRIES, but any delivered edge from a
+  knower is a plausible infection path, and the convention is exact,
+  cheap, and identical on both backends.  Sentinels: -1 = origin
+  (the declarer itself, or the subject — its own authority for
+  refute/revive news), -2 = heard but unattributed (delayed-lane
+  arrival, or a same-tick relay chain whose sender only learned this
+  tick), -3 = never heard.
+* **arming**: a slot arms on a *suspect declaration that stuck* (the
+  declarer's post-tick view of its target is SUSPECT/FAULTY at the
+  declared incarnation).  Faulty escalations are not separately
+  tracked — every FAULTY is preceded by the suspect rumor the slot
+  already holds, and the escalation is the slot's *resolution*.
+  ``track`` scenario ops reserve slot j for a named subject (armed by
+  the first qualifying declaration about it at tick >= ``at``); the
+  remaining free slots auto-arm, assigning same-tick new subjects in
+  ascending subject order.  Duplicate (subject, key) pairs never
+  double-arm.
+* **resolution** (the detection-causality chain): the slot records the
+  origin declarer, its probe tick (= declaration tick; the failed
+  probe, its witness set and the declaration share one tick by the
+  step's phase layout), the ping-req witness set, and the first tick
+  the cluster-wide view maximum of the subject escapes the suspect
+  key: ``>= key+7`` (= alive at the next incarnation) is a REFUTATION,
+  else ``>= key+1`` (faulty — or leave) is a CONFIRMATION.  A tick
+  where both appear resolves as refuted (the lattice winner).
+
+State rides the scan carry bit-packed: the knows planes are uint32
+words (``ops/bitpack``), and no leaf is bool (the carry-budget pin).
+``prov_update`` is the ONE int-exact update shared by the scan fold
+and the eager per-tick host oracle — the policy-plane precedent that
+makes bit-parity a property of the call graph instead of a test's
+luck.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.ops import bitpack
+
+# status bits of a view key (mirrors swim_sim; re-declared to keep this
+# module import-light for the host-side exporters)
+_SUSPECT = 2
+_FAULTY = 3
+
+# first_heard / parent sentinels (module docstring)
+UNHEARD = -1  # first_heard: never heard
+P_ORIGIN = -1  # parent: the rumor's own origin / the subject itself
+P_UNATTRIBUTED = -2  # parent: heard, but no in-tick edge explains it
+P_UNHEARD = -3  # parent: never heard
+
+# slot resolution states (pv_slot[:, 3])
+RES_PENDING = 0
+RES_REFUTED = 1
+RES_CONFIRMED = 2
+
+# pv_slot columns
+_C_SUBJ, _C_KEY, _C_ORG, _C_RES = 0, 1, 2, 3
+
+# the evidence keys both backend steps export when prov is armed
+EVIDENCE_KEYS = (
+    "pv_tgt", "pv_send", "pv_ping", "pv_ack", "pv_wit", "pv_witv",
+    "pv_req", "pv_rping", "pv_rack", "pv_resp", "pv_decl",
+)
+
+MAX_RUMORS = 64  # static slot cap (K*N int16+int32 planes ride the carry)
+MAX_TICKS = 32767  # int16 first_heard/tick range
+
+
+class ProvCarry(NamedTuple):
+    """The provenance scan carry — zero bool leaves (budget pin).
+
+    ``knows`` stays PACKED at rest (uint32 words, 1 bit per node) and is
+    unpacked only inside ``prov_update``; everything else is already
+    int.  K = tracked-rumor slots, N = nodes, kk = ping_req_size.
+    """
+
+    slot: jax.Array  # int32[K, 4]: subject(-1 unarmed), key, origin, res
+    tickv: jax.Array  # int16[K, 2]: (origin_tick, resolution_tick); -1
+    wits: jax.Array  # int32[K, kk]: origin's ping-req witness set; -1 pad
+    first: jax.Array  # int16[K, N]: first_heard ticks; -1 unheard
+    parent: jax.Array  # int32[K, N]: first infector; -3/-1/-2 sentinels
+    knows: jax.Array  # uint32[K, W]: packed knows plane
+
+
+def init_carry(n: int, k: int, k_wit: int) -> ProvCarry:
+    """A fresh all-unarmed carry for K rumor slots over N nodes."""
+    w = bitpack.packed_width(n)
+    return ProvCarry(
+        slot=jnp.concatenate(
+            [
+                jnp.full((k, 3), -1, jnp.int32),
+                jnp.zeros((k, 1), jnp.int32),
+            ],
+            axis=1,
+        ),
+        tickv=jnp.full((k, 2), -1, jnp.int16),
+        wits=jnp.full((k, k_wit), -1, jnp.int32),
+        first=jnp.full((k, n), UNHEARD, jnp.int16),
+        parent=jnp.full((k, n), P_UNHEARD, jnp.int32),
+        knows=jnp.zeros((k, w), jnp.uint32),
+    )
+
+
+def track_tensors(tracks: tuple, k: int) -> tuple[jax.Array, jax.Array]:
+    """``track`` op reservations as (pv_at, pv_node) int32[K] tensors.
+
+    ``tracks`` is the compiled tuple of (at, node) pairs; slot j holds
+    reservation j and unreserved slots pad with node -1 (free for
+    auto-arming)."""
+    at = np.full(k, 0, np.int32)
+    node = np.full(k, -1, np.int32)
+    for j, (a, m) in enumerate(tracks):
+        at[j] = a
+        node[j] = m
+    return jnp.asarray(at), jnp.asarray(node)
+
+
+def _attribute(ks: jax.Array, ev: dict[str, jax.Array], n: int) -> jax.Array:
+    """Canonical plausible infector per node for one rumor.
+
+    ``ks`` is the knows-at-tick-START plane; returns int32[N] sender
+    indices with ``n`` as the no-candidate sentinel.  Phase precedence
+    and min-sender tie-break per the module docstring; every scatter is
+    a ``.min`` onto the sentinel so the order is data-independent."""
+    ids = jnp.arange(n, dtype=jnp.int32)
+    sent = jnp.int32(n)
+    tgt = ev["pv_tgt"]
+    w = ev["pv_wit"]
+    tgt_b = jnp.broadcast_to(tgt[:, None], w.shape)
+    # phase 3: prober v -> its target (in-tick payload deliveries only)
+    c3 = jnp.full((n,), sent).at[tgt].min(
+        jnp.where(ev["pv_ping"] & ks, ids, sent)
+    )
+    # phase 4: the target's ack/full-sync reply back to v (elementwise)
+    c4 = jnp.where(ev["pv_ack"] & ks[tgt], tgt, sent)
+    # phase 5a: ping-req source v -> witness
+    c5a = jnp.full((n,), sent).at[w].min(
+        jnp.where(ev["pv_req"] & ks[:, None], ids[:, None], sent)
+    )
+    # phase 5b: witness -> target relay ping
+    c5b = jnp.full((n,), sent).at[tgt_b].min(
+        jnp.where(ev["pv_rping"] & ks[w], w, sent)
+    )
+    # phase 5c: target -> witness relay ack
+    c5c = jnp.full((n,), sent).at[w].min(
+        jnp.where(ev["pv_rack"] & ks[tgt][:, None], tgt_b, sent)
+    )
+    # phase 5d: witness -> source response
+    c5d = jnp.min(jnp.where(ev["pv_resp"] & ks[w], w, sent), axis=1)
+    out = c3
+    for c in (c4, c5a, c5b, c5c, c5d):
+        out = jnp.where(out < sent, out, c)
+    return out
+
+
+def prov_update(
+    pvc: ProvCarry,
+    ev: dict[str, jax.Array],
+    tick: jax.Array,
+    view_post: Callable[[jax.Array], jax.Array],
+    pv_at: jax.Array,
+    pv_node: jax.Array,
+    n: int,
+) -> tuple[ProvCarry, jax.Array]:
+    """One tick of the provenance fold (scan body AND host oracle).
+
+    ``ev`` is the step's delivery-evidence bundle (EVIDENCE_KEYS);
+    ``view_post`` maps viewer-major subject queries int32[N, M] to the
+    POST-tick view keys int32[N, M] (dense: a take_along_axis of
+    view_key; delta: ``view_lookup``).  Returns the next carry and the
+    per-slot heard count int32[K] (the ``pv_heard`` telemetry plane).
+    """
+    k = pvc.slot.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    tick = jnp.asarray(tick, jnp.int32)
+    t16 = tick.astype(jnp.int16)
+
+    # -- origin gate: suspect declarations that stuck -------------------
+    # The declared key is recovered from the declarer's post-tick view:
+    # an applied declaration leaves (inc*8+SUSPECT) — or its same-tick
+    # FAULTY escalation at suspicion_ticks=0, which shares the inc — so
+    # (view >> 3) * 8 + SUSPECT IS the declared key; a declaration the
+    # lattice refused (already refuted at a higher incarnation) leaves
+    # an ALIVE status and is filtered here.
+    tgt = ev["pv_tgt"]
+    post_t = view_post(tgt[:, None])[:, 0]
+    st8 = post_t & 7
+    dkey = (post_t >> 3) * 8 + jnp.int32(_SUSPECT)
+    decl = ev["pv_decl"] & ((st8 == _SUSPECT) | (st8 == _FAULTY)) & (tgt != ids)
+
+    # -- arming ---------------------------------------------------------
+    armed = pvc.slot[:, _C_SUBJ] >= 0
+    dup = jnp.any(
+        armed[None, :]
+        & (tgt[:, None] == pvc.slot[None, :, _C_SUBJ])
+        & (dkey[:, None] == pvc.slot[None, :, _C_KEY]),
+        axis=1,
+    )
+    cand = decl & ~dup
+    # per-subject aggregation: the rumor key is the max declared key and
+    # the origin the min declarer index (simultaneous declarers)
+    s_idx = jnp.where(cand, tgt, n)
+    key_by = jnp.full((n,), -1, jnp.int32).at[s_idx].max(dkey, mode="drop")
+    org_by = jnp.full((n,), n, jnp.int32).at[s_idx].min(ids, mode="drop")
+    has_subj = key_by >= 0
+    # reserved slots fire first (track ops pin slot j to a subject)
+    rsv_subj = jnp.clip(pv_node, 0, n - 1)
+    rsv_fire = (
+        (~armed) & (pv_node >= 0) & (tick >= pv_at) & has_subj[rsv_subj]
+    )
+    consumed = (
+        jnp.zeros((n,), bool)
+        .at[jnp.where(rsv_fire, rsv_subj, n)]
+        .set(True, mode="drop")
+    )
+    # free slots auto-arm the remaining new subjects in ascending order
+    rem = has_subj & ~consumed
+    s_rank = jnp.cumsum(rem.astype(jnp.int32)) - 1
+    subj_by_rank = (
+        jnp.full((k,), -1, jnp.int32)
+        .at[jnp.where(rem, s_rank, k)]
+        .set(ids, mode="drop")
+    )
+    free = (~armed) & (pv_node < 0)
+    f_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    auto_subj = jnp.where(free, subj_by_rank[jnp.clip(f_rank, 0, k - 1)], -1)
+    new_subj = jnp.where(rsv_fire, rsv_subj, auto_subj)
+    arm_now = new_subj >= 0
+    safe_new = jnp.clip(new_subj, 0, n - 1)
+    new_org = org_by[safe_new]
+    org_safe = jnp.clip(new_org, 0, n - 1)
+    new_wits = jnp.where(ev["pv_witv"][org_safe], ev["pv_wit"][org_safe], -1)
+    slot = jnp.where(
+        arm_now[:, None],
+        jnp.stack(
+            [new_subj, key_by[safe_new], new_org, jnp.zeros((k,), jnp.int32)],
+            axis=1,
+        ),
+        pvc.slot,
+    )
+    tickv = jnp.where(
+        arm_now[:, None],
+        jnp.stack([jnp.full((k,), 1, jnp.int16) * t16,
+                   jnp.full((k,), -1, jnp.int16)], axis=1),
+        pvc.tickv,
+    )
+    wits = jnp.where(arm_now[:, None], new_wits, pvc.wits)
+
+    # -- knows / first_heard / parent -----------------------------------
+    subj = slot[:, _C_SUBJ]
+    keyv = slot[:, _C_KEY]
+    armed2 = subj >= 0
+    q = jnp.broadcast_to(jnp.clip(subj, 0, n - 1)[None, :], (n, k))
+    col = view_post(q)  # [N, K] viewer-major post views of each subject
+    knows_new = (armed2[None, :] & (col >= keyv[None, :])).T  # [K, N]
+    knows_old = bitpack.unpack_bits(pvc.knows, n)  # [K, N]
+    newly = knows_new & ~knows_old
+    cand_p = jax.vmap(lambda ks: _attribute(ks, ev, n))(knows_old)  # [K, N]
+    origin_sig = (ids[None, :] == subj[:, None]) | (
+        decl[None, :]
+        & (tgt[None, :] == subj[:, None])
+        & (dkey[None, :] == keyv[:, None])
+    )
+    parent_new = jnp.where(
+        origin_sig,
+        jnp.int32(P_ORIGIN),
+        jnp.where(cand_p < n, cand_p, jnp.int32(P_UNATTRIBUTED)),
+    )
+    parent = jnp.where(newly, parent_new, pvc.parent)
+    first = jnp.where(newly, t16, pvc.first)
+
+    # -- resolution ------------------------------------------------------
+    mx = jnp.max(jnp.where(armed2[None, :], col, -1), axis=0)  # [K]
+    pend = armed2 & (slot[:, _C_RES] == RES_PENDING)
+    res_new = jnp.where(
+        mx >= keyv + 7,
+        jnp.int32(RES_REFUTED),
+        jnp.where(mx >= keyv + 1, jnp.int32(RES_CONFIRMED),
+                  jnp.int32(RES_PENDING)),
+    )
+    fire = pend & (res_new != RES_PENDING)
+    slot = slot.at[:, _C_RES].set(
+        jnp.where(fire, res_new, slot[:, _C_RES])
+    )
+    tickv = tickv.at[:, 1].set(jnp.where(fire, t16, tickv[:, 1]))
+
+    heard = jnp.sum(knows_new, axis=1, dtype=jnp.int32)
+    return (
+        ProvCarry(slot, tickv, wits, first, parent,
+                  bitpack.pack_bits(knows_new)),
+        heard,
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side report
+# ---------------------------------------------------------------------------
+
+
+def _pct(times: np.ndarray, q: float) -> int:
+    """All-int lower-percentile over a nonempty int array."""
+    s = np.sort(times)
+    idx = min(len(s) - 1, max(0, int(np.ceil(q * len(s))) - 1))
+    return int(s[idx])
+
+
+def build_report(
+    pv_slot: Any,
+    pv_tickv: Any,
+    pv_wits: Any,
+    pv_first: Any,
+    pv_parent: Any,
+    pv_knows: Any,
+    n: int,
+) -> dict[str, Any]:
+    """The host-side provenance report from the final net's pv tensors.
+
+    Per armed slot: the rumor identity, its causality chain, the full
+    propagation tree (tick-ordered parent edges — a parent always heard
+    strictly earlier, so one pass assigns depths), infection-time
+    percentiles vs the paper's log2(N) bound, and straggler counts.
+    Everything is an int (golden-pinnable)."""
+    slot = np.asarray(pv_slot)
+    tickv = np.asarray(pv_tickv).astype(np.int32)
+    wits = np.asarray(pv_wits)
+    first = np.asarray(pv_first).astype(np.int32)
+    parent = np.asarray(pv_parent)
+    del pv_knows  # knows == (first >= 0) by construction
+    log2n = int(np.ceil(np.log2(max(2, n))))
+    rumors = []
+    for j in range(slot.shape[0]):
+        if slot[j, _C_SUBJ] < 0:
+            continue
+        fh = first[j]
+        par = parent[j]
+        heard = fh >= 0
+        origin_tick = int(tickv[j, 0])
+        times = (fh[heard] - origin_tick).astype(np.int64)
+        # depth: process heard nodes in first_heard order; parents heard
+        # strictly earlier (knows-at-start attribution), origins depth 0
+        depth = np.full(n, -1, np.int64)
+        for v in np.lexsort((np.arange(n), np.where(heard, fh, 1 << 30))):
+            if not heard[v]:
+                break
+            p = par[v]
+            if p == P_ORIGIN:
+                depth[v] = 0
+            elif p >= 0 and depth[p] >= 0:
+                depth[v] = depth[p] + 1
+        infected = int(heard.sum())
+        rumors.append(
+            {
+                "slot": j,
+                "subject": int(slot[j, _C_SUBJ]),
+                "key": int(slot[j, _C_KEY]),
+                "origin": int(slot[j, _C_ORG]),
+                "origin_tick": origin_tick,
+                "resolution": int(slot[j, _C_RES]),
+                "resolution_tick": int(tickv[j, 1]),
+                "witnesses": [int(w) for w in wits[j] if w >= 0],
+                "infected": infected,
+                "unheard": n - infected,
+                "unattributed": int((par[heard] == P_UNATTRIBUTED).sum()),
+                "depth_max": int(depth.max()) if infected else -1,
+                "infection_p50": _pct(times, 0.50) if infected else -1,
+                "infection_p95": _pct(times, 0.95) if infected else -1,
+                "infection_p99": _pct(times, 0.99) if infected else -1,
+                "stragglers": int((times > 2 * log2n).sum()),
+                "first_heard": fh.tolist(),
+                "parent": par.tolist(),
+            }
+        )
+    return {"n": n, "log2_n": log2n, "rumors": rumors}
+
+
+def summary_block(report: dict[str, Any]) -> dict[str, int]:
+    """The all-int aggregate block ``library.incident_summary`` embeds
+    (worst-case over rumors, so the pin catches any slot regressing)."""
+    rs = report["rumors"]
+    if not rs:
+        return {"rumors": 0}
+    return {
+        "rumors": len(rs),
+        "confirmed": sum(1 for r in rs if r["resolution"] == RES_CONFIRMED),
+        "refuted": sum(1 for r in rs if r["resolution"] == RES_REFUTED),
+        "infected_min": min(r["infected"] for r in rs),
+        "infected_max": max(r["infected"] for r in rs),
+        "depth_max": max(r["depth_max"] for r in rs),
+        "p50_max": max(r["infection_p50"] for r in rs),
+        "p95_max": max(r["infection_p95"] for r in rs),
+        "p99_max": max(r["infection_p99"] for r in rs),
+        "stragglers": sum(r["stragglers"] for r in rs),
+        "unattributed": sum(r["unattributed"] for r in rs),
+    }

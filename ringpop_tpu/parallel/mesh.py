@@ -115,6 +115,15 @@ NET_FIELD_SPECS: dict[str, str] = {
     "po_sends_w": _REP,
     "po_deliv_w": _REP,
     "po_retry_cap": _REP,
+    # provenance plane residue (obs/provenance.py): O(K x N) report
+    # tensors, read host-side only — replicated; the sharded step never
+    # updates them (the plane runs in the scenario scan, not the step)
+    "pv_slot": _REP,
+    "pv_tickv": _REP,
+    "pv_wits": _REP,
+    "pv_first": _REP,
+    "pv_parent": _REP,
+    "pv_knows": _REP,
 }
 
 DELTA_FIELD_SPECS: dict[str, str] = {

@@ -70,6 +70,11 @@ class CompiledScenario(NamedTuple):
     # hashable -> a jit-static of the scan); None = no overload event
     # and the compiled program carries no overload state at all
     overload: Any | None = None
+    # provenance plane (obs/provenance.py): tracked-rumor slot count
+    # (the plane's static width; 0 = legacy program, no plane) and the
+    # track-op reservations as (at, node) pairs in slot order
+    trace_rumors: int = 0
+    tracks: tuple[tuple[int, int], ...] = ()
 
 
 def expand_events(
@@ -107,6 +112,10 @@ def expand_events(
             pass  # static config (faults.overload_config); the update
             # is per-tick in-scan state, not a timeline op, and the
             # host oracle carries it tick-by-tick itself — no marker
+        elif e.op == "track":
+            pass  # observation op: a compile-time slot reservation
+            # (CompiledScenario.tracks), never a timeline op — no
+            # boundary, so the key schedule is untouched (host parity)
         else:
             out.append((e.at, e.op, e.node))
     out.extend(
@@ -166,6 +175,10 @@ def compile_spec(
         has_gray=ft is not None and bool(ft.pe_tick.shape[0]),
         delay_depth=sfaults.delay_depth(spec),
         overload=sfaults.overload_config(spec),
+        trace_rumors=spec.trace_rumors,
+        tracks=tuple(
+            (e.at, e.node) for e in spec.events if e.op == "track"
+        ),
     )
 
 

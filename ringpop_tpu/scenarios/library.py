@@ -398,7 +398,7 @@ def format_catalog() -> str:
 SUMMARY_SCHEMA = 1
 
 
-def incident_summary(trace: Any) -> dict[str, int]:
+def incident_summary(trace: Any, prov: Any | None = None) -> dict[str, int]:
     """One incident run's detect/heal/serve summary — every value an
     exact int so the golden files under ``tests/golden/incidents/``
     pin bit-equality, not tolerances.
@@ -408,7 +408,12 @@ def incident_summary(trace: Any) -> dict[str, int]:
     the end, -1 if never), ``final_live``, the serving totals
     (``sends`` = handled_local + proxy_sends + proxy_retries, the
     amplification numerator), the latency percentile floors in ms,
-    and the overload peaks when the feedback loop ran."""
+    and the overload peaks when the feedback loop ran.
+
+    ``prov`` (a ``obs.provenance.build_report`` dict from a traced
+    run) embeds the plane's all-int aggregate as ``pv_*`` keys — the
+    dissemination scorecard (infection depth / percentiles vs the
+    paper's log2(N) bound) pinned right next to detect/heal."""
     m = trace.metrics
     hits = np.flatnonzero(m["faulty_declared"] > 0)
     detect = int(hits[0]) if hits.size else -1
@@ -458,6 +463,11 @@ def incident_summary(trace: Any) -> dict[str, int]:
         out["policy_shed_peak"] = int(m["policy_shed_nodes"].max())
         out["policy_retry_cap_min"] = int(m["policy_retry_cap"].min())
         out["policy_amp_peak_x16"] = int(m["policy_amp_x16"].max())
+    if prov is not None:
+        from ringpop_tpu.obs.provenance import summary_block
+
+        for key, value in summary_block(prov).items():
+            out[f"pv_{key}"] = int(value)
     return out
 
 
@@ -485,6 +495,11 @@ def format_summary(name: str, summary: dict[str, int]) -> str:
     if "policy_shed" in s:
         parts.append(f"shed {s['policy_shed']}")
         parts.append(f"peak quarantine {s['policy_quar_peak']}")
+    if s.get("pv_rumors"):
+        parts.append(
+            f"rumors {s['pv_rumors']} (depth {s['pv_depth_max']}, "
+            f"infect p99 {s['pv_p99_max']}t)"
+        )
     return ", ".join(parts)
 
 

@@ -43,6 +43,7 @@ from ringpop_tpu.scenarios.compile import (
     CompiledScenario,
     expand_events,
 )
+from ringpop_tpu.obs import provenance as pvn
 from ringpop_tpu.policies import core as pol
 from ringpop_tpu.scenarios import faults as sfaults
 from ringpop_tpu.scenarios.spec import ScenarioSpec
@@ -272,6 +273,70 @@ def policy_traffic(traffic: Any | None, policy: Any | None) -> Any:
     )
 
 
+def precheck_prov(
+    compiled: CompiledScenario,
+    net: NetState,
+    params: Any | None = None,
+    *,
+    standing_ok: bool = False,
+) -> None:
+    """Static rejections of the provenance plane, callable before any
+    PRNG key is drawn (the ``precheck`` contract).  The plane folds the
+    dense delivery-evidence bundle, which the sparse-dissemination
+    program never materializes; and a net carrying tracked-rumor state
+    from a previous run would silently extend the old wavefronts —
+    reject unless resuming (``standing_ok``), whose net carries this
+    very run's own mid-flight planes."""
+    if not compiled.trace_rumors:
+        return
+    sw = getattr(params, "swim", params)
+    if sw is not None and getattr(sw, "sparse_cap", 0):
+        raise NotImplementedError(
+            "trace_rumors needs the dense delivery evidence; run traced "
+            "scenarios with sparse_cap=0"
+        )
+    if not standing_ok and net.pv_slot is not None:
+        if bool((np.asarray(net.pv_slot)[:, 0] >= 0).any()):
+            raise ValueError(
+                "the cluster carries tracked-rumor state from a previous "
+                "run (net.pv_*): clear_provenance() first, or resume the "
+                "run that wrote it"
+            )
+
+
+def prepare_prov(
+    compiled: CompiledScenario, net: NetState, params: Any | None = None
+) -> tuple[Any, jax.Array | None, jax.Array | None]:
+    """The initial provenance carry + track-reservation tensors —
+    all-unarmed slots for a fresh run, or the net's checkpointed
+    mid-flight planes on resume (the prepare_faults/prepare_policy
+    contract).  Returns ``(ProvCarry | None, pv_at, pv_node)``."""
+    if not compiled.trace_rumors:
+        return None, None, None
+    k = compiled.trace_rumors
+    sw = getattr(params, "swim", params)
+    kk = int(getattr(sw, "ping_req_size", 3))
+    if net.pv_slot is not None:
+        if net.pv_slot.shape[0] != k:
+            raise ValueError(
+                f"the cluster carries {net.pv_slot.shape[0]} tracked-rumor "
+                f"slots but this scenario compiles {k}; clear_provenance() "
+                "or match trace_rumors"
+            )
+        pvc = pvn.ProvCarry(
+            slot=jnp.asarray(net.pv_slot, jnp.int32),
+            tickv=jnp.asarray(net.pv_tickv, jnp.int16),
+            wits=jnp.asarray(net.pv_wits, jnp.int32),
+            first=jnp.asarray(net.pv_first, jnp.int16),
+            parent=jnp.asarray(net.pv_parent, jnp.int32),
+            knows=jnp.asarray(net.pv_knows, jnp.uint32),
+        )
+    else:
+        pvc = pvn.init_carry(compiled.n, k, kk)
+    pv_at, pv_node = pvn.track_tensors(compiled.tracks, k)
+    return pvc, pv_at, pv_node
+
+
 def prepare_policy(
     policy: Any | None, net: NetState, n: int, max_retries: int
 ) -> tuple | None:
@@ -346,12 +411,16 @@ def _scenario_scan_impl(
     po=None,
     po_knobs=None,
     sw_knobs=None,
+    pv=None,
+    pv_at=None,
+    pv_node=None,
     *,
     params,
     has_revive: bool,
     traffic=None,
     overload=None,
     policy=None,
+    prov: int | None = None,
 ):
     # ``tick0`` (traced int32 scalar, or None for 0) offsets the tick
     # counter the event/partition/traffic comparisons see: a streamed
@@ -368,7 +437,7 @@ def _scenario_scan_impl(
     def body(carry, xs):
         # node-bit planes ride the carry bit-packed (uint32 words, 1
         # bit/node); all in-tick work runs on the unpacked bool form
-        st, pu, pr, gid, per, ovc, poc = carry
+        st, pu, pr, gid, per, ovc, poc, pvc = carry
         u = bitpack.unpack_bits(pu, n)
         r = bitpack.unpack_bits(pr, n)
         if overload is not None:
@@ -440,12 +509,14 @@ def _scenario_scan_impl(
         if is_delta:
             sp = params._replace(swim=params.swim._replace(loss=loss_t))
             st, metrics = sdelta.delta_step_impl(st, net, key, sp,
-                                                 knobs=sw_knobs)
+                                                 knobs=sw_knobs,
+                                                 prov=prov is not None)
             conv = sdelta._converged_impl(st, u, r)
             own = sdelta.view_lookup(st, ids) & 7
         else:
             sp = params._replace(loss=loss_t)
-            st, metrics = sim.swim_step_impl(st, net, key, sp, sw_knobs)
+            st, metrics = sim.swim_step_impl(st, net, key, sp, sw_knobs,
+                                             prov is not None)
             conv = sim.converged_impl(st, net)
             own = jnp.diagonal(st.view_key) & 7
         live = jnp.sum(
@@ -456,6 +527,21 @@ def _scenario_scan_impl(
         y["converged"] = conv
         y["live"] = live
         y["loss"] = loss_t
+        if prov is not None:
+            # the provenance fold consumes the step's delivery-evidence
+            # bundle in place (never stacked into the telemetry) and
+            # emits the per-slot heard count as its one [K] plane
+            ev = {k: y.pop(k) for k in pvn.EVIDENCE_KEYS}
+            if is_delta:
+                view_post = lambda q: sdelta.view_lookup(st, q)  # noqa: E731
+            else:
+                view_post = lambda q: jnp.take_along_axis(  # noqa: E731
+                    st.view_key, q, axis=1
+                )
+            pvc, heard = pvn.prov_update(
+                pvc, ev, t, view_post, pv_at, pv_node, n
+            )
+            y["pv_heard"] = heard
         if traffic is not None:
             # serve this tick's key batch against the views the protocol
             # period just produced: lookups under churn, in the same
@@ -514,7 +600,7 @@ def _scenario_scan_impl(
                    bitpack.pack_bits(po_quar), po_sends_w, po_deliv_w,
                    po_cap)
         return (st, bitpack.pack_bits(u), bitpack.pack_bits(r), gid, per,
-                ovc, poc), y
+                ovc, poc, pvc), y
 
     t_idx = jnp.arange(ticks, dtype=jnp.int32)
     if tick0 is not None:
@@ -525,10 +611,13 @@ def _scenario_scan_impl(
         po[0], bitpack.pack_bits(po[1]), bitpack.pack_bits(po[2]),
         po[3], po[4], po[5],
     )
-    (state, pu, pr, adj, period, ov_c, po_c), ys = jax.lax.scan(
+    # the provenance carry arrives pre-packed (ProvCarry: the knows
+    # planes are uint32 words at rest, no bool leaves) — no boundary
+    # pack/unpack like the node-bit planes
+    (state, pu, pr, adj, period, ov_c, po_c, pv), ys = jax.lax.scan(
         body,
         (state, bitpack.pack_bits(up), bitpack.pack_bits(responsive), adj,
-         period, ov_c, po_c),
+         period, ov_c, po_c, pv),
         xs,
     )
     up = bitpack.unpack_bits(pu, n)
@@ -541,12 +630,14 @@ def _scenario_scan_impl(
     # period stays int16 on exit: the streamed runner threads this
     # return straight into the next segment's dispatch, so widening
     # here would retrace the one compiled executable
-    return state, up, responsive, adj, period, ov, po, ys
+    return state, up, responsive, adj, period, ov, po, pv, ys
 
 
 _scenario_scan = jax.jit(
     _scenario_scan_impl,
-    static_argnames=("params", "has_revive", "traffic", "overload", "policy"),
+    static_argnames=(
+        "params", "has_revive", "traffic", "overload", "policy", "prov"
+    ),
     donate_argnums=(0, 1, 2, 3),
 )
 
@@ -663,9 +754,11 @@ def run_compiled(
         adj = precheck(state, net, compiled, params)
         precheck_overload(compiled, traffic, net)
         precheck_policy(policy, traffic, net)
+        precheck_prov(compiled, net, params)
     traffic = overload_traffic(traffic, compiled)
     traffic = policy_traffic(traffic, policy)
     state, period, ov = prepare_faults(state, net, compiled, params)
+    pv, pv_at, pv_node = prepare_prov(compiled, net, params)
     po = None
     knobs = None
     if policy is not None:
@@ -696,10 +789,12 @@ def run_compiled(
         meta["policy"] = policy.name
     if param_knobs is not None:
         meta["param_knobs"] = sorted(param_knobs)
+    if compiled.trace_rumors:
+        meta["trace_rumors"] = compiled.trace_rumors
     # ledger-off (the default): dispatch() is a plain call-through; on,
     # the dispatch is recorded with its compile/execute split and AOT
     # memory footprint (obs/ledger.py)
-    state, up, resp, adj, period, ov, po, ys = default_ledger().dispatch(
+    state, up, resp, adj, period, ov, po, pv, ys = default_ledger().dispatch(
         "run_scenario",
         _scenario_scan,
         state,
@@ -721,14 +816,22 @@ def run_compiled(
         po,
         knobs,
         sw_knobs,
+        pv,
+        pv_at,
+        pv_node,
         params=params,
         has_revive=compiled.has_revive,
         traffic=traffic.static if traffic is not None else None,
         overload=compiled.overload,
         policy=policy.config if policy is not None else None,
+        prov=compiled.trace_rumors or None,
         _meta=meta,
     )
-    return state, final_net(up, resp, adj, period, compiled, ov=ov, po=po), ys
+    return (
+        state,
+        final_net(up, resp, adj, period, compiled, ov=ov, po=po, pv=pv),
+        ys,
+    )
 
 
 def prepare_faults(
@@ -795,6 +898,7 @@ def final_net(
     compiled: CompiledScenario,
     ov: tuple[jax.Array, jax.Array] | None = None,
     po: tuple | None = None,
+    pv: Any | None = None,
 ) -> NetState:
     """The post-run NetState, link rules mirrored to their state at the
     final tick — exactly what the host loop's last ``faultcfg`` apply
@@ -824,6 +928,13 @@ def final_net(
         kw.update(
             po_press=po[0], po_shed=po[1], po_quar=po[2],
             po_sends_w=po[3], po_deliv_w=po[4], po_retry_cap=po[5],
+        )
+    if pv is not None:
+        # and for the provenance carry (ProvCarry leaf order; knows
+        # stays packed — it is packed words at rest everywhere)
+        kw.update(
+            pv_slot=pv.slot, pv_tickv=pv.tickv, pv_wits=pv.wits,
+            pv_first=pv.first, pv_parent=pv.parent, pv_knows=pv.knows,
         )
     return NetState(up=up, responsive=resp, adj=adj, period=period, **kw)
 

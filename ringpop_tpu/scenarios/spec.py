@@ -91,7 +91,16 @@ from typing import Any, NamedTuple
 _NODE_OPS = ("kill", "revive", "suspend", "resume")
 _FAULT_OPS = ("link_loss", "delay", "flap", "gray", "rolling_restart",
               "overload")
-_OPS = _NODE_OPS + ("partition", "heal", "loss", "loss_ramp") + _FAULT_OPS
+# observation ops: no protocol effect, no event tensor — compile-time
+# configuration for the provenance plane (obs/provenance.py).  ``track``
+# reserves a tracked-rumor slot for ``node``: the slot arms at the first
+# qualifying suspect declaration about that subject at tick >= ``at``.
+# Requires ``trace_rumors > 0`` on the spec.
+_OBS_OPS = ("track",)
+_OPS = (
+    _NODE_OPS + ("partition", "heal", "loss", "loss_ramp")
+    + _FAULT_OPS + _OBS_OPS
+)
 
 # ops that take a p value under the JSON key "p" (loss_ramp uses "to")
 _P_OPS = ("loss", "link_loss", "delay")
@@ -214,9 +223,19 @@ def expand_fault_primitives(e: Event, ticks: int) -> list[Event]:
 class ScenarioSpec(NamedTuple):
     ticks: int
     events: tuple[Event, ...] = ()
+    # provenance plane (obs/provenance.py): number of tracked-rumor
+    # slots to carry through the scan.  0 (the default) compiles the
+    # exact legacy program — the plane doesn't exist.
+    trace_rumors: int = 0
 
     def to_dict(self) -> dict[str, Any]:
-        return {"ticks": self.ticks, "events": [e.to_dict() for e in self.events]}
+        d: dict[str, Any] = {
+            "ticks": self.ticks,
+            "events": [e.to_dict() for e in self.events],
+        }
+        if self.trace_rumors:
+            d["trace_rumors"] = self.trace_rumors
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)
@@ -226,6 +245,7 @@ class ScenarioSpec(NamedTuple):
         return cls(
             ticks=int(d["ticks"]),
             events=tuple(Event.from_dict(e) for e in d.get("events", [])),
+            trace_rumors=int(d.get("trace_rumors", 0)),
         )
 
     @classmethod
@@ -243,8 +263,31 @@ class ScenarioSpec(NamedTuple):
 
     def validate(self, n: int) -> "ScenarioSpec":
         """Static validation against a cluster size; raises ValueError."""
+        from ringpop_tpu.obs import provenance as _prov
+
         if self.ticks < 1:
             raise ValueError(f"ticks must be >= 1 (got {self.ticks})")
+        if self.trace_rumors < 0 or self.trace_rumors > _prov.MAX_RUMORS:
+            raise ValueError(
+                f"trace_rumors must be in [0, {_prov.MAX_RUMORS}] "
+                f"(got {self.trace_rumors})"
+            )
+        if self.trace_rumors and self.ticks > _prov.MAX_TICKS:
+            raise ValueError(
+                f"the provenance plane carries int16 ticks: trace_rumors "
+                f"needs ticks <= {_prov.MAX_TICKS} (got {self.ticks})"
+            )
+        n_track = sum(1 for e in self.events if e.op == "track")
+        if n_track and not self.trace_rumors:
+            raise ValueError(
+                "track events need trace_rumors > 0 on the spec (the "
+                "slot count is the compiled plane's static width)"
+            )
+        if n_track > self.trace_rumors:
+            raise ValueError(
+                f"{n_track} track events exceed trace_rumors="
+                f"{self.trace_rumors} slots"
+            )
         seen_node_tick: set[tuple[int, int]] = set()
         seen_part_tick: set[int] = set()
 
@@ -359,6 +402,19 @@ class ScenarioSpec(NamedTuple):
                     raise ValueError(
                         f"overload needs factor >= 2 (got {e.factor}; "
                         "1 would degrade nothing)"
+                    )
+            elif e.op == "track":
+                if e.node is None or not 0 <= e.node < n:
+                    raise ValueError(
+                        f"track needs a node in [0, {n}) (got {e.node})"
+                    )
+                if sum(
+                    1 for o in self.events
+                    if o.op == "track" and o.node == e.node
+                ) > 1:
+                    raise ValueError(
+                        f"duplicate track reservations for node {e.node}: "
+                        "a subject's rumor slot arms once"
                     )
             elif e.op in ("link_loss", "delay"):
                 check_window(e, e.op)

@@ -340,6 +340,7 @@ def run_streamed(
     if policy is not None and traffic is not None:
         policy = pol.compile_policy(policy, n=cluster.n, m=traffic.static.m)
     srunner.precheck_policy(policy, traffic, cluster.net)
+    srunner.precheck_prov(compiled, cluster.net, params_pre)
     if checkpoint_path and store is None:
         # resume must be able to reassemble the full trace, so a
         # checkpointed run always persists its slabs
@@ -471,6 +472,13 @@ def resume(
         else None
     )
     srunner.precheck_policy(policy, traffic, cluster.net, standing_ok=True)
+    # ... and for the provenance carry: the checkpointed net's pv_*
+    # planes ARE this run's mid-flight wavefronts, resumed verbatim
+    srunner.precheck_prov(
+        compiled, cluster.net,
+        cluster.dparams if cluster.backend == "delta" else cluster.params,
+        standing_ok=True,
+    )
     # cluster.key already holds the post-schedule key (the schedule was
     # fully drawn before the first segment); derive the schedule again
     # from the recorded start key without touching it
@@ -536,8 +544,9 @@ def _drive(
         static_traffic.max_retries if static_traffic is not None else 0,
     )
     knobs = pol.knob_arrays(policy) if policy is not None else None
+    pv0, pv_at, pv_node = srunner.prepare_prov(compiled, cluster.net, params)
     carry = (f_state, cluster.net.up, cluster.net.responsive, adj, period0,
-             ov0, po0)
+             ov0, po0, pv0)
     pending: tuple | None = None
     slabs: list[Trace] = []  # only populated when there is no store
     state = {"prev_live": cursor.get("prev_live"), "last_slab": None,
@@ -574,6 +583,10 @@ def _drive(
             carry[5],  # the overload feedback carry (or None)
             carry[6],  # the remediation policy carry (or None)
             knobs,
+            None,  # sw_knobs: param_knobs is not wired streamed
+            carry[7],  # the provenance carry (ProvCarry or None)
+            pv_at,
+            pv_node,
         )
         statics = dict(
             params=params,
@@ -581,6 +594,7 @@ def _drive(
             traffic=static_traffic,
             overload=compiled.overload,
             policy=policy.config if policy is not None else None,
+            prov=compiled.trace_rumors or None,
         )
         srunner._dispatches += 1
         t0 = time.perf_counter()
@@ -666,6 +680,7 @@ def _drive(
             # still overlap this segment's compute)
             ov_snap = carry[5]
             po_snap = carry[6]
+            pv_snap = carry[7]
             po_kw = {}
             if po_snap is not None:
                 po_kw = dict(
@@ -675,6 +690,17 @@ def _drive(
                     po_sends_w=np.asarray(po_snap[3]),
                     po_deliv_w=np.asarray(po_snap[4]),
                     po_retry_cap=np.asarray(po_snap[5]),
+                )
+            if pv_snap is not None:
+                # knows stays packed in the checkpoint too — it is
+                # uint32 words at rest everywhere (ops/bitpack)
+                po_kw.update(
+                    pv_slot=np.asarray(pv_snap.slot),
+                    pv_tickv=np.asarray(pv_snap.tickv),
+                    pv_wits=np.asarray(pv_snap.wits),
+                    pv_first=np.asarray(pv_snap.first),
+                    pv_parent=np.asarray(pv_snap.parent),
+                    pv_knows=np.asarray(pv_snap.knows),
                 )
             snap = (
                 _to_host(carry[0]),
@@ -695,7 +721,7 @@ def _drive(
                 ),
             )
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:7], out[7]
+        carry, ys = out[:8], out[8]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -718,10 +744,10 @@ def _drive(
         _drain(pending, overlapped=False)
 
     # the run is whole again: hand the final carry back to the cluster
-    f_state, f_up, f_resp, f_adj, f_per, f_ov, f_po = carry
+    f_state, f_up, f_resp, f_adj, f_per, f_ov, f_po, f_pv = carry
     cluster.state = f_state
     cluster.net = srunner.final_net(
-        f_up, f_resp, f_adj, f_per, compiled, ov=f_ov, po=f_po
+        f_up, f_resp, f_adj, f_per, compiled, ov=f_ov, po=f_po, pv=f_pv
     )
     cluster.set_loss(float(compiled.loss[-1]))  # host mirror (run_scenario)
     if checkpoint_path is not None:
@@ -825,6 +851,7 @@ def run_sweep_streamed(
     if policy is not None and traffic is not None:
         policy = pol.compile_policy(policy, n=cluster.n, m=traffic.static.m)
     srunner.precheck_policy(policy, traffic, cluster.net)
+    srunner.precheck_prov(cs.base, cluster.net, params)
     traffic = srunner.overload_traffic(traffic, cs.base)
     traffic = srunner.policy_traffic(traffic, policy)
     tr_tensors = traffic.tensors if traffic is not None else None
@@ -848,6 +875,7 @@ def run_sweep_streamed(
         static_traffic.max_retries if static_traffic is not None else 0,
     )
     knobs = ssweep.policy_knob_axes(policy, policy_axes, r)
+    pv0, pv_at, pv_node = srunner.prepare_prov(cs.base, cluster.net, params)
     carry = (
         ssweep._broadcast_replicas(f_state, r),
         ssweep._broadcast_replicas(cluster.net.up, r),
@@ -856,6 +884,7 @@ def run_sweep_streamed(
         ssweep._broadcast_replicas(period0, r),
         ssweep._broadcast_replicas(ov0, r),
         ssweep._broadcast_replicas(po0, r),
+        ssweep._broadcast_replicas(pv0, r),
     )
     sharding = ssweep._replica_sharding() if shard else None
     if sharding is not None:
@@ -920,6 +949,10 @@ def run_sweep_streamed(
             carry[5],  # the overload feedback carry (or None)
             carry[6],  # the remediation policy carry (or None)
             knobs,
+            None,  # sw_knobs: param_knobs is not wired streamed
+            carry[7],  # the provenance carry (ProvCarry or None)
+            pv_at,
+            pv_node,
         )
         statics = dict(
             params=params,
@@ -927,6 +960,7 @@ def run_sweep_streamed(
             traffic=static_traffic,
             overload=cs.base.overload,
             policy=policy.config if policy is not None else None,
+            prov=cs.base.trace_rumors or None,
         )
         ssweep._dispatches += 1
         t0 = time.perf_counter()
@@ -975,7 +1009,7 @@ def run_sweep_streamed(
 
     for seg, (a, b) in enumerate(bounds):
         out, row = _launch(seg, a, b, carry)
-        carry, ys = out[:7], out[7]
+        carry, ys = out[:8], out[8]
         if pending is not None:
             _drain(pending, overlapped=True)
             pending = None
@@ -989,7 +1023,7 @@ def run_sweep_streamed(
     if pending is not None:
         _drain(pending, overlapped=False)
 
-    states, up, resp, adj_out, per_out, ov_out, po_out = carry
+    states, up, resp, adj_out, per_out, ov_out, po_out, pv_out = carry
     net_kw = {}
     if ov_out is not None:
         net_kw = dict(ov_cnt=ov_out[0], ov_gray=ov_out[1])
@@ -998,6 +1032,12 @@ def run_sweep_streamed(
             po_press=po_out[0], po_shed=po_out[1], po_quar=po_out[2],
             po_sends_w=po_out[3], po_deliv_w=po_out[4],
             po_retry_cap=po_out[5],
+        )
+    if pv_out is not None:
+        net_kw.update(
+            pv_slot=pv_out.slot, pv_tickv=pv_out.tickv, pv_wits=pv_out.wits,
+            pv_first=pv_out.first, pv_parent=pv_out.parent,
+            pv_knows=pv_out.knows,
         )
     nets = NetState(up=up, responsive=resp, adj=adj_out, period=per_out,
                     **net_kw)

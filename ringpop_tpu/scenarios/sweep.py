@@ -355,26 +355,35 @@ def _sweep_scan_impl(
     po=None,
     po_knobs=None,
     sw_knobs=None,
+    pv=None,
+    pv_at=None,
+    pv_node=None,
     *,
     params,
     has_revive: bool,
     traffic=None,
     overload=None,
     policy=None,
+    prov: int | None = None,
 ):
     # ``tick0`` (traced int32 scalar shared by every replica, or None
     # for 0) is the segment offset of the streamed sweep
     # (scenarios/stream.py): closed over rather than batched, so the
     # vmapped body sees the same global tick numbering per segment.
+    # ``pv_at``/``pv_node`` (the track-op reservations) are likewise
+    # closed over: the spec's slot plan is shared by every replica —
+    # only the provenance CARRY batches (each replica infects its own
+    # wavefronts from its own chaos).
     def one(state, up, responsive, adj, period, ev_tick, ev_kind, ev_node,
             p_tick, p_gid, loss, keys, faults, tr_tensors, ov, po,
-            po_knobs, sw_knobs):
+            po_knobs, sw_knobs, pv):
         return runner._scenario_scan_impl(
             state, up, responsive, adj, period,
             ev_tick, ev_kind, ev_node, p_tick, p_gid, loss, keys,
             tr_tensors, tick0, faults, ov, po, po_knobs, sw_knobs,
+            pv, pv_at, pv_node,
             params=params, has_revive=has_revive, traffic=traffic,
-            overload=overload, policy=policy,
+            overload=overload, policy=policy, prov=prov,
         )
 
     return jax.vmap(
@@ -390,7 +399,7 @@ def _sweep_scan_impl(
         # key batches against its own trajectory, exactly what a
         # standalone run_scenario with this workload would serve).
         in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0, 0, None, None, 0,
-                 0, 0, 0),
+                 0, 0, 0, 0),
     )(
         state,
         up,
@@ -410,6 +419,7 @@ def _sweep_scan_impl(
         po,
         po_knobs,
         sw_knobs,
+        pv,
     )
 
 
@@ -418,7 +428,9 @@ def _sweep_scan_impl(
 # benchmarks/mem_census.py.
 _sweep_scan = jax.jit(
     _sweep_scan_impl,
-    static_argnames=("params", "has_revive", "traffic", "overload", "policy"),
+    static_argnames=(
+        "params", "has_revive", "traffic", "overload", "policy", "prov"
+    ),
     donate_argnums=(0, 1, 2, 3),
 )
 
@@ -629,9 +641,11 @@ def run_sweep_compiled(
         )
     adj = runner.precheck(state, net, cs.base, params)
     runner.precheck_policy(policy, traffic, net)
+    runner.precheck_prov(cs.base, net, params)
     traffic = runner.overload_traffic(traffic, cs.base)
     traffic = runner.policy_traffic(traffic, policy)
     state, period, ov = runner.prepare_faults(state, net, cs.base, params)
+    pv, pv_at, pv_node = runner.prepare_prov(cs.base, net, params)
     r = cs.replicas
     po = None
     knobs = policy_knob_axes(policy, policy_axes, r)
@@ -655,6 +669,7 @@ def run_sweep_compiled(
     ]
     ov_b = _broadcast_replicas(ov, r)
     po_b = _broadcast_replicas(po, r)
+    pv_b = _broadcast_replicas(pv, r)
     if shard:
         precheck_shard(r)
         sharding = _replica_sharding()
@@ -671,6 +686,9 @@ def run_sweep_compiled(
             )
             po_b = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, sharding), po_b
+            )
+            pv_b = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), pv_b
             )
             knobs = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, sharding), knobs
@@ -691,9 +709,11 @@ def run_sweep_compiled(
         meta["policy"] = policy.name
     if param_axes:
         meta["param_axes"] = sorted(param_axes)
+    if cs.base.trace_rumors:
+        meta["trace_rumors"] = cs.base.trace_rumors
     # routed through the dispatch ledger (obs/ledger.py): a call-through
     # when disabled, a recorded compile/execute + footprint row when on
-    states, up, resp, adj, period, ov, po, ys = default_ledger().dispatch(
+    states, up, resp, adj, period, ov, po, pv, ys = default_ledger().dispatch(
         "run_sweep" if program_tag is None else f"run_sweep:{program_tag}",
         _sweep_scan,
         *batched,
@@ -711,11 +731,15 @@ def run_sweep_compiled(
         po_b,
         knobs,
         sw_knobs,
+        pv_b,
+        pv_at,
+        pv_node,
         params=params,
         has_revive=cs.base.has_revive,
         traffic=traffic.static if traffic is not None else None,
         overload=cs.base.overload,
         policy=policy.config if policy is not None else None,
+        prov=cs.base.trace_rumors or None,
         _meta=meta,
     )
     net_kw = {}
@@ -725,6 +749,11 @@ def run_sweep_compiled(
         net_kw.update(
             po_press=po[0], po_shed=po[1], po_quar=po[2],
             po_sends_w=po[3], po_deliv_w=po[4], po_retry_cap=po[5],
+        )
+    if pv is not None:
+        net_kw.update(
+            pv_slot=pv.slot, pv_tickv=pv.tickv, pv_wits=pv.wits,
+            pv_first=pv.first, pv_parent=pv.parent, pv_knows=pv.knows,
         )
     nets = type(net)(up=up, responsive=resp, adj=adj, period=period, **net_kw)
     return states, nets, ys

@@ -109,10 +109,15 @@ class Trace:
         }
         if self.planes:
             # histogram planes summarize as percentile estimates of
-            # their whole-run bucket aggregate (bucket-floor values)
+            # their whole-run bucket aggregate (bucket-floor values);
+            # provenance planes (pv_*) are per-slot counters, not
+            # bucket rows — their stats come from the host report
+            # (obs.provenance.build_report), not a bucket aggregate
             from ringpop_tpu.traffic.latency import hist_stats
 
             for name, arr in self.planes.items():
+                if name.startswith("pv_"):
+                    continue
                 out[name] = hist_stats(arr.sum(axis=0))
         return out
 

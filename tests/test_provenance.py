@@ -1,0 +1,351 @@
+"""Gossip provenance plane: rumor tracing against a per-tick host oracle.
+
+The acceptance oracle is the eager host walk (``_host_prov_walk``):
+step the protocol per tick with the same key schedule, export the same
+delivery-evidence bundle (``swim_step(..., prov=True)``), and fold it
+through the SAME ``obs.provenance.prov_update`` the compiled scan
+folds — slots, wavefronts, parents, resolutions, and the per-tick
+``pv_heard`` plane must match bit for bit (the update is exact int
+algebra shared by both callers, so parity is equality).
+
+Fast lane: spec validation, the dense oracle, the report/spans
+exporters, the prov-off == legacy equivalence pin, and the precheck
+rejections.  The delta twin, streamed/resume bit-parity, and the sweep
+replica contract ride the slow lane (each is its own XLA compile).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.models import swim_delta as sdelta
+from ringpop_tpu.models import swim_sim as sim
+from ringpop_tpu.models.cluster import SimCluster
+from ringpop_tpu.models.swim_sim import SwimParams
+from ringpop_tpu.obs import provenance as pvn
+from ringpop_tpu.obs import spans as pvspans
+from ringpop_tpu.ops import bitpack
+from ringpop_tpu.scenarios import compile as scompile
+from ringpop_tpu.scenarios.spec import ScenarioSpec
+
+N = 10
+LEAN = SwimParams(suspicion_ticks=8, ping_req_size=1)
+K = 3
+
+# one reserved slot that never fires (node 1 stays healthy), one kill
+# whose suspect rumor auto-arms a free slot and confirms at suspicion
+# expiry — reservation passthrough + auto-arm + resolution in one spec
+PV_SPEC = {
+    "ticks": 18,
+    "trace_rumors": K,
+    "events": [
+        {"at": 0, "op": "track", "node": 1},
+        {"at": 3, "op": "kill", "node": 9},
+    ],
+}
+
+
+@pytest.fixture(scope="module")
+def traced():
+    """One traced dense run shared by the fast lane (order-dependent:
+    the precheck test clears its provenance state and runs LAST)."""
+    c = SimCluster(N, LEAN, seed=11)
+    trace = c.run_scenario(PV_SPEC)
+    return c, trace
+
+
+# ---------------------------------------------------------------------------
+# fast: pure-host validation
+# ---------------------------------------------------------------------------
+
+
+def test_provenance_spec_validation():
+    def bad(d, match=None):
+        with pytest.raises(ValueError, match=match):
+            ScenarioSpec.from_dict(d).validate(N)
+
+    ok = dict(PV_SPEC)
+    ScenarioSpec.from_dict(ok).validate(N)
+    bad(dict(ok, trace_rumors=-1), "trace_rumors")
+    bad(dict(ok, trace_rumors=pvn.MAX_RUMORS + 1), "trace_rumors")
+    bad(dict(ok, ticks=pvn.MAX_TICKS + 1), "int16")
+    # track needs a slot count, a valid subject, and no duplicates
+    bad({"ticks": 8, "events": [{"at": 0, "op": "track", "node": 1}]},
+        "trace_rumors")
+    bad(dict(ok, events=[{"at": 0, "op": "track", "node": N}]), "track")
+    bad(dict(ok, events=[{"at": 0, "op": "track", "node": 1},
+                         {"at": 2, "op": "track", "node": 1}]),
+        "duplicate track")
+    # more reservations than slots
+    bad(dict(ok, trace_rumors=1,
+             events=[{"at": 0, "op": "track", "node": 1},
+                     {"at": 0, "op": "track", "node": 2}]),
+        "exceed")
+    # JSON round trip keeps the plane config
+    spec = ScenarioSpec.from_dict(ok)
+    assert ScenarioSpec.from_json(spec.to_json()) == spec
+    assert spec.trace_rumors == K
+    # trace_rumors=0 is the default and stays out of the dict form
+    assert "trace_rumors" not in ScenarioSpec(ticks=4).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# the host oracle
+# ---------------------------------------------------------------------------
+
+
+def _host_prov_walk(backend, spec_obj, seed, **kw):
+    """Step the protocol eagerly with the scan's key schedule, folding
+    each tick's evidence bundle through ``prov_update`` exactly as the
+    scan body does.  Returns (cluster, ProvCarry, heard rows)."""
+    c = SimCluster(N, LEAN, seed=seed, backend=backend, **kw)
+    compiled = scompile.compile_spec(spec_obj, c.n, base_loss=c.params.loss)
+    keys = scompile.key_schedule(c._split, compiled)
+    pvc = pvn.init_carry(c.n, spec_obj.trace_rumors, LEAN.ping_req_size)
+    pv_at, pv_node = pvn.track_tensors(compiled.tracks, spec_obj.trace_rumors)
+    by_tick = defaultdict(list)
+    for at, op, arg in scompile.expand_events(spec_obj, c.params.loss):
+        by_tick[at].append((op, arg))
+    heards = []
+    for t in range(spec_obj.ticks):
+        for op, arg in sorted(by_tick.get(t, ()),
+                              key=lambda x: scompile._OP_RANK[x[0]]):
+            if op == "kill":
+                c.kill(arg)
+            elif op == "suspend":
+                c.suspend(arg)
+            elif op == "resume":
+                c.resume(arg)
+            elif op == "loss":
+                c.set_loss(arg)
+        if backend == "delta":
+            c.state, m = sdelta.delta_step(
+                c.state, c.net, keys[t], params=c.dparams, prov=True
+            )
+            view_post = lambda q: sdelta.view_lookup(c.state, q)  # noqa: E731
+        else:
+            c.state, m = sim.swim_step(
+                c.state, c.net, keys[t], params=c.params, prov=True
+            )
+            view_post = lambda q: jnp.take_along_axis(  # noqa: E731
+                c.state.view_key, q, axis=1
+            )
+        ev = {name: m[name] for name in pvn.EVIDENCE_KEYS}
+        pvc, heard = pvn.prov_update(
+            pvc, ev, t, view_post, pv_at, pv_node, c.n
+        )
+        heards.append(np.asarray(heard))
+    return c, pvc, np.stack(heards)
+
+
+def _assert_prov_parity(a, trace, b, pvc, heards):
+    """Compiled scan == host fold, bit for bit, carry and telemetry."""
+    np.testing.assert_array_equal(np.asarray(trace.planes["pv_heard"]),
+                                  heards)
+    for name, host in (
+        ("pv_slot", pvc.slot), ("pv_tickv", pvc.tickv),
+        ("pv_wits", pvc.wits), ("pv_first", pvc.first),
+        ("pv_parent", pvc.parent), ("pv_knows", pvc.knows),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.net, name)), np.asarray(host), err_msg=name
+        )
+    # the evidence export did not perturb the protocol trajectory
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state), jax.tree_util.tree_leaves(b.state)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert a.checksums() == b.checksums()
+
+
+def test_provenance_dense_host_oracle(traced):
+    """Tier-1 acceptance oracle (dense arm)."""
+    a, trace = traced
+    b, pvc, heards = _host_prov_walk(
+        "dense", ScenarioSpec.from_dict(PV_SPEC), seed=11
+    )
+    _assert_prov_parity(a, trace, b, pvc, heards)
+
+
+def test_provenance_report_and_spans(traced, tmp_path):
+    """The report's causality chain is coherent and the Perfetto
+    exporter writes structurally valid trace-event JSON from it."""
+    a, _ = traced
+    rep = a.provenance_report()
+    assert rep["n"] == N and rep["log2_n"] == 4
+    rumors = {r["subject"]: r for r in rep["rumors"]}
+    assert 9 in rumors  # the kill's suspect rumor auto-armed
+    r = rumors[9]
+    assert r["slot"] != 0  # slot 0 stays reserved for node 1, unarmed
+    assert all(x["slot"] != 0 for x in rep["rumors"])
+    assert r["key"] % 8 == pvn._SUSPECT
+    assert 0 <= r["origin"] < N and r["origin"] != 9
+    assert r["origin_tick"] >= 3
+    # a dead subject cannot refute: confirmed at suspicion expiry,
+    # every live node heard, and the tree is rooted (origin at depth 0)
+    assert r["resolution"] == pvn.RES_CONFIRMED
+    assert r["resolution_tick"] > r["origin_tick"]
+    assert r["infected"] == N - 1 and r["unheard"] == 1
+    assert r["first_heard"][9] == pvn.UNHEARD
+    assert r["parent"][r["origin"]] == pvn.P_ORIGIN
+    assert r["depth_max"] >= 1
+    assert r["infection_p50"] <= r["infection_p95"] <= r["infection_p99"]
+    assert len(r["witnesses"]) <= LEAN.ping_req_size
+    # knows plane == (first_heard >= 0): the packed carry agrees
+    knows = bitpack.unpack_bits(jnp.asarray(a.net.pv_knows), N)
+    np.testing.assert_array_equal(
+        np.asarray(knows), np.asarray(a.net.pv_first) >= 0
+    )
+    # the summary block is all-int (golden-pinnable)
+    block = pvn.summary_block(rep)
+    assert block["rumors"] == len(rep["rumors"])
+    assert all(isinstance(v, int) for v in block.values())
+
+    path = str(tmp_path / "spans.json")
+    count = pvspans.write_spans(rep, path)
+    doc = json.loads(open(path).read())
+    events = doc["traceEvents"]
+    assert count == len(events) > 0
+    assert {e["ph"] for e in events} <= {"M", "X", "s", "f"}
+    # every flow-start has its matching flow-end
+    starts = {e["id"] for e in events if e["ph"] == "s"}
+    ends = {e["id"] for e in events if e["ph"] == "f"}
+    assert starts and starts == ends
+    # one infection event per heard node of each rumor
+    infections = [e for e in events if e.get("cat") == "infection"]
+    assert len(infections) == sum(x["infected"] for x in rep["rumors"])
+    assert doc["otherData"]["summary"] == block
+
+
+def test_provenance_off_is_legacy(traced):
+    """The plane is observer-only: a traced run's protocol trajectory
+    is bit-identical to the untraced run, and the untraced program
+    carries no pv residue at all."""
+    a, ta = traced
+    spec_off = dict(PV_SPEC, trace_rumors=0)
+    spec_off["events"] = [e for e in spec_off["events"]
+                          if e["op"] != "track"]
+    b = SimCluster(N, LEAN, seed=11)
+    tb = b.run_scenario(spec_off)
+    assert "pv_heard" in ta.planes and "pv_heard" not in tb.planes
+    for k in tb.metrics:
+        np.testing.assert_array_equal(ta.metrics[k], tb.metrics[k], err_msg=k)
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.state), jax.tree_util.tree_leaves(b.state)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert a.checksums() == b.checksums()
+    assert b.net.pv_slot is None
+    with pytest.raises(ValueError, match="no provenance state"):
+        b.provenance_report()
+
+
+def test_provenance_precheck_rejections(traced):
+    """Static rejections fire before any key is drawn.  Runs LAST in
+    the fast lane: it clears the shared fixture's provenance state."""
+    # the sparse fast path never materializes the evidence bundle
+    c = SimCluster(N, LEAN._replace(sparse_cap=4), seed=2)
+    with pytest.raises(NotImplementedError, match="sparse_cap"):
+        c.run_scenario(PV_SPEC)
+    # leftover tracked-rumor state from a finished run
+    a, _ = traced
+    with pytest.raises(ValueError, match="clear_provenance"):
+        a.run_scenario(PV_SPEC)
+    a.clear_provenance()
+    assert a.net.pv_slot is None
+    with pytest.raises(ValueError, match="no provenance state"):
+        a.provenance_report()
+
+
+# ---------------------------------------------------------------------------
+# slow: the delta twin + execution-strategy contracts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_provenance_delta_host_oracle():
+    """The delta twin of the acceptance oracle (same ``prov_update``
+    over ``view_lookup`` post-views; its own XLA compile)."""
+    kw = dict(capacity=N, wire_cap=N, claim_grid=3 * N * N)
+    a = SimCluster(N, LEAN, seed=11, backend="delta", **kw)
+    trace = a.run_scenario(PV_SPEC)
+    b, pvc, heards = _host_prov_walk(
+        "delta", ScenarioSpec.from_dict(PV_SPEC), seed=11, **kw
+    )
+    _assert_prov_parity(a, trace, b, pvc, heards)
+    # the wavefront report is backend-coherent too
+    r = {x["subject"]: x for x in a.provenance_report()["rumors"]}[9]
+    assert r["resolution"] == pvn.RES_CONFIRMED
+    assert r["infected"] == N - 1
+
+
+@pytest.mark.slow
+def test_provenance_streamed_and_resume_bit_identical(tmp_path):
+    """Streaming a traced run is an execution strategy (same pv
+    tensors), and a SIGKILL mid-run resumes from the checkpoint v5 pv
+    planes to a bit-identical end state."""
+    from ringpop_tpu import checkpoint as ckpt
+    from ringpop_tpu.scenarios import stream as sstream
+
+    a = SimCluster(N, LEAN, seed=7)
+    a.run_scenario(PV_SPEC)
+    b = SimCluster(N, LEAN, seed=7)
+    b.run_scenario(PV_SPEC, segment_ticks=7)
+    for name in ("pv_slot", "pv_tickv", "pv_wits", "pv_first",
+                 "pv_parent", "pv_knows"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.net, name)),
+            np.asarray(getattr(b.net, name)), err_msg=name,
+        )
+    assert a.checksums() == b.checksums()
+
+    ckpt_path = str(tmp_path / "pv.npz")
+    cv = SimCluster(N, LEAN, seed=7)
+    with pytest.raises(sstream.StreamInterrupted):
+        sstream.run_streamed(
+            cv, PV_SPEC, segment_ticks=7,
+            checkpoint_path=ckpt_path, interrupt_after=1,
+        )
+    # the checkpoint carries the mid-flight planes
+    mid = ckpt.load(ckpt_path)
+    assert mid.net.pv_slot is not None
+    cr, _ = sstream.resume(ckpt_path)
+    for name in ("pv_slot", "pv_tickv", "pv_wits", "pv_first",
+                 "pv_parent", "pv_knows"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.net, name)),
+            np.asarray(getattr(cr.net, name)), err_msg=name,
+        )
+    assert a.checksums() == cr.checksums()
+    assert cr.provenance_report()["rumors"]  # report works post-resume
+
+
+@pytest.mark.slow
+def test_provenance_sweep_replica_parity():
+    """A traced sweep replica is bit-identical to the standalone run
+    from its replica key, and the per-replica pv tensors land on
+    ``final_nets`` (the cluster itself does not advance)."""
+    c = SimCluster(N, LEAN, seed=9)
+    strace = c.run_sweep(PV_SPEC, 2)
+    assert c.net.pv_slot is None  # sweeps never advance the cluster
+    assert strace.planes["pv_heard"].shape == (2, PV_SPEC["ticks"], K)
+    strace.summary()  # pv planes are skipped, not summarized
+    d = SimCluster(N, LEAN, seed=9)
+    d.key = jnp.asarray(strace.replica_keys[1])
+    td = d.run_scenario(PV_SPEC)
+    np.testing.assert_array_equal(
+        strace.planes["pv_heard"][1], np.asarray(td.planes["pv_heard"])
+    )
+    for name in ("pv_slot", "pv_tickv", "pv_wits", "pv_first",
+                 "pv_parent", "pv_knows"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(strace.final_nets, name))[1],
+            np.asarray(getattr(d.net, name)), err_msg=name,
+        )

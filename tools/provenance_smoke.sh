@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# CPU provenance smoke: the gossip provenance plane end to end through
+# the CLI.  Replays thundering_rejoin (half the cluster dies at once —
+# every slot's suspect rumor CONFIRMS) at the golden configuration with
+# 8 rumor slots armed and the Perfetto exporter on, then asserts the
+# exported trace-event JSON is structurally valid and carries what the
+# plane promises: a nonzero infection wavefront per rumor, flow arrows
+# along the propagation tree, and a complete suspect→confirmed
+# detection-causality chain for a killed node (origin prober + witness
+# window + resolution tick).
+# This is the CI provenance-smoke job's body; run it locally the same
+# way:  tools/provenance_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/ringpop-prov.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+
+echo "== traced incident run (golden configuration)"
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+  python -m ringpop_tpu tick-cluster --backend tpu-sim -n 16 --seed 3 \
+  --incident thundering_rejoin --trace-rumors 8 \
+  --spans-out "$workdir/spans.json" \
+  | tee "$workdir/run.log"
+
+grep -q "provenance: 8/8 rumor slots armed" "$workdir/run.log"
+grep -q "rumors 8" "$workdir/run.log"
+
+JAX_PLATFORMS=cpu python - "$workdir" <<'EOF'
+import json
+import sys
+
+workdir = sys.argv[1]
+with open(f"{workdir}/spans.json") as f:
+    doc = json.load(f)
+
+events = doc["traceEvents"]
+summary = doc["otherData"]["summary"]
+n = doc["otherData"]["n"]
+assert n == 16, doc["otherData"]
+
+# every rumor armed, every one a CONFIRMED suspect→faulty chain (the
+# killed half cannot refute), full wavefront reach
+assert summary["rumors"] == 8, summary
+assert summary["confirmed"] == 8 and summary["refuted"] == 0, summary
+assert summary["infected_min"] == n, summary
+
+by_phase = {}
+for e in events:
+    by_phase.setdefault(e["ph"], []).append(e)
+assert set(by_phase) <= {"M", "X", "s", "f"}, set(by_phase)
+
+# one detection window per rumor, each a complete confirmed chain
+det = [e for e in by_phase["X"] if e.get("cat") == "detection"]
+assert len(det) == 8, len(det)
+for e in det:
+    assert e["name"] == "suspect→confirmed", e["name"]
+    a = e["args"]
+    assert 0 <= a["origin_prober"] < n, a
+    assert a["resolution"] == "confirmed", a
+    assert a["resolution_tick"] > e["ts"] // doc["otherData"]["tick_us"], a
+    assert e["dur"] > 0, e
+
+# a nonzero infection wavefront: one 1-tick slice per heard node
+inf = [e for e in by_phase["X"] if e.get("cat") == "infection"]
+assert len(inf) == 8 * n, len(inf)
+
+# flow arrows pair up along the propagation tree
+starts = {e["id"] for e in by_phase.get("s", [])}
+ends = {e["id"] for e in by_phase.get("f", [])}
+assert starts and starts == ends, (len(starts), len(ends))
+
+print(
+    f"provenance smoke OK: {summary['rumors']} rumors confirmed, "
+    f"wavefront {summary['infected_min']}/{n}, depth "
+    f"{summary['depth_max']}, {len(events)} trace events"
+)
+EOF
+
+echo "provenance smoke passed"
